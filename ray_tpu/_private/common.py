@@ -1,0 +1,269 @@
+"""Shared data model: task/actor specs, resource sets, config.
+
+Analog of the reference's src/ray/common/ (TaskSpec task/task_spec.h, fixed-point
+resource arithmetic scheduling/fixed_point.h, RayConfig ray_config_def.h). Specs
+are msgpack-serializable dicts with typed wrappers; resources use integer
+fixed-point (1/10000 granularity) so fractional grants never drift.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# Config. Pattern follows the reference's RAY_CONFIG table: every knob is
+# overridable via environment variable RAY_TPU_<NAME>.
+# ---------------------------------------------------------------------------
+
+_CONFIG_DEFAULTS: Dict[str, Any] = {
+    # Objects at or below this size live in the owner's in-process memory
+    # store and move inline through RPCs; larger go to the shm store.
+    "max_direct_call_object_size": 100 * 1024,
+    # Default object store capacity fraction of system memory.
+    "object_store_memory_fraction": 0.3,
+    "object_store_memory_min": 64 * 1024 * 1024,
+    # Worker lease / pool.
+    "worker_lease_timeout_s": 60.0,
+    "idle_worker_keep_s": 60.0,
+    "max_workers_per_node": 64,
+    # Health checks (reference cadence: ray_config_def.h:847-853).
+    "health_check_initial_delay_s": 5.0,
+    "health_check_period_s": 3.0,
+    "health_check_timeout_s": 10.0,
+    "health_check_failure_threshold": 5,
+    # Task defaults.
+    "default_max_task_retries": 3,
+    "actor_default_max_restarts": 0,
+    # Object transfer chunk size between nodes.
+    "object_chunk_size": 8 * 1024 * 1024,
+    # Scheduling: hybrid policy spills beyond this utilization (reference
+    # scheduler_spread_threshold).
+    "scheduler_spread_threshold": 0.5,
+    "scheduler_top_k_fraction": 0.2,
+}
+
+
+class _Config:
+    def __getattr__(self, name: str):
+        if name not in _CONFIG_DEFAULTS:
+            raise AttributeError(name)
+        env = os.environ.get(f"RAY_TPU_{name.upper()}")
+        default = _CONFIG_DEFAULTS[name]
+        if env is None:
+            return default
+        if isinstance(default, bool):
+            return env.lower() in ("1", "true", "yes")
+        return type(default)(env)
+
+
+config = _Config()
+
+# ---------------------------------------------------------------------------
+# Fixed-point resources (reference: src/ray/common/scheduling/fixed_point.h).
+# ---------------------------------------------------------------------------
+
+RESOURCE_UNIT = 10000  # 1.0 CPU == 10000 units
+
+
+def to_fixed(amount: float) -> int:
+    return int(round(amount * RESOURCE_UNIT))
+
+
+def from_fixed(units: int) -> float:
+    return units / RESOURCE_UNIT
+
+
+class ResourceSet:
+    """A bag of named resource quantities with exact arithmetic."""
+
+    __slots__ = ("_units",)
+
+    def __init__(self, amounts: Optional[Dict[str, float]] = None, _units=None):
+        if _units is not None:
+            self._units = {k: v for k, v in _units.items() if v != 0}
+        else:
+            self._units = {
+                k: to_fixed(v) for k, v in (amounts or {}).items() if to_fixed(v) != 0
+            }
+
+    @classmethod
+    def from_units(cls, units: Dict[str, int]) -> "ResourceSet":
+        return cls(_units=dict(units))
+
+    def to_units(self) -> Dict[str, int]:
+        return dict(self._units)
+
+    def to_dict(self) -> Dict[str, float]:
+        return {k: from_fixed(v) for k, v in self._units.items()}
+
+    def is_subset_of(self, other: "ResourceSet") -> bool:
+        return all(other._units.get(k, 0) >= v for k, v in self._units.items())
+
+    def __add__(self, other: "ResourceSet") -> "ResourceSet":
+        units = dict(self._units)
+        for k, v in other._units.items():
+            units[k] = units.get(k, 0) + v
+        return ResourceSet.from_units(units)
+
+    def __sub__(self, other: "ResourceSet") -> "ResourceSet":
+        units = dict(self._units)
+        for k, v in other._units.items():
+            units[k] = units.get(k, 0) - v
+        return ResourceSet.from_units(units)
+
+    def get(self, name: str) -> float:
+        return from_fixed(self._units.get(name, 0))
+
+    def is_empty(self) -> bool:
+        return not self._units
+
+    def nonnegative(self) -> bool:
+        return all(v >= 0 for v in self._units.values())
+
+    def keys(self):
+        return self._units.keys()
+
+    def __repr__(self):
+        return f"ResourceSet({self.to_dict()})"
+
+    def __eq__(self, other):
+        return isinstance(other, ResourceSet) and self._units == other._units
+
+
+# ---------------------------------------------------------------------------
+# Specs. Kept as plain dicts on the wire; wrappers give attribute access.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TaskSpec:
+    """Everything a worker needs to execute one task invocation.
+
+    Reference: TaskSpec proto (src/ray/protobuf/common.proto; max_task_retries
+    at :666). args_blob is cloudpickle((args, kwargs)) with contained
+    ObjectRefs reduced to descriptors; dependencies lists those refs so the
+    executor resolves them before unpickling.
+    """
+
+    task_id: str  # hex
+    job_id: str
+    name: str
+    func_id: str  # content hash; body in GCS function table
+    args_blob: Optional[bytes]
+    dependencies: List[Tuple[str, Tuple[str, int]]]  # (oid hex, owner addr)
+    num_returns: int
+    return_ids: List[str]
+    resources: Dict[str, int]  # fixed-point units
+    # Large-args path: the serialized (args, kwargs) lives in the shm store
+    # under this id instead of args_blob.
+    args_object: Optional[str] = None
+    # Positions/keys of top-level ObjectRef arguments the executor resolves
+    # to values before invoking the function (reference semantics).
+    ref_positions: List[int] = field(default_factory=list)
+    kw_ref_keys: List[str] = field(default_factory=list)
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    owner_addr: Optional[Tuple[str, int]] = None  # owner's object server
+    # Actor fields.
+    actor_id: Optional[str] = None
+    actor_creation: bool = False
+    actor_method: Optional[str] = None
+    seq_no: int = -1
+    caller_id: Optional[str] = None
+    max_restarts: int = 0
+    max_concurrency: int = 1
+    # Placement.
+    pg_id: Optional[str] = None
+    bundle_index: int = -1
+    scheduling_strategy: Optional[dict] = None
+    runtime_env: Optional[dict] = None
+    # Named actor registration.
+    actor_name: Optional[str] = None
+    namespace: Optional[str] = None
+
+    def to_wire(self) -> dict:
+        return {k: getattr(self, k) for k in self.__dataclass_fields__}
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "TaskSpec":
+        known = {k: d[k] for k in cls.__dataclass_fields__ if k in d}
+        return cls(**known)
+
+
+@dataclass
+class Bundle:
+    """One placement-group bundle: a resource reservation on a single node."""
+
+    resources: Dict[str, int]  # fixed-point
+    node_id: Optional[str] = None  # filled once placed
+
+
+@dataclass
+class PlacementGroupSpec:
+    pg_id: str
+    bundles: List[Dict[str, int]]
+    strategy: str  # PACK | SPREAD | STRICT_PACK | STRICT_SPREAD
+    name: str = ""
+    job_id: str = ""
+
+    def to_wire(self) -> dict:
+        return {
+            "pg_id": self.pg_id,
+            "bundles": self.bundles,
+            "strategy": self.strategy,
+            "name": self.name,
+            "job_id": self.job_id,
+        }
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "PlacementGroupSpec":
+        return cls(**d)
+
+
+# ---------------------------------------------------------------------------
+# Errors (analog of python/ray/exceptions.py).
+# ---------------------------------------------------------------------------
+
+
+class RayTpuError(Exception):
+    pass
+
+
+class TaskError(RayTpuError):
+    """Wraps an exception raised by user task code; re-raised at ray.get."""
+
+    def __init__(self, cause: BaseException, task_name: str = "", traceback_str: str = ""):
+        self.cause = cause
+        self.task_name = task_name
+        self.traceback_str = traceback_str
+        super().__init__(f"task {task_name!r} failed: {cause!r}\n{traceback_str}")
+
+
+class WorkerCrashedError(RayTpuError):
+    pass
+
+
+class ActorDiedError(RayTpuError):
+    pass
+
+
+class ActorUnavailableError(RayTpuError):
+    pass
+
+
+class ObjectLostError(RayTpuError):
+    pass
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class TaskCancelledError(RayTpuError):
+    pass
+
+
+class PlacementGroupError(RayTpuError):
+    pass

@@ -1,0 +1,1007 @@
+"""CoreWorker: the in-process runtime embedded in every driver and worker.
+
+TPU-native analog of the reference's CoreWorker (src/ray/core_worker/core_worker.h:292):
+Put/Get/Wait, task submission over leased workers (direct task transport —
+transport/direct_task_transport.h:75), direct actor submission with per-handle
+sequence numbers (transport/sequential_actor_submit_queue.cc), ownership-based
+reference counting (reference_count.cc), task retries (task_manager.cc), and an
+object server so borrowers can pull owner-local objects.
+
+Everything here is async and runs on the process's event loop; the public sync
+API (ray_tpu/_private/worker.py) bridges via run_coroutine_threadsafe.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from ray_tpu._private import rpc, serialization
+from ray_tpu._private.common import (
+    ActorDiedError,
+    GetTimeoutError,
+    ObjectLostError,
+    ResourceSet,
+    TaskCancelledError,
+    TaskError,
+    TaskSpec,
+    WorkerCrashedError,
+    config,
+)
+from ray_tpu._private.gcs import GcsClient
+from ray_tpu._private.ids import ActorID, ObjectID, TaskID, deterministic_object_id
+from ray_tpu._private.object_store import IN_PLASMA, INLINE, MemoryStore, PlasmaClient
+
+logger = logging.getLogger(__name__)
+
+
+class ObjectRef:
+    """A reference to a (possibly not-yet-computed) object.
+
+    Carries the owner's object-server address so any holder can resolve the
+    value (ownership model: the owner worker is the object's directory).
+    """
+
+    __slots__ = ("_hex", "_owner_addr", "_core", "__weakref__")
+
+    def __init__(self, hex_id: str, owner_addr: Tuple[str, int], core: Optional["CoreWorker"] = None):
+        self._hex = hex_id
+        self._owner_addr = tuple(owner_addr) if owner_addr else None
+        self._core = core
+        if core is not None:
+            core.reference_table.add_local(hex_id)
+
+    def hex(self) -> str:
+        return self._hex
+
+    def binary(self) -> bytes:
+        return bytes.fromhex(self._hex)
+
+    @property
+    def owner_addr(self):
+        return self._owner_addr
+
+    def __repr__(self):
+        return f"ObjectRef({self._hex})"
+
+    def __hash__(self):
+        return hash(self._hex)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._hex == self._hex
+
+    def __reduce__(self):
+        serialization.record_contained_ref(self)
+        deserializer = serialization.get_ref_deserializer()
+        if deserializer is not None:
+            return (deserializer, (self._hex, self._owner_addr))
+        return (_plain_ref, (self._hex, self._owner_addr))
+
+    def __del__(self):
+        core = self._core
+        if core is not None and not core.closed:
+            try:
+                core.reference_table.remove_local(self._hex, core)
+            except Exception:
+                pass
+
+    def __await__(self):
+        # Allows `await ref` inside async actors.
+        core = self._core
+        if core is None:
+            raise RuntimeError("ObjectRef is not attached to a core worker")
+        return core.get_objects([self], timeout=None).__await__()
+
+
+def _plain_ref(hex_id, owner_addr):
+    # Deserialized outside any worker context (e.g. in a subprocess tool):
+    # ref without a core; get() requires re-attachment.
+    return ObjectRef(hex_id, owner_addr, None)
+
+
+class RefEntry:
+    __slots__ = ("local", "submitted", "owned", "freed")
+
+    def __init__(self):
+        self.local = 0
+        self.submitted = 0
+        self.owned = False
+        self.freed = False
+
+
+class ReferenceTable:
+    """Per-process reference counts driving object lifetime.
+
+    Owner frees the object (memory store entry + shm primary copy) once the
+    local python refcount and in-flight-task count both reach zero.
+    Reference: src/ray/core_worker/reference_count.cc (we implement the
+    owner-side protocol; cross-worker borrow counts are conservatively
+    approximated by the submitted-task count).
+    """
+
+    def __init__(self):
+        self.entries: Dict[str, RefEntry] = {}
+
+    def _entry(self, oid: str) -> RefEntry:
+        e = self.entries.get(oid)
+        if e is None:
+            e = self.entries[oid] = RefEntry()
+        return e
+
+    def add_local(self, oid: str) -> None:
+        self._entry(oid).local += 1
+
+    def mark_owned(self, oid: str) -> None:
+        self._entry(oid).owned = True
+
+    def add_submitted(self, oid: str) -> None:
+        self._entry(oid).submitted += 1
+
+    def remove_submitted(self, oid: str, core: "CoreWorker") -> None:
+        e = self.entries.get(oid)
+        if e is None:
+            return
+        e.submitted -= 1
+        self._maybe_free(oid, e, core)
+
+    def remove_local(self, oid: str, core: "CoreWorker") -> None:
+        e = self.entries.get(oid)
+        if e is None:
+            return
+        e.local -= 1
+        self._maybe_free(oid, e, core)
+
+    def _maybe_free(self, oid: str, e: RefEntry, core: "CoreWorker") -> None:
+        if e.local <= 0 and e.submitted <= 0 and not e.freed:
+            e.freed = True
+            del self.entries[oid]
+            if e.owned:
+                core.schedule_free(oid)
+
+
+class Lease:
+    def __init__(self, lease_id: str, worker_id: str, addr, conn, raylet_conn):
+        self.lease_id = lease_id
+        self.worker_id = worker_id
+        self.addr = tuple(addr)
+        self.conn: rpc.Connection = conn
+        self.raylet_conn: rpc.Connection = raylet_conn
+
+
+class _ShapePool:
+    """Per-resource-shape lease state: idle leases, waiters, in-flight
+    requests to the raylet."""
+
+    __slots__ = ("idle", "waiters", "inflight")
+
+    def __init__(self):
+        self.idle: List[Lease] = []
+        self.waiters: "asyncio.Queue[asyncio.Future]" = None  # lazily created
+        self.inflight = 0
+
+
+class LeasePool:
+    """Granted-lease cache with pipelined acquisition and cancellation.
+
+    Reference design: CoreWorkerDirectTaskSubmitter pipelines one lease
+    request per queued task, reuses returned workers for queued tasks of the
+    same shape, and cancels now-surplus raylet requests — without the
+    cancellation, recycled leases starve the raylet's queue (resources are
+    never returned while requests wait on them).
+    """
+
+    # Idle leases kept per shape before returning workers to the raylet.
+    MAX_IDLE = 2
+
+    def __init__(self, core: "CoreWorker"):
+        self.core = core
+        self.pools: Dict[tuple, _ShapePool] = {}
+        self.waiters: Dict[tuple, List[asyncio.Future]] = {}
+
+    @staticmethod
+    def shape_key(resources: Dict[str, int], pg_id, bundle_index) -> tuple:
+        return (tuple(sorted((resources or {}).items())), pg_id, bundle_index)
+
+    def _pool(self, key) -> _ShapePool:
+        p = self.pools.get(key)
+        if p is None:
+            p = self.pools[key] = _ShapePool()
+        return p
+
+    async def acquire(self, resources: Dict[str, int], pg_id=None, bundle_index=None) -> Lease:
+        key = self.shape_key(resources, pg_id, bundle_index)
+        pool = self._pool(key)
+        while pool.idle:
+            lease = pool.idle.pop()
+            if not lease.conn.closed:
+                return lease
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self.waiters.setdefault(key, []).append(fut)
+        pool.inflight += 1
+        asyncio.create_task(self._request_lease(key, resources, pg_id, bundle_index))
+        return await fut
+
+    async def _request_lease(self, key, resources, pg_id, bundle_index) -> None:
+        from ray_tpu._private.ids import TaskID as _T
+
+        pool = self._pool(key)
+        lease_id = _T.from_random().hex()
+        raylet_conn = self.core.raylet_conn
+        try:
+            hops = 0
+            while True:
+                reply = await raylet_conn.call(
+                    "RequestWorkerLease",
+                    {
+                        "lease_id": lease_id,
+                        "resources": resources,
+                        "pg_id": pg_id,
+                        "bundle_index": bundle_index,
+                    },
+                    timeout=None,
+                )
+                if reply.get("cancelled"):
+                    return
+                if reply.get("granted"):
+                    conn = await self.core.connect_to(tuple(reply["worker_addr"]))
+                    lease = Lease(
+                        reply["lease_id"],
+                        reply["worker_id"],
+                        reply["worker_addr"],
+                        conn,
+                        raylet_conn,
+                    )
+                    self._dispatch(key, lease)
+                    return
+                spill = reply.get("spillback")
+                if spill is None:
+                    raise rpc.RpcError(
+                        f"no node can host resources {resources} (cluster infeasible)"
+                    )
+                hops += 1
+                if hops > 4:
+                    raise rpc.RpcError("lease spillback loop exceeded 4 hops")
+                raylet_conn = await self.core.connect_to(tuple(spill["addr"]))
+        except Exception as e:
+            # Fail one waiter (the request served one logical slot).
+            waiters = self.waiters.get(key, [])
+            while waiters:
+                fut = waiters.pop(0)
+                if not fut.done():
+                    fut.set_exception(e)
+                    break
+        finally:
+            pool.inflight -= 1
+
+    def _dispatch(self, key, lease: Lease) -> None:
+        waiters = self.waiters.get(key, [])
+        while waiters:
+            fut = waiters.pop(0)
+            if not fut.done():
+                fut.set_result(lease)
+                return
+        pool = self._pool(key)
+        if len(pool.idle) < self.MAX_IDLE:
+            pool.idle.append(lease)
+        else:
+            asyncio.create_task(self._return_worker(lease, dirty=False))
+
+    async def release(self, lease: Lease, resources, pg_id=None, bundle_index=None, dirty=False):
+        key = self.shape_key(resources, pg_id, bundle_index)
+        pool = self._pool(key)
+        if dirty or lease.conn.closed:
+            await self._return_worker(lease, dirty=True)
+            return
+        # Serve a queued waiter directly and cancel one surplus in-flight
+        # raylet request so the raylet's queue drains.
+        waiters = self.waiters.get(key, [])
+        handed = False
+        while waiters:
+            fut = waiters.pop(0)
+            if not fut.done():
+                fut.set_result(lease)
+                handed = True
+                break
+        if handed:
+            return
+        if len(pool.idle) < self.MAX_IDLE and pool.inflight == 0:
+            pool.idle.append(lease)
+        else:
+            await self._return_worker(lease, dirty=False)
+
+    async def _return_worker(self, lease: Lease, dirty: bool) -> None:
+        try:
+            await lease.raylet_conn.call(
+                "ReturnWorker", {"lease_id": lease.lease_id, "dirty": dirty}
+            )
+        except rpc.RpcError:
+            pass
+
+    async def drain(self):
+        for pool in self.pools.values():
+            for lease in pool.idle:
+                await self._return_worker(lease, dirty=False)
+            pool.idle.clear()
+
+
+class ActorSubmitter:
+    """Direct transport to one actor with per-handle sequencing and
+    restart-aware redirection."""
+
+    def __init__(self, core: "CoreWorker", actor_id: str):
+        self.core = core
+        self.actor_id = actor_id
+        self.seq = 0
+        self.conn: Optional[rpc.Connection] = None
+        self.state = "PENDING"
+        self.addr = None
+        self.incarnation = 0
+        self._lock = asyncio.Lock()
+
+    async def _resolve(self, timeout: float = 300.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            reply = await self.core.gcs.call("GetActor", {"actor_id": self.actor_id})
+            info = reply["actor"]
+            if info is None:
+                raise ActorDiedError(f"actor {self.actor_id[:8]} unknown to GCS")
+            self.state = info["state"]
+            if info["state"] == "ALIVE":
+                # A restarted incarnation starts its sequence log fresh.
+                if info["num_restarts"] != self.incarnation:
+                    self.incarnation = info["num_restarts"]
+                    self.seq = 0
+                self.addr = tuple(info["addr"])
+                self.conn = await self.core.connect_to(self.addr)
+                return
+            if info["state"] == "DEAD":
+                raise ActorDiedError(
+                    f"actor {self.actor_id[:8]} is dead: {info.get('death_cause')}"
+                )
+            await asyncio.sleep(0.1)
+        raise ActorDiedError(f"timed out waiting for actor {self.actor_id[:8]} to start")
+
+    async def submit(self, spec: TaskSpec) -> dict:
+        async with self._lock:
+            if self.conn is None or self.conn.closed:
+                self.conn = None
+                await self._resolve()
+            conn = self.conn
+            spec.seq_no = self.seq
+            self.seq += 1
+        try:
+            return await conn.call("PushActorTask", {"spec": spec.to_wire()})
+        except rpc.ConnectionLost:
+            # Actor worker died mid-call. In-flight tasks fail (reference
+            # semantics: no silent at-least-once resend); the next submit
+            # re-resolves and lands on the restarted incarnation if any.
+            self.conn = None
+            from ray_tpu._private.common import ActorUnavailableError
+
+            raise ActorUnavailableError(
+                f"actor {self.actor_id[:8]} died while task {spec.name!r} was in flight"
+            )
+
+
+def function_id_of(pickled: bytes) -> str:
+    return hashlib.blake2b(pickled, digest_size=16).hexdigest()
+
+
+class CoreWorker:
+    """One per process. Owns the event-loop-side runtime state."""
+
+    def __init__(
+        self,
+        *,
+        job_id: str,
+        session_name: str,
+        node_id: str,
+        gcs_conn: rpc.Connection,
+        raylet_conn: rpc.Connection,
+        is_driver: bool,
+        worker_id: str,
+        server: rpc.Server,
+    ):
+        self.job_id = job_id
+        self.session_name = session_name
+        self.node_id = node_id
+        self.gcs = GcsClient(gcs_conn)
+        self.raylet_conn = raylet_conn
+        self.is_driver = is_driver
+        self.worker_id = worker_id
+        self.server = server  # shared rpc server (object server + task server)
+        self.addr: Optional[Tuple[str, int]] = None  # set after server start
+        self.raylet_addr: Optional[Tuple[str, int]] = None
+
+        self.memory_store = MemoryStore()
+        self.plasma = PlasmaClient(raylet_conn)
+        self.reference_table = ReferenceTable()
+        self.lease_pool = LeasePool(self)
+        self.actor_submitters: Dict[str, ActorSubmitter] = {}
+        self._conns: Dict[Tuple[str, int], rpc.Connection] = {}
+        self._func_ids_exported: set = set()
+        self._task_events: List[dict] = []
+        self._free_queue: List[str] = []
+        self.closed = False
+        self._bg_tasks: List[asyncio.Task] = []
+
+        server.register("GetObject", self._handle_get_object)
+        server.register("WaitObject", self._handle_wait_object)
+        server.register("Ping", self._handle_ping)
+
+    def start_background(self) -> None:
+        self._bg_tasks.append(asyncio.create_task(self._flush_loop()))
+
+    async def _flush_loop(self) -> None:
+        while not self.closed:
+            await asyncio.sleep(1.0)
+            await self._flush_free_queue()
+            await self._flush_task_events()
+
+    async def _flush_free_queue(self) -> None:
+        if not self._free_queue:
+            return
+        oids, self._free_queue = self._free_queue, []
+        to_delete_local = []
+        for oid in oids:
+            entry = self.memory_store.get(oid)
+            self.memory_store.delete(oid)
+            if entry is not None and entry.kind == IN_PLASMA:
+                if entry.plasma_addr == self.raylet_addr:
+                    to_delete_local.append(oid)
+                else:
+                    asyncio.create_task(self._delete_remote(oid, entry.plasma_addr))
+        if to_delete_local:
+            try:
+                await self.plasma.delete(to_delete_local)
+            except rpc.RpcError:
+                pass
+
+    async def _delete_remote(self, oid: str, addr) -> None:
+        try:
+            conn = await self.connect_to(tuple(addr))
+            await conn.call("ObjDelete", {"oids": [oid]})
+        except rpc.RpcError:
+            pass
+
+    async def _flush_task_events(self) -> None:
+        if not self._task_events:
+            return
+        events, self._task_events = self._task_events, []
+        try:
+            await self.gcs.call("AddTaskEvents", {"events": events})
+        except rpc.RpcError:
+            pass
+
+    def record_task_event(self, task_id: str, name: str, state: str, **extra) -> None:
+        self._task_events.append(
+            {
+                "task_id": task_id,
+                "name": name,
+                "state": state,
+                "job_id": self.job_id,
+                "worker_id": self.worker_id,
+                "node_id": self.node_id,
+                "time": time.time(),
+                **extra,
+            }
+        )
+
+    def schedule_free(self, oid: str) -> None:
+        self._free_queue.append(oid)
+
+    async def connect_to(self, addr: Tuple[str, int]) -> rpc.Connection:
+        addr = tuple(addr)
+        conn = self._conns.get(addr)
+        if conn is None or conn.closed:
+            conn = await rpc.connect(*addr, handlers=self.server._handlers)
+            self._conns[addr] = conn
+        return conn
+
+    # ------------------------------------------------------------------ put
+
+    async def put(self, value: Any) -> ObjectRef:
+        oid = ObjectID.from_random().hex()
+        await self.put_with_id(oid, value)
+        ref = ObjectRef(oid, self.addr, self)
+        self.reference_table.mark_owned(oid)
+        return ref
+
+    async def put_with_id(self, oid: str, value: Any) -> None:
+        serialized = serialization.serialize(value)
+        if serialized.total_size <= config.max_direct_call_object_size:
+            self.memory_store.put_inline(oid, serialized.to_bytes())
+        else:
+            await self.plasma.put_serialized(oid, serialized)
+            self.memory_store.put_plasma_marker(oid, self.raylet_addr)
+
+    # ------------------------------------------------------------------ get
+
+    async def get_objects(self, refs: List[ObjectRef], timeout: Optional[float]) -> List[Any]:
+        single = False
+        if isinstance(refs, ObjectRef):
+            refs, single = [refs], True
+        deadline = time.monotonic() + timeout if timeout is not None else None
+        payloads = await asyncio.gather(
+            *(self._resolve_payload(r, deadline) for r in refs)
+        )
+        values = []
+        with serialization.DeserializationContext(
+            ref_deserializer=self._deserialize_ref
+        ):
+            for payload in payloads:
+                value, is_exc = serialization.deserialize(payload)
+                if is_exc:
+                    raise value
+                values.append(value)
+        return values[0] if single else values
+
+    def _deserialize_ref(self, hex_id, owner_addr):
+        return ObjectRef(hex_id, owner_addr, self)
+
+    def _remaining(self, deadline) -> Optional[float]:
+        if deadline is None:
+            return None
+        rem = deadline - time.monotonic()
+        if rem <= 0:
+            raise GetTimeoutError("get timed out")
+        return rem
+
+    async def _resolve_payload(self, ref: ObjectRef, deadline) -> bytes:
+        oid = ref.hex()
+        entry = self.memory_store.get(oid)
+        owned = oid in self.reference_table.entries and self.reference_table.entries[oid].owned
+        if entry is None and owned:
+            entry = await self.memory_store.wait_for(oid, self._remaining(deadline))
+            if entry is None:
+                raise GetTimeoutError(f"timed out waiting for {oid[:12]}")
+        if entry is not None:
+            if entry.kind == INLINE:
+                return entry.payload
+            return await self._fetch_plasma(oid, entry.plasma_addr, deadline)
+        # Borrowed ref: try local plasma first (common when the primary copy
+        # is on our node), else ask the owner.
+        found, _ = await self.plasma.get([oid], block=False)
+        if oid in found:
+            return found[oid]
+        return await self._fetch_from_owner(ref, deadline)
+
+    async def _fetch_plasma(self, oid: str, plasma_addr, deadline) -> memoryview:
+        if tuple(plasma_addr) == self.raylet_addr:
+            found, missing = await self.plasma.get([oid], timeout=self._remaining(deadline))
+            if oid in found:
+                return found[oid]
+            raise ObjectLostError(f"object {oid[:12]} lost from local store")
+        return await self.plasma.pull(oid, tuple(plasma_addr))
+
+    async def _fetch_from_owner(self, ref: ObjectRef, deadline) -> bytes:
+        if ref.owner_addr is None:
+            raise ObjectLostError(f"no owner known for {ref.hex()[:12]}")
+        if tuple(ref.owner_addr) == self.addr:
+            # We are the owner but have no entry: freed or never created.
+            raise ObjectLostError(f"object {ref.hex()[:12]} no longer exists on owner")
+        conn = await self.connect_to(ref.owner_addr)
+        reply = await conn.call(
+            "GetObject",
+            {"oid": ref.hex(), "timeout": self._remaining(deadline)},
+            timeout=None,
+        )
+        status = reply.get("status")
+        if status == "inline":
+            return reply["payload"]
+        if status == "plasma":
+            return await self._fetch_plasma(ref.hex(), tuple(reply["addr"]), deadline)
+        if status == "timeout":
+            raise GetTimeoutError(f"owner timed out resolving {ref.hex()[:12]}")
+        raise ObjectLostError(f"owner reports {ref.hex()[:12]}: {status}")
+
+    # -- owner-side object server -------------------------------------------
+
+    async def _handle_get_object(self, conn, p):
+        entry = await self.memory_store.wait_for(p["oid"], p.get("timeout", 300))
+        if entry is None:
+            known = p["oid"] in self.reference_table.entries
+            return {"status": "timeout" if known else "unknown"}
+        if entry.kind == INLINE:
+            return {"status": "inline", "payload": entry.payload}
+        return {"status": "plasma", "addr": list(entry.plasma_addr)}
+
+    async def _handle_wait_object(self, conn, p):
+        entry = await self.memory_store.wait_for(p["oid"], p.get("timeout"))
+        return {"ready": entry is not None}
+
+    async def _handle_ping(self, conn, p):
+        return {"pong": True, "worker_id": self.worker_id}
+
+    # ------------------------------------------------------------- wait
+
+    async def wait(
+        self, refs: List[ObjectRef], num_returns: int, timeout: Optional[float]
+    ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        ready_flags: Dict[int, bool] = {}
+
+        async def probe(i, ref):
+            try:
+                await self._wait_available(ref, None)
+                ready_flags[i] = True
+            except asyncio.CancelledError:
+                pass
+
+        tasks = [asyncio.create_task(probe(i, r)) for i, r in enumerate(refs)]
+        deadline = time.monotonic() + timeout if timeout is not None else None
+        try:
+            while len(ready_flags) < num_returns:
+                pending = [t for t in tasks if not t.done()]
+                if not pending:
+                    break
+                rem = None if deadline is None else max(0.0, deadline - time.monotonic())
+                done, _ = await asyncio.wait(
+                    pending, timeout=rem, return_when=asyncio.FIRST_COMPLETED
+                )
+                if not done:
+                    break  # timeout
+        finally:
+            for t in tasks:
+                t.cancel()
+        ready = [r for i, r in enumerate(refs) if ready_flags.get(i)]
+        not_ready = [r for i, r in enumerate(refs) if not ready_flags.get(i)]
+        return ready, not_ready
+
+    async def _wait_available(self, ref: ObjectRef, timeout) -> None:
+        oid = ref.hex()
+        entry = self.memory_store.get(oid)
+        if entry is not None:
+            return
+        owned = oid in self.reference_table.entries and self.reference_table.entries[oid].owned
+        if owned:
+            entry = await self.memory_store.wait_for(oid, timeout)
+            if entry is None:
+                raise GetTimeoutError(oid)
+            return
+        contains = await self.plasma.contains([oid])
+        if contains.get(oid):
+            return
+        if ref.owner_addr is None or tuple(ref.owner_addr) == self.addr:
+            entry = await self.memory_store.wait_for(oid, timeout)
+            if entry is None:
+                raise GetTimeoutError(oid)
+            return
+        conn = await self.connect_to(ref.owner_addr)
+        await conn.call("WaitObject", {"oid": oid, "timeout": timeout}, timeout=None)
+
+    # ----------------------------------------------------- function export
+
+    async def export_function(self, pickled_fn: bytes) -> str:
+        func_id = function_id_of(pickled_fn)
+        if func_id not in self._func_ids_exported:
+            await self.gcs.kv_put(func_id, pickled_fn, ns="fn", overwrite=False)
+            self._func_ids_exported.add(func_id)
+        return func_id
+
+    # ------------------------------------------------------- task submission
+
+    def _prepare_args(self, args: tuple, kwargs: dict):
+        """Serialize the call arguments; returns (blob_info, deps).
+
+        Top-level ObjectRef args are replaced by positional markers resolved
+        by the executor to values (reference semantics); nested refs pass
+        through as refs. A large blob moves via the shm store.
+        """
+        ref_positions = []
+        plain_args = list(args)
+        for i, a in enumerate(plain_args):
+            if isinstance(a, ObjectRef):
+                ref_positions.append(i)
+        kw_ref_keys = [k for k, v in kwargs.items() if isinstance(v, ObjectRef)]
+        serialized = serialization.serialize((plain_args, kwargs))
+        deps = []
+        for r in serialized.contained_refs:
+            deps.append((r.hex(), list(r.owner_addr) if r.owner_addr else None))
+        return serialized, ref_positions, kw_ref_keys, deps
+
+    async def submit_task(
+        self,
+        pickled_fn: bytes,
+        fn_name: str,
+        args: tuple,
+        kwargs: dict,
+        *,
+        num_returns: int = 1,
+        resources: Optional[Dict[str, float]] = None,
+        max_retries: Optional[int] = None,
+        retry_exceptions: bool = False,
+        pg_id: Optional[str] = None,
+        bundle_index: int = -1,
+        scheduling_strategy: Optional[dict] = None,
+        runtime_env: Optional[dict] = None,
+    ) -> List[ObjectRef]:
+        func_id = await self.export_function(pickled_fn)
+        task_id = TaskID.from_random().hex()
+        return_ids = [
+            deterministic_object_id(TaskID.from_hex(task_id), i).hex()
+            for i in range(num_returns)
+        ]
+        serialized, ref_pos, kw_refs, deps = self._prepare_args(args, kwargs)
+        args_blob, args_object = None, None
+        if serialized.total_size <= config.max_direct_call_object_size:
+            args_blob = serialized.to_bytes()
+        else:
+            args_object = ObjectID.from_random().hex()
+            await self.plasma.put_serialized(args_object, serialized)
+            self.memory_store.put_plasma_marker(args_object, self.raylet_addr)
+            self.reference_table.mark_owned(args_object)
+            self.reference_table.add_local(args_object)
+
+        res = ResourceSet(resources if resources is not None else {"CPU": 1.0})
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id,
+            name=fn_name,
+            func_id=func_id,
+            args_blob=args_blob,
+            args_object=args_object,
+            ref_positions=ref_pos,
+            kw_ref_keys=kw_refs,
+            dependencies=deps,
+            num_returns=num_returns,
+            return_ids=return_ids,
+            resources=res.to_units(),
+            max_retries=(
+                max_retries if max_retries is not None else config.default_max_task_retries
+            ),
+            retry_exceptions=retry_exceptions,
+            owner_addr=list(self.addr),
+            pg_id=pg_id,
+            bundle_index=bundle_index,
+            scheduling_strategy=scheduling_strategy,
+            runtime_env=runtime_env,
+        )
+        wire = spec.to_wire()
+
+        refs = []
+        for oid in return_ids:
+            self.reference_table.mark_owned(oid)
+            refs.append(ObjectRef(oid, self.addr, self))
+        for dep_oid, _ in deps:
+            self.reference_table.add_submitted(dep_oid)
+        self.record_task_event(task_id, fn_name, "PENDING")
+        asyncio.create_task(self._run_task(wire, spec))
+        return refs
+
+    async def _run_task(self, wire: dict, spec: TaskSpec) -> None:
+        try:
+            await self._wait_for_deps(spec.dependencies)
+            attempts = spec.max_retries + 1
+            last_err: Optional[Exception] = None
+            for attempt in range(attempts):
+                try:
+                    reply = await self._lease_and_push(wire, spec)
+                    self._store_task_results(spec, reply)
+                    self.record_task_event(spec.task_id, spec.name, "FINISHED")
+                    return
+                except (rpc.ConnectionLost, WorkerCrashedError) as e:
+                    last_err = e
+                    self.record_task_event(
+                        spec.task_id, spec.name, "RETRY", attempt=attempt
+                    )
+                    logger.warning(
+                        "task %s attempt %d failed (%s); retrying",
+                        spec.name,
+                        attempt,
+                        e,
+                    )
+                    await asyncio.sleep(min(1.0, 0.1 * (attempt + 1)))
+            self._store_task_error(
+                spec, WorkerCrashedError(f"task {spec.name} failed after retries: {last_err}")
+            )
+        except Exception as e:
+            logger.exception("task %s submission failed", spec.name)
+            self._store_task_error(spec, e)
+        finally:
+            for dep_oid, _ in spec.dependencies:
+                self.reference_table.remove_submitted(dep_oid, self)
+
+    async def _wait_for_deps(self, deps) -> None:
+        waits = []
+        for oid, owner in deps:
+            ref = ObjectRef(oid, tuple(owner) if owner else None, self)
+            waits.append(self._wait_available(ref, 300))
+        if waits:
+            await asyncio.gather(*waits)
+
+    async def _lease_and_push(self, wire: dict, spec: TaskSpec) -> dict:
+        lease = await self.lease_pool.acquire(
+            spec.resources, spec.pg_id, spec.bundle_index
+        )
+        dirty = False
+        try:
+            self.record_task_event(spec.task_id, spec.name, "RUNNING")
+            return await lease.conn.call("PushTask", {"spec": wire}, timeout=None)
+        except rpc.ConnectionLost:
+            dirty = True
+            raise
+        finally:
+            await self.lease_pool.release(
+                lease, spec.resources, spec.pg_id, spec.bundle_index, dirty=dirty
+            )
+
+    def _store_task_results(self, spec: TaskSpec, reply: dict) -> None:
+        if reply.get("error") is not None:
+            payload = reply["error"]
+            for oid in spec.return_ids:
+                self.memory_store.put_inline(oid, payload)
+            self.record_task_event(spec.task_id, spec.name, "FAILED")
+            return
+        returns = reply["returns"]
+        for oid, ret in zip(spec.return_ids, returns):
+            if "inline" in ret:
+                self.memory_store.put_inline(oid, ret["inline"])
+            else:
+                self.memory_store.put_plasma_marker(oid, tuple(ret["plasma"]))
+
+    def _store_task_error(self, spec: TaskSpec, exc: Exception) -> None:
+        serialized = serialization.serialize(exc)
+        payload = serialized.to_bytes()
+        for oid in spec.return_ids:
+            self.memory_store.put_inline(oid, payload)
+        self.record_task_event(spec.task_id, spec.name, "FAILED")
+
+    # ----------------------------------------------------------- actors
+
+    async def create_actor(
+        self,
+        pickled_cls: bytes,
+        cls_name: str,
+        args: tuple,
+        kwargs: dict,
+        *,
+        resources: Optional[Dict[str, float]] = None,
+        max_restarts: int = 0,
+        max_concurrency: int = 1,
+        name: Optional[str] = None,
+        namespace: Optional[str] = None,
+        lifetime: Optional[str] = None,
+        get_if_exists: bool = False,
+        pg_id: Optional[str] = None,
+        bundle_index: int = -1,
+        scheduling_strategy: Optional[dict] = None,
+        runtime_env: Optional[dict] = None,
+    ) -> str:
+        func_id = await self.export_function(pickled_cls)
+        actor_id = ActorID.from_random().hex()
+        task_id = TaskID.from_random().hex()
+        serialized, ref_pos, kw_refs, deps = self._prepare_args(args, kwargs)
+        strategy = dict(scheduling_strategy or {})
+        if lifetime == "detached":
+            strategy["detached"] = True
+        res = ResourceSet(resources if resources is not None else {"CPU": 1.0})
+        args_blob, args_object = None, None
+        if serialized.total_size <= config.max_direct_call_object_size:
+            args_blob = serialized.to_bytes()
+        else:
+            args_object = ObjectID.from_random().hex()
+            await self.plasma.put_serialized(args_object, serialized)
+            self.memory_store.put_plasma_marker(args_object, self.raylet_addr)
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id,
+            name=cls_name,
+            func_id=func_id,
+            args_blob=args_blob,
+            args_object=args_object,
+            ref_positions=ref_pos,
+            kw_ref_keys=kw_refs,
+            dependencies=deps,
+            num_returns=0,
+            return_ids=[],
+            resources=res.to_units(),
+            owner_addr=list(self.addr),
+            actor_id=actor_id,
+            actor_creation=True,
+            max_restarts=max_restarts,
+            max_concurrency=max_concurrency,
+            pg_id=pg_id,
+            bundle_index=bundle_index,
+            scheduling_strategy=strategy,
+            runtime_env=runtime_env,
+            actor_name=name,
+            namespace=namespace,
+        )
+        wire = spec.to_wire()
+        reply = await self.gcs.call(
+            "CreateActor",
+            {"spec": wire, "wait_alive": False, "get_if_exists": get_if_exists},
+            timeout=None,
+        )
+        if reply.get("existing"):
+            return reply["actor"]["actor_id"]
+        return actor_id
+
+    def _submitter(self, actor_id: str) -> ActorSubmitter:
+        sub = self.actor_submitters.get(actor_id)
+        if sub is None:
+            sub = self.actor_submitters[actor_id] = ActorSubmitter(self, actor_id)
+        return sub
+
+    async def submit_actor_task(
+        self,
+        actor_id: str,
+        method_name: str,
+        args: tuple,
+        kwargs: dict,
+        num_returns: int = 1,
+    ) -> List[ObjectRef]:
+        task_id = TaskID.from_random().hex()
+        return_ids = [
+            deterministic_object_id(TaskID.from_hex(task_id), i).hex()
+            for i in range(num_returns)
+        ]
+        serialized, ref_pos, kw_refs, deps = self._prepare_args(args, kwargs)
+        args_blob, args_object = None, None
+        if serialized.total_size <= config.max_direct_call_object_size:
+            args_blob = serialized.to_bytes()
+        else:
+            args_object = ObjectID.from_random().hex()
+            await self.plasma.put_serialized(args_object, serialized)
+            self.memory_store.put_plasma_marker(args_object, self.raylet_addr)
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id,
+            name=method_name,
+            func_id="",
+            args_blob=args_blob,
+            args_object=args_object,
+            ref_positions=ref_pos,
+            kw_ref_keys=kw_refs,
+            dependencies=deps,
+            num_returns=num_returns,
+            return_ids=return_ids,
+            resources={},
+            owner_addr=list(self.addr),
+            actor_id=actor_id,
+            actor_method=method_name,
+            caller_id=self.worker_id,
+        )
+        refs = []
+        for oid in return_ids:
+            self.reference_table.mark_owned(oid)
+            refs.append(ObjectRef(oid, self.addr, self))
+        for dep_oid, _ in deps:
+            self.reference_table.add_submitted(dep_oid)
+        asyncio.create_task(self._run_actor_task(spec))
+        return refs
+
+    async def _run_actor_task(self, spec: TaskSpec) -> None:
+        try:
+            await self._wait_for_deps(spec.dependencies)
+            sub = self._submitter(spec.actor_id)
+            reply = await sub.submit(spec)
+            self._store_task_results(spec, reply)
+        except Exception as e:
+            self._store_task_error(spec, e)
+        finally:
+            for dep_oid, _ in spec.dependencies:
+                self.reference_table.remove_submitted(dep_oid, self)
+
+    async def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
+        await self.gcs.call("KillActor", {"actor_id": actor_id, "no_restart": no_restart})
+
+    # ---------------------------------------------------------- shutdown
+
+    async def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        for t in self._bg_tasks:
+            t.cancel()
+        await self._flush_task_events()
+        await self.lease_pool.drain()
+        self.plasma.close()
+        for conn in self._conns.values():
+            await conn.close()

@@ -1,0 +1,722 @@
+"""GCS: the cluster control service (control plane singleton).
+
+TPU-native analog of the reference's gcs_server (src/ray/gcs/gcs_server/gcs_server.h:219):
+one asyncio process holding cluster state — node membership, actor FSM with
+restarts, placement-group 2PC, internal KV (which doubles as the function
+table), pubsub, and the job table. Persistence is in-memory (the reference's
+default StoreClient since Ray 2.x); a pluggable store interface keeps the
+Redis-equivalent door open.
+
+Health checking follows the reference's connection+liveness model
+(gcs_health_check_manager.cc): raylets hold a persistent RPC connection and
+push periodic resource updates; a dropped connection or missed deadline marks
+the node dead, which drives actor restarts and PG rescheduling.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private import rpc
+from ray_tpu._private.common import PlacementGroupSpec, ResourceSet, config
+
+logger = logging.getLogger(__name__)
+
+# Actor FSM states (reference: gcs_actor_manager.cc).
+DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
+PENDING_CREATION = "PENDING_CREATION"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+
+class NodeInfo:
+    def __init__(self, node_id: str, addr, resources: Dict[str, int], labels, conn):
+        self.node_id = node_id
+        self.addr = tuple(addr)
+        self.total = dict(resources)
+        self.available = dict(resources)
+        self.labels = labels or {}
+        self.conn: rpc.Connection = conn
+        self.state = "ALIVE"
+        self.last_seen = time.monotonic()
+
+    def to_wire(self, include_conn=False) -> dict:
+        return {
+            "node_id": self.node_id,
+            "addr": list(self.addr),
+            "total": self.total,
+            "available": self.available,
+            "labels": self.labels,
+            "state": self.state,
+        }
+
+
+class ActorInfo:
+    def __init__(self, actor_id: str, spec: dict):
+        self.actor_id = actor_id
+        self.spec = spec  # actor-creation TaskSpec wire dict
+        self.state = PENDING_CREATION
+        self.addr: Optional[Tuple[str, int]] = None
+        self.worker_id: Optional[str] = None
+        self.node_id: Optional[str] = None
+        self.num_restarts = 0
+        self.max_restarts = spec.get("max_restarts", 0)
+        self.name = spec.get("actor_name")
+        self.namespace = spec.get("namespace") or "default"
+        self.job_id = spec.get("job_id")
+        self.detached = (spec.get("scheduling_strategy") or {}).get("detached", False)
+        self.death_cause: Optional[str] = None
+        self.pending: List[asyncio.Future] = []
+
+    def to_wire(self) -> dict:
+        return {
+            "actor_id": self.actor_id,
+            "state": self.state,
+            "addr": list(self.addr) if self.addr else None,
+            "worker_id": self.worker_id,
+            "node_id": self.node_id,
+            "num_restarts": self.num_restarts,
+            "max_restarts": self.max_restarts,
+            "name": self.name,
+            "namespace": self.namespace,
+            "job_id": self.job_id,
+            "death_cause": self.death_cause,
+            "class_name": self.spec.get("name"),
+        }
+
+
+class PlacementGroupInfo:
+    def __init__(self, spec: PlacementGroupSpec):
+        self.spec = spec
+        self.state = "PENDING"  # PENDING | CREATED | REMOVED | RESCHEDULING
+        self.bundle_nodes: List[Optional[str]] = [None] * len(spec.bundles)
+        self.pending: List[asyncio.Future] = []
+
+
+class GcsServer:
+    """The control service. Start with `await GcsServer(...).start()`."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, session_name: str = ""):
+        self.server = rpc.Server(host, port)
+        self.session_name = session_name
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.actors: Dict[str, ActorInfo] = {}
+        self.named_actors: Dict[Tuple[str, str], str] = {}  # (ns, name) -> actor_id
+        self.kv: Dict[Tuple[str, str], bytes] = {}
+        self.subscribers: Dict[str, Set[rpc.Connection]] = {}
+        self.jobs: Dict[str, dict] = {}
+        self.placement_groups: Dict[str, PlacementGroupInfo] = {}
+        self.task_events: List[dict] = []  # ring buffer of task state events
+        self._pending_actor_queue: List[str] = []
+        self._wake_scheduler = asyncio.Event()
+        self._scheduler_task: Optional[asyncio.Task] = None
+        self._register_handlers()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def start(self) -> Tuple[str, int]:
+        addr = await self.server.start()
+        self.server.on_disconnect(self._on_disconnect)
+        self._scheduler_task = asyncio.create_task(self._actor_scheduler_loop())
+        logger.info("gcs listening on %s:%s", *addr)
+        return addr
+
+    async def stop(self) -> None:
+        if self._scheduler_task:
+            self._scheduler_task.cancel()
+        await self.server.stop()
+
+    def _register_handlers(self) -> None:
+        s = self.server
+        s.register("RegisterNode", self._register_node)
+        s.register("GetAllNodes", self._get_all_nodes)
+        s.register("UpdateResources", self._update_resources)
+        s.register("CreateActor", self._create_actor)
+        s.register("GetActor", self._get_actor)
+        s.register("GetNamedActor", self._get_named_actor)
+        s.register("ListActors", self._list_actors)
+        s.register("ReportActorReady", self._report_actor_ready)
+        s.register("ReportWorkerDied", self._report_worker_died)
+        s.register("KillActor", self._kill_actor)
+        s.register("KVPut", self._kv_put)
+        s.register("KVGet", self._kv_get)
+        s.register("KVDel", self._kv_del)
+        s.register("KVKeys", self._kv_keys)
+        s.register("KVExists", self._kv_exists)
+        s.register("Subscribe", self._subscribe)
+        s.register("Publish", self._publish)
+        s.register("RegisterJob", self._register_job)
+        s.register("JobFinished", self._job_finished)
+        s.register("ListJobs", self._list_jobs)
+        s.register("CreatePlacementGroup", self._create_pg)
+        s.register("RemovePlacementGroup", self._remove_pg)
+        s.register("GetPlacementGroup", self._get_pg)
+        s.register("ListPlacementGroups", self._list_pgs)
+        s.register("AddTaskEvents", self._add_task_events)
+        s.register("ListTaskEvents", self._list_task_events)
+        s.register("GetClusterStatus", self._cluster_status)
+        s.register("Ping", self._ping)
+
+    # -- nodes --------------------------------------------------------------
+
+    async def _register_node(self, conn, p):
+        info = NodeInfo(p["node_id"], p["addr"], p["resources"], p.get("labels"), conn)
+        self.nodes[p["node_id"]] = info
+        conn.context["node_id"] = p["node_id"]
+        await self._publish_msg("nodes", {"event": "added", "node": info.to_wire()})
+        self._wake_scheduler.set()
+        return {"ok": True, "session_name": self.session_name}
+
+    async def _get_all_nodes(self, conn, p):
+        return {"nodes": [n.to_wire() for n in self.nodes.values()]}
+
+    async def _update_resources(self, conn, p):
+        node = self.nodes.get(p["node_id"])
+        if node is not None:
+            node.available = p["available"]
+            node.last_seen = time.monotonic()
+            if p.get("total"):
+                node.total = p["total"]
+            self._wake_scheduler.set()
+        return {"ok": True}
+
+    def _on_disconnect(self, conn: rpc.Connection) -> None:
+        node_id = conn.context.get("node_id")
+        if node_id and node_id in self.nodes:
+            try:
+                asyncio.get_running_loop()
+                asyncio.create_task(self._handle_node_death(node_id))
+            except RuntimeError:
+                pass  # loop already stopped (interpreter shutdown)
+        for subs in self.subscribers.values():
+            subs.discard(conn)
+
+    async def _handle_node_death(self, node_id: str) -> None:
+        node = self.nodes.get(node_id)
+        if node is None or node.state == "DEAD":
+            return
+        node.state = "DEAD"
+        logger.warning("node %s died", node_id[:8])
+        await self._publish_msg("nodes", {"event": "removed", "node": node.to_wire()})
+        # Fail/restart actors that lived there.
+        for actor in list(self.actors.values()):
+            if actor.node_id == node_id and actor.state in (ALIVE, PENDING_CREATION, RESTARTING):
+                await self._on_actor_worker_death(actor, f"node {node_id[:8]} died")
+        # PGs with bundles there go back to pending.
+        for pg in self.placement_groups.values():
+            if pg.state == "CREATED" and node_id in pg.bundle_nodes:
+                pg.state = "RESCHEDULING"
+                asyncio.create_task(self._schedule_pg(pg))
+
+    # -- actor FSM ----------------------------------------------------------
+
+    async def _create_actor(self, conn, p):
+        spec = p["spec"]
+        actor_id = spec["actor_id"]
+        actor = ActorInfo(actor_id, spec)
+        if actor.name:
+            key = (actor.namespace, actor.name)
+            if key in self.named_actors:
+                existing_id = self.named_actors[key]
+                existing = self.actors.get(existing_id)
+                if existing is not None and existing.state != DEAD:
+                    if p.get("get_if_exists"):
+                        return {"existing": True, "actor": existing.to_wire()}
+                    raise rpc.RpcError(f"actor name {actor.name!r} already taken")
+            self.named_actors[key] = actor_id
+        self.actors[actor_id] = actor
+        self._pending_actor_queue.append(actor_id)
+        self._wake_scheduler.set()
+        if p.get("wait_alive", True):
+            fut = asyncio.get_running_loop().create_future()
+            actor.pending.append(fut)
+            return await fut
+        return {"actor": actor.to_wire()}
+
+    async def _actor_scheduler_loop(self) -> None:
+        """Places pending actors on nodes as resources allow (analog of
+        GcsActorScheduler). Runs whenever resources or the queue change."""
+        while True:
+            await self._wake_scheduler.wait()
+            self._wake_scheduler.clear()
+            remaining: List[str] = []
+            for actor_id in self._pending_actor_queue:
+                actor = self.actors.get(actor_id)
+                if actor is None or actor.state not in (PENDING_CREATION, RESTARTING):
+                    continue
+                placed = await self._try_place_actor(actor)
+                if not placed:
+                    remaining.append(actor_id)
+            self._pending_actor_queue = remaining
+            if remaining:
+                await asyncio.sleep(0.2)
+                self._wake_scheduler.set()
+
+    async def _try_place_actor(self, actor: ActorInfo) -> bool:
+        demand = ResourceSet.from_units(actor.spec.get("resources") or {})
+        strategy = actor.spec.get("scheduling_strategy") or {}
+        candidates = [n for n in self.nodes.values() if n.state == "ALIVE"]
+        if strategy.get("node_id"):
+            candidates = [n for n in candidates if n.node_id == strategy["node_id"]]
+        if actor.spec.get("pg_id"):
+            pg = self.placement_groups.get(actor.spec["pg_id"])
+            if pg is None or pg.state != "CREATED":
+                return False
+            idx = actor.spec.get("bundle_index", -1)
+            nodes_ok = set(
+                pg.bundle_nodes if idx < 0 else [pg.bundle_nodes[idx]]
+            )
+            candidates = [n for n in candidates if n.node_id in nodes_ok]
+        feasible = [
+            n
+            for n in candidates
+            if demand.is_subset_of(ResourceSet.from_units(n.total))
+        ]
+        if not feasible:
+            if not candidates and strategy.get("node_id"):
+                await self._fail_actor(actor, "node affinity target not found")
+                return True
+            return False
+        available = [
+            n
+            for n in feasible
+            if demand.is_subset_of(ResourceSet.from_units(n.available))
+        ]
+        if not available:
+            return False
+        # Pack: most-utilized feasible node first (reference hybrid policy).
+        node = max(available, key=lambda n: _utilization(n))
+        try:
+            reply = await node.conn.call(
+                "LeaseWorkerForActor", {"spec": actor.spec}, timeout=120
+            )
+        except rpc.RpcError as e:
+            logger.warning("actor lease on %s failed: %s", node.node_id[:8], e)
+            return False
+        if not reply.get("granted"):
+            return False
+        actor.node_id = node.node_id
+        actor.worker_id = reply["worker_id"]
+        return True
+
+    async def _report_actor_ready(self, conn, p):
+        actor = self.actors.get(p["actor_id"])
+        if actor is None:
+            return {"ok": False}
+        if p.get("error"):
+            await self._fail_actor(actor, p["error"], creation_failed=True)
+            return {"ok": True}
+        actor.state = ALIVE
+        actor.addr = tuple(p["addr"])
+        actor.worker_id = p["worker_id"]
+        actor.node_id = p["node_id"]
+        result = {"actor": actor.to_wire()}
+        for fut in actor.pending:
+            if not fut.done():
+                fut.set_result(result)
+        actor.pending.clear()
+        await self._publish_msg(f"actor:{actor.actor_id}", actor.to_wire())
+        return {"ok": True}
+
+    async def _on_actor_worker_death(self, actor: ActorInfo, cause: str) -> None:
+        if actor.state == DEAD:
+            return
+        if actor.max_restarts == -1 or actor.num_restarts < actor.max_restarts:
+            actor.num_restarts += 1
+            actor.state = RESTARTING
+            actor.addr = None
+            logger.info(
+                "restarting actor %s (%d/%s): %s",
+                actor.actor_id[:8],
+                actor.num_restarts,
+                actor.max_restarts,
+                cause,
+            )
+            await self._publish_msg(f"actor:{actor.actor_id}", actor.to_wire())
+            self._pending_actor_queue.append(actor.actor_id)
+            self._wake_scheduler.set()
+        else:
+            await self._fail_actor(actor, cause)
+
+    async def _fail_actor(self, actor: ActorInfo, cause: str, creation_failed=False) -> None:
+        actor.state = DEAD
+        actor.death_cause = cause
+        for fut in actor.pending:
+            if not fut.done():
+                if creation_failed:
+                    fut.set_exception(rpc.RpcError(f"actor creation failed: {cause}"))
+                else:
+                    fut.set_result({"actor": actor.to_wire()})
+        actor.pending.clear()
+        if actor.name and self.named_actors.get((actor.namespace, actor.name)) == actor.actor_id:
+            del self.named_actors[(actor.namespace, actor.name)]
+        await self._publish_msg(f"actor:{actor.actor_id}", actor.to_wire())
+
+    async def _report_worker_died(self, conn, p):
+        """Raylet reports a worker process exit (reference:
+        WorkerInfoGcsService.ReportWorkerFailure)."""
+        for actor_id in p.get("actor_ids", []):
+            actor = self.actors.get(actor_id)
+            if actor is not None and actor.state in (ALIVE, PENDING_CREATION):
+                await self._on_actor_worker_death(
+                    actor, p.get("cause") or "worker process died"
+                )
+        return {"ok": True}
+
+    async def _get_actor(self, conn, p):
+        actor = self.actors.get(p["actor_id"])
+        if actor is None:
+            return {"actor": None}
+        return {"actor": actor.to_wire()}
+
+    async def _get_named_actor(self, conn, p):
+        actor_id = self.named_actors.get((p.get("namespace") or "default", p["name"]))
+        if actor_id is None:
+            return {"actor": None}
+        return {"actor": self.actors[actor_id].to_wire()}
+
+    async def _list_actors(self, conn, p):
+        return {"actors": [a.to_wire() for a in self.actors.values()]}
+
+    async def _kill_actor(self, conn, p):
+        actor = self.actors.get(p["actor_id"])
+        if actor is None:
+            return {"ok": False}
+        no_restart = p.get("no_restart", True)
+        if no_restart:
+            actor.max_restarts = actor.num_restarts  # exhaust restarts
+        node = self.nodes.get(actor.node_id) if actor.node_id else None
+        if node is not None and node.state == "ALIVE" and actor.worker_id:
+            try:
+                await node.conn.call(
+                    "KillWorker", {"worker_id": actor.worker_id, "force": True}, timeout=10
+                )
+            except rpc.RpcError:
+                pass
+        if no_restart and actor.state != DEAD:
+            await self._fail_actor(actor, "killed via ray.kill")
+        return {"ok": True}
+
+    # -- kv -----------------------------------------------------------------
+
+    async def _kv_put(self, conn, p):
+        key = (p.get("ns") or "", p["key"])
+        if not p.get("overwrite", True) and key in self.kv:
+            return {"added": False}
+        self.kv[key] = p["value"]
+        return {"added": True}
+
+    async def _kv_get(self, conn, p):
+        return {"value": self.kv.get((p.get("ns") or "", p["key"]))}
+
+    async def _kv_del(self, conn, p):
+        ns = p.get("ns") or ""
+        if p.get("prefix"):
+            keys = [k for k in self.kv if k[0] == ns and k[1].startswith(p["key"])]
+            for k in keys:
+                del self.kv[k]
+            return {"deleted": len(keys)}
+        return {"deleted": int(self.kv.pop((ns, p["key"]), None) is not None)}
+
+    async def _kv_keys(self, conn, p):
+        ns = p.get("ns") or ""
+        prefix = p.get("prefix") or ""
+        return {"keys": [k[1] for k in self.kv if k[0] == ns and k[1].startswith(prefix)]}
+
+    async def _kv_exists(self, conn, p):
+        return {"exists": (p.get("ns") or "", p["key"]) in self.kv}
+
+    # -- pubsub -------------------------------------------------------------
+
+    async def _subscribe(self, conn, p):
+        self.subscribers.setdefault(p["channel"], set()).add(conn)
+        return {"ok": True}
+
+    async def _publish(self, conn, p):
+        await self._publish_msg(p["channel"], p["msg"])
+        return {"ok": True}
+
+    async def _publish_msg(self, channel: str, msg: Any) -> None:
+        for sub in list(self.subscribers.get(channel, ())):
+            try:
+                await sub.push("Pub", {"channel": channel, "msg": msg})
+            except rpc.RpcError:
+                self.subscribers[channel].discard(sub)
+
+    # -- jobs ---------------------------------------------------------------
+
+    async def _register_job(self, conn, p):
+        self.jobs[p["job_id"]] = {
+            "job_id": p["job_id"],
+            "driver_addr": p.get("driver_addr"),
+            "start_time": time.time(),
+            "state": "RUNNING",
+            "entrypoint": p.get("entrypoint", ""),
+        }
+        return {"ok": True}
+
+    async def _job_finished(self, conn, p):
+        job = self.jobs.get(p["job_id"])
+        if job:
+            job["state"] = "FINISHED"
+            job["end_time"] = time.time()
+        # Kill non-detached actors owned by the job.
+        for actor in list(self.actors.values()):
+            if actor.job_id == p["job_id"] and not actor.detached and actor.state != DEAD:
+                await self._kill_actor(conn, {"actor_id": actor.actor_id, "no_restart": True})
+        return {"ok": True}
+
+    async def _list_jobs(self, conn, p):
+        return {"jobs": list(self.jobs.values())}
+
+    # -- placement groups (2PC driver; reference gcs_placement_group_scheduler.cc)
+
+    async def _create_pg(self, conn, p):
+        spec = PlacementGroupSpec.from_wire(p["spec"])
+        pg = PlacementGroupInfo(spec)
+        self.placement_groups[spec.pg_id] = pg
+        asyncio.create_task(self._schedule_pg(pg))
+        if p.get("wait_ready"):
+            fut = asyncio.get_running_loop().create_future()
+            pg.pending.append(fut)
+            return await fut
+        return {"pg_id": spec.pg_id, "state": pg.state}
+
+    async def _schedule_pg(self, pg: PlacementGroupInfo) -> None:
+        spec = pg.spec
+        deadline = time.monotonic() + 120
+        while pg.state in ("PENDING", "RESCHEDULING"):
+            placement = self._place_bundles(spec)
+            if placement is not None:
+                ok = await self._try_commit_pg(pg, placement)
+                if ok:
+                    pg.state = "CREATED"
+                    pg.bundle_nodes = placement
+                    for fut in pg.pending:
+                        if not fut.done():
+                            fut.set_result({"pg_id": spec.pg_id, "state": "CREATED"})
+                    pg.pending.clear()
+                    await self._publish_msg(f"pg:{spec.pg_id}", {"state": "CREATED"})
+                    self._wake_scheduler.set()
+                    return
+            if time.monotonic() > deadline:
+                break
+            await asyncio.sleep(0.2)
+        if pg.state in ("PENDING", "RESCHEDULING"):
+            for fut in pg.pending:
+                if not fut.done():
+                    fut.set_exception(
+                        rpc.RpcError(f"placement group {spec.pg_id[:8]} infeasible")
+                    )
+            pg.pending.clear()
+
+    def _place_bundles(self, spec: PlacementGroupSpec) -> Optional[List[str]]:
+        """Map bundles to nodes per strategy against the current resource view.
+        Reference: bundle_scheduling_policy.cc (PACK/SPREAD/STRICT_*)."""
+        alive = [n for n in self.nodes.values() if n.state == "ALIVE"]
+        if not alive:
+            return None
+        avail = {n.node_id: ResourceSet.from_units(n.available) for n in alive}
+        demands = [ResourceSet.from_units(b) for b in spec.bundles]
+        placement: List[Optional[str]] = [None] * len(demands)
+
+        def fits(nid, demand):
+            return demand.is_subset_of(avail[nid])
+
+        order = sorted(avail, key=lambda nid: -_utilization(self.nodes[nid]))
+        if spec.strategy == "STRICT_PACK":
+            for nid in order:
+                total = ResourceSet()
+                for d in demands:
+                    total = total + d
+                if total.is_subset_of(avail[nid]):
+                    return [nid] * len(demands)
+            return None
+        if spec.strategy == "STRICT_SPREAD":
+            if len(alive) < len(demands):
+                return None
+            used: Set[str] = set()
+            for i, d in enumerate(demands):
+                pick = next(
+                    (nid for nid in order if nid not in used and fits(nid, d)), None
+                )
+                if pick is None:
+                    return None
+                placement[i] = pick
+                used.add(pick)
+                avail[pick] = avail[pick] - d
+            return placement  # type: ignore[return-value]
+        # PACK: prefer filling utilized nodes; SPREAD: prefer emptiest first.
+        if spec.strategy == "SPREAD":
+            order = list(reversed(order))
+        for i, d in enumerate(demands):
+            pick = next((nid for nid in order if fits(nid, d)), None)
+            if pick is None:
+                return None
+            placement[i] = pick
+            avail[pick] = avail[pick] - d
+            if spec.strategy == "SPREAD":
+                order.remove(pick)
+                order.append(pick)  # round-robin
+        return placement  # type: ignore[return-value]
+
+    async def _try_commit_pg(self, pg: PlacementGroupInfo, placement: List[str]) -> bool:
+        """Two-phase commit of bundle reservations across raylets."""
+        spec = pg.spec
+        by_node: Dict[str, List[int]] = {}
+        for idx, nid in enumerate(placement):
+            by_node.setdefault(nid, []).append(idx)
+        prepared: List[str] = []
+        for nid, idxs in by_node.items():
+            node = self.nodes.get(nid)
+            if node is None or node.state != "ALIVE":
+                break
+            try:
+                reply = await node.conn.call(
+                    "PreparePGBundles",
+                    {
+                        "pg_id": spec.pg_id,
+                        "bundles": {str(i): spec.bundles[i] for i in idxs},
+                    },
+                    timeout=30,
+                )
+            except rpc.RpcError:
+                break
+            if not reply.get("success"):
+                break
+            prepared.append(nid)
+        else:
+            for nid in prepared:
+                await self.nodes[nid].conn.call(
+                    "CommitPGBundles", {"pg_id": spec.pg_id}, timeout=30
+                )
+            return True
+        for nid in prepared:  # rollback
+            try:
+                await self.nodes[nid].conn.call(
+                    "ReleasePGBundles", {"pg_id": spec.pg_id}, timeout=30
+                )
+            except rpc.RpcError:
+                pass
+        return False
+
+    async def _remove_pg(self, conn, p):
+        pg = self.placement_groups.get(p["pg_id"])
+        if pg is None:
+            return {"ok": False}
+        pg.state = "REMOVED"
+        for nid in set(n for n in pg.bundle_nodes if n):
+            node = self.nodes.get(nid)
+            if node and node.state == "ALIVE":
+                try:
+                    await node.conn.call("ReleasePGBundles", {"pg_id": p["pg_id"]}, timeout=30)
+                except rpc.RpcError:
+                    pass
+        return {"ok": True}
+
+    async def _get_pg(self, conn, p):
+        pg = self.placement_groups.get(p["pg_id"])
+        if pg is None:
+            return {"pg": None}
+        return {
+            "pg": {
+                "pg_id": pg.spec.pg_id,
+                "state": pg.state,
+                "strategy": pg.spec.strategy,
+                "bundles": pg.spec.bundles,
+                "bundle_nodes": pg.bundle_nodes,
+                "name": pg.spec.name,
+            }
+        }
+
+    async def _list_pgs(self, conn, p):
+        return {
+            "pgs": [
+                (await self._get_pg(conn, {"pg_id": pid}))["pg"]
+                for pid in self.placement_groups
+            ]
+        }
+
+    # -- task events / status ----------------------------------------------
+
+    async def _add_task_events(self, conn, p):
+        self.task_events.extend(p["events"])
+        if len(self.task_events) > 100000:
+            self.task_events = self.task_events[-50000:]
+        return {"ok": True}
+
+    async def _list_task_events(self, conn, p):
+        events = self.task_events
+        if p.get("job_id"):
+            events = [e for e in events if e.get("job_id") == p["job_id"]]
+        return {"events": events[-(p.get("limit") or 1000):]}
+
+    async def _cluster_status(self, conn, p):
+        return {
+            "nodes": [n.to_wire() for n in self.nodes.values()],
+            "actors": sum(1 for a in self.actors.values() if a.state == ALIVE),
+            "placement_groups": sum(
+                1 for g in self.placement_groups.values() if g.state == "CREATED"
+            ),
+            "jobs": list(self.jobs.values()),
+        }
+
+    async def _ping(self, conn, p):
+        return {"pong": True, "time": time.time()}
+
+
+def _utilization(node: NodeInfo) -> float:
+    util = 0.0
+    for k, total in node.total.items():
+        if total > 0:
+            util = max(util, 1.0 - node.available.get(k, 0) / total)
+    return util
+
+
+class GcsClient:
+    """Typed async client for the GCS (used by raylets, workers, drivers)."""
+
+    def __init__(self, conn: rpc.Connection):
+        self.conn = conn
+        self._sub_handlers: Dict[str, List] = {}
+        conn._handlers.setdefault("Pub", self._on_pub)
+
+    async def _on_pub(self, conn, p):
+        for fn in self._sub_handlers.get(p["channel"], []):
+            try:
+                res = fn(p["msg"])
+                if asyncio.iscoroutine(res):
+                    await res
+            except Exception:
+                logger.exception("pubsub handler failed for %s", p["channel"])
+
+    async def subscribe(self, channel: str, handler) -> None:
+        self._sub_handlers.setdefault(channel, []).append(handler)
+        await self.conn.call("Subscribe", {"channel": channel})
+
+    async def publish(self, channel: str, msg) -> None:
+        await self.conn.call("Publish", {"channel": channel, "msg": msg})
+
+    async def kv_put(self, key: str, value: bytes, ns: str = "", overwrite=True) -> bool:
+        r = await self.conn.call(
+            "KVPut", {"ns": ns, "key": key, "value": value, "overwrite": overwrite}
+        )
+        return r["added"]
+
+    async def kv_get(self, key: str, ns: str = "") -> Optional[bytes]:
+        return (await self.conn.call("KVGet", {"ns": ns, "key": key}))["value"]
+
+    async def kv_del(self, key: str, ns: str = "", prefix=False) -> int:
+        return (await self.conn.call("KVDel", {"ns": ns, "key": key, "prefix": prefix}))[
+            "deleted"
+        ]
+
+    async def kv_keys(self, prefix: str = "", ns: str = "") -> List[str]:
+        return (await self.conn.call("KVKeys", {"ns": ns, "prefix": prefix}))["keys"]
+
+    def call(self, method: str, payload=None, timeout=None):
+        return self.conn.call(method, payload, timeout)

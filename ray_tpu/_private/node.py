@@ -1,0 +1,83 @@
+"""Node bring-up: owns the GCS + raylet for a head node.
+
+Analog of the reference's python/ray/_private/node.py (start_ray_processes). Two
+modes:
+- in-loop (default): GCS and raylet run as asyncio servers on the driver's
+  background event loop — same wire protocol as separate processes (workers
+  still connect over TCP), minus process-spawn latency. This is also how
+  cluster_utils boots extra "nodes" for multi-node tests.
+- subprocess: daemons run as their own processes (``python -m
+  ray_tpu._private.gcs`` / ``raylet``) for deployment-shaped setups.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import secrets
+import time
+from typing import Dict, Optional, Tuple
+
+from ray_tpu._private.gcs import GcsServer
+from ray_tpu._private.raylet import Raylet
+
+
+class Node:
+    def __init__(
+        self,
+        *,
+        head: bool = True,
+        gcs_addr: Optional[Tuple[str, int]] = None,
+        num_cpus: Optional[float] = None,
+        num_tpus: Optional[float] = None,
+        resources: Optional[Dict[str, float]] = None,
+        object_store_memory: Optional[int] = None,
+        session_name: Optional[str] = None,
+        labels: Optional[Dict[str, str]] = None,
+        worker_env: Optional[Dict[str, str]] = None,
+    ):
+        self.head = head
+        self.session_name = session_name or f"s{int(time.time())}_{secrets.token_hex(4)}"
+        self.gcs_server: Optional[GcsServer] = None
+        self.gcs_addr = gcs_addr
+        self.raylet: Optional[Raylet] = None
+        self.raylet_addr: Optional[Tuple[str, int]] = None
+        self._resources = dict(resources or {})
+        if num_cpus is not None:
+            self._resources["CPU"] = float(num_cpus)
+        if num_tpus is not None:
+            self._resources["TPU"] = float(num_tpus)
+        if "CPU" not in self._resources:
+            self._resources["CPU"] = float(os.cpu_count() or 1)
+        if "TPU" not in self._resources:
+            from ray_tpu._private.raylet import detect_tpu_resources
+
+            self._resources.update(detect_tpu_resources())
+        self.object_store_memory = object_store_memory
+        self.labels = labels
+        self.worker_env = worker_env
+
+    async def start(self) -> None:
+        if self.head:
+            self.gcs_server = GcsServer(session_name=self.session_name)
+            self.gcs_addr = await self.gcs_server.start()
+        assert self.gcs_addr is not None
+        self.raylet = Raylet(
+            self.gcs_addr,
+            self.session_name,
+            resources=self._resources,
+            object_store_memory=self.object_store_memory,
+            labels=self.labels,
+            worker_env=self.worker_env,
+        )
+        self.raylet_addr = await self.raylet.start()
+
+    async def stop(self) -> None:
+        if self.raylet is not None:
+            await self.raylet.stop()
+        if self.gcs_server is not None:
+            await self.gcs_server.stop()
+
+    @property
+    def node_id(self) -> str:
+        return self.raylet.node_id if self.raylet else ""

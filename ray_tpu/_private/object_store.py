@@ -1,0 +1,174 @@
+"""Object stores seen from inside a worker/driver process.
+
+Two tiers, mirroring the reference's CoreWorker store providers
+(src/ray/core_worker/store_provider/):
+
+- MemoryStore: owner-local in-process store for small objects and for
+  "where is it" markers of large objects that live in shm. Futures/waiters
+  let `get` block until a pending task fills the slot.
+- PlasmaClient: client of the local raylet's object directory; data moves
+  through named shm segments (zero-copy reads via memoryview).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._private import rpc, shm
+from ray_tpu._private.common import ObjectLostError, config
+
+logger = logging.getLogger(__name__)
+
+# Memory-store entry kinds.
+INLINE = "inline"  # payload bytes present locally
+IN_PLASMA = "plasma"  # payload in shm on some node (addr attached)
+
+
+class MemoryStoreEntry:
+    __slots__ = ("kind", "payload", "plasma_addr")
+
+    def __init__(self, kind: str, payload: Optional[bytes], plasma_addr=None):
+        self.kind = kind
+        self.payload = payload
+        self.plasma_addr = plasma_addr  # raylet addr holding the primary copy
+
+
+class MemoryStore:
+    def __init__(self):
+        self._entries: Dict[str, MemoryStoreEntry] = {}
+        self._waiters: Dict[str, List[asyncio.Future]] = {}
+
+    def contains(self, oid: str) -> bool:
+        return oid in self._entries
+
+    def get(self, oid: str) -> Optional[MemoryStoreEntry]:
+        return self._entries.get(oid)
+
+    def put_inline(self, oid: str, payload: bytes) -> None:
+        self._entries[oid] = MemoryStoreEntry(INLINE, payload)
+        self._notify(oid)
+
+    def put_plasma_marker(self, oid: str, plasma_addr: Tuple[str, int]) -> None:
+        self._entries[oid] = MemoryStoreEntry(IN_PLASMA, None, tuple(plasma_addr))
+        self._notify(oid)
+
+    def delete(self, oid: str) -> None:
+        self._entries.pop(oid, None)
+
+    def _notify(self, oid: str) -> None:
+        for fut in self._waiters.pop(oid, []):
+            if not fut.done():
+                fut.set_result(True)
+
+    async def wait_for(self, oid: str, timeout: Optional[float]) -> Optional[MemoryStoreEntry]:
+        entry = self._entries.get(oid)
+        if entry is not None:
+            return entry
+        fut = asyncio.get_running_loop().create_future()
+        self._waiters.setdefault(oid, []).append(fut)
+        try:
+            await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            return None
+        return self._entries.get(oid)
+
+
+class PlasmaClient:
+    """Client of the local raylet's shm object directory.
+
+    Mapped segments are held (pinned client-side) until `release`; reads are
+    zero-copy memoryviews into the segment.
+    """
+
+    def __init__(self, raylet_conn: rpc.Connection):
+        self.conn = raylet_conn
+        self._segments: Dict[str, shm.Segment] = {}
+        self._deferred_close: List[shm.Segment] = []
+
+    async def put_serialized(self, oid: str, serialized) -> None:
+        size = max(1, serialized.total_size)
+        reply = await self.conn.call("ObjCreate", {"oid": oid, "size": size, "pin": True})
+        if reply.get("exists"):
+            return  # already stored (e.g. deterministic re-execution)
+        seg = shm.create(reply["name"], size)
+        try:
+            serialized.write_to(seg.view)
+        finally:
+            seg.close()
+        await self.conn.call("ObjSeal", {"oid": oid})
+
+    async def put_bytes(self, oid: str, payload: bytes) -> None:
+        reply = await self.conn.call(
+            "ObjCreate", {"oid": oid, "size": max(1, len(payload)), "pin": True}
+        )
+        if reply.get("exists"):
+            return
+        seg = shm.create(reply["name"], max(1, len(payload)))
+        try:
+            seg.view[: len(payload)] = payload
+        finally:
+            seg.close()
+        await self.conn.call("ObjSeal", {"oid": oid})
+
+    async def get(
+        self, oids: List[str], timeout: Optional[float] = None, block: bool = True
+    ) -> Tuple[Dict[str, memoryview], List[str]]:
+        reply = await self.conn.call(
+            "ObjGet",
+            {"oids": oids, "timeout": timeout, "block": block},
+            timeout=None if timeout is None else timeout + 10,
+        )
+        found: Dict[str, memoryview] = {}
+        for oid, meta in reply["found"].items():
+            seg = self._segments.get(oid)
+            if seg is None:
+                seg = shm.open_ro(meta["name"])
+                self._segments[oid] = seg
+            found[oid] = seg.view
+        return found, reply["missing"]
+
+    async def contains(self, oids: List[str]) -> Dict[str, bool]:
+        reply = await self.conn.call("ObjContains", {"oids": oids})
+        return reply["contains"]
+
+    async def pull(self, oid: str, from_addr: Tuple[str, int]) -> memoryview:
+        """Ask the local raylet to fetch a remote object, then map it."""
+        await self.conn.call(
+            "PullObject", {"oid": oid, "from_addr": list(from_addr)}, timeout=300
+        )
+        found, missing = await self.get([oid], timeout=30)
+        if oid in found:
+            return found[oid]
+        raise ObjectLostError(f"pull of {oid[:12]} failed: {missing}")
+
+    def release(self, oid: str) -> None:
+        seg = self._segments.pop(oid, None)
+        if seg is not None:
+            self._close_or_defer(seg)
+        # Opportunistically retry deferred closes.
+        still = []
+        for s in self._deferred_close:
+            try:
+                s.close()
+            except Exception:
+                still.append(s)
+        self._deferred_close = still
+
+    def _close_or_defer(self, seg: shm.Segment) -> None:
+        try:
+            seg.close()
+        except Exception:
+            # memoryviews into the segment are still alive; retry later.
+            self._deferred_close.append(seg)
+
+    async def delete(self, oids: List[str]) -> None:
+        for oid in oids:
+            self.release(oid)
+        await self.conn.call("ObjDelete", {"oids": oids})
+
+    def close(self) -> None:
+        for oid in list(self._segments):
+            self.release(oid)

@@ -1,0 +1,757 @@
+"""Raylet: the per-node daemon.
+
+TPU-native analog of the reference's raylet (src/ray/raylet/node_manager.cc):
+worker-pool management, lease-based task scheduling with spillback, placement
+group bundle 2PC resource accounting, and the node's shared-memory object
+directory (the plasma-store role: src/ray/object_manager/plasma/store.h — data
+lives in per-object shm segments created by clients, the raylet owns naming,
+pinning, LRU eviction and cross-node transfer).
+
+Accelerator detection: reports a ``TPU`` resource per local chip plus the
+pod-slice gang resource ``TPU-{pod_type}-head`` on worker 0 of a slice,
+mirroring the reference's TPUAcceleratorManager
+(python/ray/_private/accelerators/tpu.py:75,382).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private import rpc, shm
+from ray_tpu._private.common import ResourceSet, config
+from ray_tpu._private.gcs import GcsClient
+
+logger = logging.getLogger(__name__)
+
+
+def detect_tpu_resources() -> Dict[str, float]:
+    """Probe local TPU chips (reference: tpu.py:104-120 probes /dev/accel* and
+    /dev/vfio). Under JAX we can also ask the runtime, but daemons must not
+    grab the chips, so probe device files and env only."""
+    resources: Dict[str, float] = {}
+    count = 0
+    for i in range(16):
+        if os.path.exists(f"/dev/accel{i}") or os.path.exists(f"/dev/accel_{i}"):
+            count += 1
+    if count == 0 and os.path.isdir("/dev/vfio"):
+        entries = [e for e in os.listdir("/dev/vfio") if e.isdigit()]
+        count = len(entries)
+    env_chips = os.environ.get("TPU_VISIBLE_CHIPS") or os.environ.get("RAY_TPU_CHIPS")
+    if env_chips:
+        count = len([c for c in env_chips.split(",") if c.strip()])
+    if count:
+        resources["TPU"] = float(count)
+        pod_type = os.environ.get("TPU_POD_TYPE") or os.environ.get(
+            "TPU_ACCELERATOR_TYPE"
+        )
+        worker_id = os.environ.get("TPU_WORKER_ID", "0")
+        if pod_type and worker_id == "0":
+            resources[f"TPU-{pod_type}-head"] = 1.0
+    return resources
+
+
+class WorkerHandle:
+    def __init__(self, worker_id: str, proc):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.conn: Optional[rpc.Connection] = None
+        self.addr: Optional[Tuple[str, int]] = None
+        self.registered = asyncio.get_running_loop().create_future()
+        self.lease_id: Optional[str] = None
+        self.actor_id: Optional[str] = None
+        self.demand: Optional[ResourceSet] = None
+        self.idle_since = time.monotonic()
+
+
+class ObjectEntry:
+    __slots__ = (
+        "oid",
+        "size",
+        "segment",
+        "sealed",
+        "pinned",
+        "last_access",
+        "waiters",
+        "creating_since",
+    )
+
+    def __init__(self, oid: str, size: int, segment: str):
+        self.oid = oid
+        self.size = size
+        self.segment = segment
+        self.sealed = False
+        self.pinned = False
+        self.last_access = time.monotonic()
+        self.waiters: List[asyncio.Future] = []
+        self.creating_since = time.monotonic()
+
+
+class LeaseRequest:
+    def __init__(self, lease_id: str, demand: ResourceSet, payload: dict):
+        self.lease_id = lease_id
+        self.demand = demand
+        self.payload = payload
+        self.fut: asyncio.Future = asyncio.get_running_loop().create_future()
+
+
+class Raylet:
+    def __init__(
+        self,
+        gcs_addr: Tuple[str, int],
+        session_name: str,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        resources: Optional[Dict[str, float]] = None,
+        object_store_memory: Optional[int] = None,
+        node_id: Optional[str] = None,
+        labels: Optional[Dict[str, str]] = None,
+        worker_env: Optional[Dict[str, str]] = None,
+    ):
+        from ray_tpu._private.ids import NodeID
+
+        self.node_id = node_id or NodeID.from_random().hex()
+        self.session_name = session_name
+        self.gcs_addr = gcs_addr
+        self.labels = labels or {}
+        self.worker_env = worker_env or {}
+        self.server = rpc.Server(host, port)
+        self.gcs: Optional[GcsClient] = None
+
+        if resources is None:
+            resources = {"CPU": float(os.cpu_count() or 1)}
+            resources.update(detect_tpu_resources())
+        resources.setdefault("node:" + self.node_id[:8], 1.0)
+        self.total = ResourceSet(resources)
+        self.available = ResourceSet(resources)
+
+        # Object store.
+        if object_store_memory is None:
+            try:
+                import psutil  # type: ignore
+
+                mem = psutil.virtual_memory().total
+            except ImportError:
+                mem = os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
+            object_store_memory = max(
+                config.object_store_memory_min,
+                int(mem * config.object_store_memory_fraction),
+            )
+        self.store_capacity = object_store_memory
+        self.store_used = 0
+        self.objects: Dict[str, ObjectEntry] = {}
+
+        # Workers.
+        self.workers: Dict[str, WorkerHandle] = {}
+        self.idle_workers: List[WorkerHandle] = []
+        self.pending_leases: List[LeaseRequest] = []
+        self.leases: Dict[str, WorkerHandle] = {}
+
+        # Placement group bundles committed on this node:
+        # pg_id -> {"base": ResourceSet deducted, "group": ResourceSet added}
+        self.pg_prepared: Dict[str, ResourceSet] = {}
+        self.pg_committed: Dict[str, Tuple[ResourceSet, ResourceSet]] = {}
+
+        self._resources_dirty = asyncio.Event()
+        self._tasks: List[asyncio.Task] = []
+        self._register_handlers()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def start(self) -> Tuple[str, int]:
+        addr = await self.server.start()
+        self.server.on_disconnect(self._on_disconnect)
+        # Duplex: the GCS calls back over this link (LeaseWorkerForActor,
+        # KillWorker, PG prepare/commit), so expose our handlers on it.
+        conn = await rpc.connect(*self.gcs_addr, handlers=self.server._handlers)
+        self.gcs = GcsClient(conn)
+        await self.gcs.call(
+            "RegisterNode",
+            {
+                "node_id": self.node_id,
+                "addr": list(addr),
+                "resources": self.total.to_units(),
+                "labels": self.labels,
+            },
+        )
+        self._tasks.append(asyncio.create_task(self._resource_report_loop()))
+        logger.info(
+            "raylet %s on %s:%s resources=%s",
+            self.node_id[:8],
+            addr[0],
+            addr[1],
+            self.total.to_dict(),
+        )
+        return addr
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for w in list(self.workers.values()):
+            self._kill_worker_proc(w)
+        for entry in list(self.objects.values()):
+            shm.unlink(entry.segment)
+        await self.server.stop()
+        if self.gcs is not None:
+            await self.gcs.conn.close()
+
+    def _register_handlers(self) -> None:
+        s = self.server
+        s.register("RegisterWorker", self._register_worker)
+        s.register("RequestWorkerLease", self._request_worker_lease)
+        s.register("ReturnWorker", self._return_worker)
+        s.register("LeaseWorkerForActor", self._lease_worker_for_actor)
+        s.register("KillWorker", self._kill_worker)
+        s.register("ObjCreate", self._obj_create)
+        s.register("ObjSeal", self._obj_seal)
+        s.register("ObjGet", self._obj_get)
+        s.register("ObjRelease", self._obj_release)
+        s.register("ObjDelete", self._obj_delete)
+        s.register("ObjContains", self._obj_contains)
+        s.register("ObjPin", self._obj_pin)
+        s.register("PullObject", self._pull_object)
+        s.register("FetchChunk", self._fetch_chunk)
+        s.register("PreparePGBundles", self._prepare_pg)
+        s.register("CommitPGBundles", self._commit_pg)
+        s.register("ReleasePGBundles", self._release_pg)
+        s.register("GetNodeStats", self._node_stats)
+        s.register("Ping", self._ping)
+
+    async def _ping(self, conn, p):
+        return {"pong": True, "node_id": self.node_id}
+
+    # -- resource reporting --------------------------------------------------
+
+    async def _resource_report_loop(self) -> None:
+        while True:
+            try:
+                await asyncio.wait_for(self._resources_dirty.wait(), timeout=1.0)
+            except asyncio.TimeoutError:
+                pass
+            self._resources_dirty.clear()
+            try:
+                await self.gcs.call(
+                    "UpdateResources",
+                    {
+                        "node_id": self.node_id,
+                        "available": self.available.to_units(),
+                        "total": self.total.to_units(),
+                    },
+                )
+            except rpc.RpcError:
+                logger.warning("gcs unreachable from raylet %s", self.node_id[:8])
+                await asyncio.sleep(1.0)
+
+    def _mark_dirty(self) -> None:
+        self._resources_dirty.set()
+
+    # -- worker pool ---------------------------------------------------------
+
+    async def _start_worker(self) -> WorkerHandle:
+        from ray_tpu._private.ids import WorkerID
+
+        worker_id = WorkerID.from_random().hex()
+        env = dict(os.environ)
+        # Ensure workers can import ray_tpu regardless of the driver's cwd.
+        import ray_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
+        existing = env.get("PYTHONPATH", "")
+        if pkg_root not in existing.split(os.pathsep):
+            env["PYTHONPATH"] = (
+                pkg_root + (os.pathsep + existing if existing else "")
+            )
+        env.update(self.worker_env)
+        env.update(
+            {
+                "RAY_TPU_RAYLET_HOST": self.server.address[0],
+                "RAY_TPU_RAYLET_PORT": str(self.server.address[1]),
+                "RAY_TPU_GCS_HOST": self.gcs_addr[0],
+                "RAY_TPU_GCS_PORT": str(self.gcs_addr[1]),
+                "RAY_TPU_NODE_ID": self.node_id,
+                "RAY_TPU_WORKER_ID": worker_id,
+                "RAY_TPU_SESSION": self.session_name,
+            }
+        )
+        proc = await asyncio.create_subprocess_exec(
+            sys.executable,
+            "-m",
+            "ray_tpu._private.worker_main",
+            env=env,
+            stdout=None,
+            stderr=None,
+        )
+        handle = WorkerHandle(worker_id, proc)
+        self.workers[worker_id] = handle
+        asyncio.create_task(self._reap_worker(handle))
+        return handle
+
+    async def _reap_worker(self, handle: WorkerHandle) -> None:
+        await handle.proc.wait()
+        self._handle_worker_exit(handle, f"exit code {handle.proc.returncode}")
+
+    def _handle_worker_exit(self, handle: WorkerHandle, cause: str) -> None:
+        if handle.worker_id not in self.workers:
+            return
+        del self.workers[handle.worker_id]
+        if handle in self.idle_workers:
+            self.idle_workers.remove(handle)
+        if handle.lease_id and handle.lease_id in self.leases:
+            del self.leases[handle.lease_id]
+            self._free_lease_resources(handle)
+        if not handle.registered.done():
+            handle.registered.set_exception(rpc.RpcError(f"worker died: {cause}"))
+        if handle.actor_id:
+            asyncio.create_task(
+                self._report_worker_death(handle.worker_id, [handle.actor_id], cause)
+            )
+
+    async def _report_worker_death(self, worker_id, actor_ids, cause) -> None:
+        try:
+            await self.gcs.call(
+                "ReportWorkerDied",
+                {"worker_id": worker_id, "actor_ids": actor_ids, "cause": cause},
+            )
+        except rpc.RpcError:
+            pass
+
+    async def _register_worker(self, conn, p):
+        handle = self.workers.get(p["worker_id"])
+        if handle is None:
+            raise rpc.RpcError("unknown worker")
+        handle.conn = conn
+        handle.addr = tuple(p["addr"])
+        conn.context["worker_id"] = p["worker_id"]
+        if not handle.registered.done():
+            handle.registered.set_result(handle)
+        return {
+            "node_id": self.node_id,
+            "session_name": self.session_name,
+            "gcs_addr": list(self.gcs_addr),
+        }
+
+    def _on_disconnect(self, conn: rpc.Connection) -> None:
+        worker_id = conn.context.get("worker_id")
+        if worker_id and worker_id in self.workers:
+            handle = self.workers[worker_id]
+            # Process may still be flushing; reaper handles true exit. If the
+            # RPC link dropped but the process lives, kill it — a worker
+            # without its raylet link is unmanageable.
+            self._kill_worker_proc(handle)
+
+    def _kill_worker_proc(self, handle: WorkerHandle) -> None:
+        try:
+            handle.proc.terminate()
+        except ProcessLookupError:
+            pass
+
+    async def _get_or_start_idle_worker(self) -> WorkerHandle:
+        while self.idle_workers:
+            handle = self.idle_workers.pop()
+            if handle.worker_id in self.workers and handle.conn and not handle.conn.closed:
+                return handle
+        handle = await self._start_worker()
+        await handle.registered
+        return handle
+
+    # -- leases --------------------------------------------------------------
+
+    def _translate_pg_demand(self, demand: ResourceSet, pg_id, bundle_index) -> ResourceSet:
+        """Rewrite resource names to the PG-scoped resources committed on this
+        node (reference encodes bundles as CPU_group_<idx>_<pgid> custom
+        resources; see placement_group_resource_manager.cc)."""
+        if not pg_id:
+            return demand
+        units = {}
+        for k, v in demand.to_units().items():
+            if bundle_index is not None and bundle_index >= 0:
+                units[f"{k}_group_{bundle_index}_{pg_id}"] = v
+            else:
+                units[f"{k}_group_{pg_id}"] = v
+        # Gang membership marker so the lease only matches nodes w/ the PG.
+        units[f"bundle_group_{pg_id}"] = 1
+        return ResourceSet.from_units(units)
+
+    async def _request_worker_lease(self, conn, p):
+        demand = ResourceSet.from_units(p.get("resources") or {})
+        demand = self._translate_pg_demand(
+            demand, p.get("pg_id"), p.get("bundle_index")
+        )
+        if not demand.is_subset_of(self.total):
+            # Infeasible here — suggest spillback target from GCS view.
+            target = await self._find_spillback_node(demand)
+            return {"spillback": target}
+        req = LeaseRequest(p["lease_id"], demand, p)
+        self.pending_leases.append(req)
+        self._try_grant_leases()
+        return await req.fut
+
+    def _try_grant_leases(self) -> None:
+        granted_any = True
+        while granted_any and self.pending_leases:
+            granted_any = False
+            req = self.pending_leases[0]
+            if req.fut.done():
+                self.pending_leases.pop(0)
+                granted_any = True
+                continue
+            if req.demand.is_subset_of(self.available):
+                self.pending_leases.pop(0)
+                self.available = self.available - req.demand
+                self._mark_dirty()
+                asyncio.create_task(self._grant(req))
+                granted_any = True
+
+    async def _grant(self, req: LeaseRequest) -> None:
+        try:
+            handle = await self._get_or_start_idle_worker()
+        except rpc.RpcError as e:
+            self.available = self.available + req.demand
+            self._mark_dirty()
+            if not req.fut.done():
+                req.fut.set_exception(e)
+            return
+        handle.lease_id = req.lease_id
+        handle.demand = req.demand  # type: ignore[attr-defined]
+        self.leases[req.lease_id] = handle
+        if not req.fut.done():
+            req.fut.set_result(
+                {
+                    "granted": True,
+                    "worker_id": handle.worker_id,
+                    "worker_addr": list(handle.addr),
+                    "lease_id": req.lease_id,
+                }
+            )
+        else:  # caller gave up; return resources
+            self._release_lease(req.lease_id, dirty=False)
+
+    def _free_lease_resources(self, handle: WorkerHandle) -> None:
+        demand = getattr(handle, "demand", None)
+        if demand is not None:
+            self.available = self.available + demand
+            handle.demand = None  # type: ignore[attr-defined]
+            self._mark_dirty()
+            self._try_grant_leases()
+
+    def _release_lease(self, lease_id: str, dirty: bool) -> Optional[WorkerHandle]:
+        handle = self.leases.pop(lease_id, None)
+        if handle is None:
+            return None
+        handle.lease_id = None
+        self._free_lease_resources(handle)
+        if dirty or handle.actor_id:
+            self._kill_worker_proc(handle)
+        elif handle.worker_id in self.workers:
+            handle.idle_since = time.monotonic()
+            self.idle_workers.append(handle)
+        return handle
+
+    async def _return_worker(self, conn, p):
+        self._release_lease(p["lease_id"], p.get("dirty", False))
+        return {"ok": True}
+
+    async def _find_spillback_node(self, demand: ResourceSet):
+        try:
+            reply = await self.gcs.call("GetAllNodes")
+        except rpc.RpcError:
+            return None
+        for n in reply["nodes"]:
+            if n["state"] != "ALIVE" or n["node_id"] == self.node_id:
+                continue
+            if demand.is_subset_of(ResourceSet.from_units(n["total"])):
+                return {"node_id": n["node_id"], "addr": n["addr"]}
+        return None
+
+    async def _lease_worker_for_actor(self, conn, p):
+        """GCS-driven actor placement: lease a worker and hand it the
+        creation spec; the worker reports readiness to the GCS itself."""
+        spec = p["spec"]
+        demand = ResourceSet.from_units(spec.get("resources") or {})
+        demand = self._translate_pg_demand(
+            demand, spec.get("pg_id"), spec.get("bundle_index")
+        )
+        if not demand.is_subset_of(self.total):
+            return {"granted": False}
+        req = LeaseRequest("actor:" + spec["actor_id"], demand, p)
+        self.pending_leases.append(req)
+        self._try_grant_leases()
+        reply = await req.fut
+        if not reply.get("granted"):
+            return reply
+        handle = self.leases[req.lease_id]
+        handle.actor_id = spec["actor_id"]
+        try:
+            await handle.conn.call("CreateActor", {"spec": spec}, timeout=300)
+        except rpc.RpcError as e:
+            self._release_lease(req.lease_id, dirty=True)
+            return {"granted": False, "error": str(e)}
+        return {"granted": True, "worker_id": handle.worker_id}
+
+    async def _kill_worker(self, conn, p):
+        handle = self.workers.get(p["worker_id"])
+        if handle is None:
+            return {"ok": False}
+        self._kill_worker_proc(handle)
+        return {"ok": True}
+
+    # -- object store --------------------------------------------------------
+
+    def _segment_name(self, oid: str) -> str:
+        return f"rt_{self.session_name[:12]}_{oid[:24]}"
+
+    def _evict_for(self, size: int) -> bool:
+        if self.store_used + size <= self.store_capacity:
+            return True
+        victims = sorted(
+            (e for e in self.objects.values() if e.sealed and not e.pinned),
+            key=lambda e: e.last_access,
+        )
+        for v in victims:
+            self._delete_entry(v)
+            if self.store_used + size <= self.store_capacity:
+                return True
+        return self.store_used + size <= self.store_capacity
+
+    def _delete_entry(self, entry: ObjectEntry) -> None:
+        self.objects.pop(entry.oid, None)
+        self.store_used -= entry.size
+        shm.unlink(entry.segment)
+
+    async def _obj_create(self, conn, p):
+        oid, size = p["oid"], p["size"]
+        if oid in self.objects:
+            entry = self.objects[oid]
+            return {"name": entry.segment, "exists": True, "sealed": entry.sealed}
+        if not self._evict_for(size):
+            raise rpc.RpcError(
+                f"object store full: need {size}, capacity {self.store_capacity}"
+            )
+        entry = ObjectEntry(oid, size, self._segment_name(oid))
+        entry.pinned = bool(p.get("pin", True))
+        self.objects[oid] = entry
+        self.store_used += size
+        return {"name": entry.segment, "exists": False}
+
+    async def _obj_seal(self, conn, p):
+        entry = self.objects.get(p["oid"])
+        if entry is None:
+            raise rpc.RpcError(f"seal of unknown object {p['oid'][:12]}")
+        entry.sealed = True
+        entry.last_access = time.monotonic()
+        for fut in entry.waiters:
+            if not fut.done():
+                fut.set_result(True)
+        entry.waiters.clear()
+        return {"ok": True}
+
+    async def _obj_get(self, conn, p):
+        """Resolve local objects; optionally block until sealed."""
+        timeout = p.get("timeout")
+        found, missing = {}, []
+        deadline = time.monotonic() + timeout if timeout else None
+        for oid in p["oids"]:
+            entry = self.objects.get(oid)
+            if entry is not None and not entry.sealed and p.get("block", True):
+                fut = asyncio.get_running_loop().create_future()
+                entry.waiters.append(fut)
+                remaining = None if deadline is None else max(0, deadline - time.monotonic())
+                try:
+                    await asyncio.wait_for(fut, remaining)
+                except asyncio.TimeoutError:
+                    pass
+                entry = self.objects.get(oid)
+            if entry is not None and entry.sealed:
+                entry.last_access = time.monotonic()
+                found[oid] = {"name": entry.segment, "size": entry.size}
+            else:
+                missing.append(oid)
+        return {"found": found, "missing": missing}
+
+    async def _obj_contains(self, conn, p):
+        return {
+            "contains": {
+                oid: (oid in self.objects and self.objects[oid].sealed)
+                for oid in p["oids"]
+            }
+        }
+
+    async def _obj_release(self, conn, p):
+        entry = self.objects.get(p["oid"])
+        if entry is not None:
+            entry.last_access = time.monotonic()
+        return {"ok": True}
+
+    async def _obj_pin(self, conn, p):
+        for oid in p["oids"]:
+            entry = self.objects.get(oid)
+            if entry is not None:
+                entry.pinned = True
+        return {"ok": True}
+
+    async def _obj_delete(self, conn, p):
+        for oid in p["oids"]:
+            entry = self.objects.get(oid)
+            if entry is not None:
+                self._delete_entry(entry)
+        return {"ok": True}
+
+    # -- cross-node transfer (reference: object_manager pull/push) -----------
+
+    async def _pull_object(self, conn, p):
+        """Fetch an object from a remote raylet into the local store."""
+        oid = p["oid"]
+        entry = self.objects.get(oid)
+        if entry is not None and entry.sealed:
+            return {"name": entry.segment, "size": entry.size}
+        remote = await rpc.connect(*p["from_addr"], retry=3)
+        try:
+            info = await remote.call("ObjGet", {"oids": [oid], "block": False})
+            meta = info["found"].get(oid)
+            if meta is None:
+                raise rpc.RpcError(f"object {oid[:12]} not on remote node")
+            size = meta["size"]
+            create = await self._obj_create(conn, {"oid": oid, "size": size, "pin": False})
+            if create.get("sealed"):
+                return {"name": create["name"], "size": size}
+            if create.get("exists"):
+                # Another pull is filling it; wait for the seal.
+                await self._obj_get(conn, {"oids": [oid], "block": True, "timeout": 60})
+                return {"name": create["name"], "size": size}
+            seg = shm.create(create["name"], size)
+            try:
+                chunk = config.object_chunk_size
+                offset = 0
+                view = seg.view
+                while offset < size:
+                    data = await remote.call(
+                        "FetchChunk",
+                        {"oid": oid, "offset": offset, "size": min(chunk, size - offset)},
+                        timeout=60,
+                    )
+                    view[offset : offset + len(data)] = data
+                    offset += len(data)
+            finally:
+                seg.close()
+            await self._obj_seal(conn, {"oid": oid})
+            return {"name": self.objects[oid].segment, "size": size}
+        finally:
+            await remote.close()
+
+    async def _fetch_chunk(self, conn, p):
+        entry = self.objects.get(p["oid"])
+        if entry is None or not entry.sealed:
+            raise rpc.RpcError(f"object {p['oid'][:12]} not local")
+        seg = shm.open_ro(entry.segment)
+        try:
+            return bytes(seg.view[p["offset"] : p["offset"] + p["size"]])
+        finally:
+            seg.close()
+
+    # -- placement group bundles ---------------------------------------------
+
+    async def _prepare_pg(self, conn, p):
+        pg_id = p["pg_id"]
+        total_demand = ResourceSet()
+        for _, units in p["bundles"].items():
+            total_demand = total_demand + ResourceSet.from_units(units)
+        if not total_demand.is_subset_of(self.available):
+            return {"success": False}
+        self.available = self.available - total_demand
+        self.pg_prepared[pg_id] = total_demand
+        # Remember per-bundle layout for commit.
+        self.pg_prepared_bundles = getattr(self, "pg_prepared_bundles", {})
+        self.pg_prepared_bundles[pg_id] = p["bundles"]
+        self._mark_dirty()
+        return {"success": True}
+
+    async def _commit_pg(self, conn, p):
+        pg_id = p["pg_id"]
+        base = self.pg_prepared.pop(pg_id, None)
+        bundles = getattr(self, "pg_prepared_bundles", {}).pop(pg_id, None)
+        if base is None or bundles is None:
+            return {"ok": False}
+        group_units: Dict[str, int] = {f"bundle_group_{pg_id}": len(bundles) * 10000}
+        for idx, units in bundles.items():
+            for k, v in units.items():
+                group_units[f"{k}_group_{idx}_{pg_id}"] = v
+                group_units[f"{k}_group_{pg_id}"] = (
+                    group_units.get(f"{k}_group_{pg_id}", 0) + v
+                )
+        group = ResourceSet.from_units(group_units)
+        self.total = self.total + group
+        self.available = self.available + group
+        self.pg_committed[pg_id] = (base, group)
+        self._mark_dirty()
+        self._try_grant_leases()
+        return {"ok": True}
+
+    async def _release_pg(self, conn, p):
+        pg_id = p["pg_id"]
+        if pg_id in self.pg_prepared:
+            self.available = self.available + self.pg_prepared.pop(pg_id)
+            getattr(self, "pg_prepared_bundles", {}).pop(pg_id, None)
+        if pg_id in self.pg_committed:
+            base, group = self.pg_committed.pop(pg_id)
+            self.total = self.total - group
+            self.available = self.available - group + base
+            # Kill workers leased against this PG's resources.
+            for lease_id, handle in list(self.leases.items()):
+                demand = getattr(handle, "demand", None)
+                if demand and any(pg_id in k for k in demand.keys()):
+                    self._release_lease(lease_id, dirty=True)
+        self._mark_dirty()
+        return {"ok": True}
+
+    async def _node_stats(self, conn, p):
+        return {
+            "node_id": self.node_id,
+            "total": self.total.to_units(),
+            "available": self.available.to_units(),
+            "num_workers": len(self.workers),
+            "num_idle": len(self.idle_workers),
+            "num_leases": len(self.leases),
+            "store_used": self.store_used,
+            "store_capacity": self.store_capacity,
+            "num_objects": len(self.objects),
+            "pending_leases": len(self.pending_leases),
+        }
+
+
+async def main() -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs-host", required=True)
+    parser.add_argument("--gcs-port", type=int, required=True)
+    parser.add_argument("--session", required=True)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--resources", default="")
+    parser.add_argument("--object-store-memory", type=int, default=None)
+    args = parser.parse_args()
+    resources = None
+    if args.resources:
+        import json
+
+        resources = json.loads(args.resources)
+    raylet = Raylet(
+        (args.gcs_host, args.gcs_port),
+        args.session,
+        host=args.host,
+        port=args.port,
+        resources=resources,
+        object_store_memory=args.object_store_memory,
+    )
+    addr = await raylet.start()
+    print(f"RAYLET_ADDR {addr[0]}:{addr[1]} NODE {raylet.node_id}", flush=True)
+    await asyncio.Event().wait()
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(main())

@@ -1,0 +1,259 @@
+"""Asyncio RPC layer: length-prefixed msgpack frames over TCP.
+
+TPU-native analog of the reference's rpc scaffolding (src/ray/rpc/): persistent
+client connections with call multiplexing, a handler-registry server, and
+server->client push for pubsub channels. The reference wraps gRPC; we use a
+lean custom framing because every daemon here is an asyncio program and the
+control-plane messages are small dicts — msgpack round-trips them with no
+codegen step. Payloads that carry Python objects (task args, actor state)
+are cloudpickled into opaque ``bytes`` fields by the caller.
+
+Frame: 4-byte little-endian length + msgpack([msgid, kind, method, payload]).
+Kinds: 0=request, 1=reply, 2=error-reply, 3=push (one-way).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import struct
+import traceback
+from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
+
+import msgpack
+
+logger = logging.getLogger(__name__)
+
+_KIND_REQ = 0
+_KIND_REP = 1
+_KIND_ERR = 2
+_KIND_PUSH = 3
+
+_LEN = struct.Struct("<I")
+_MAX_FRAME = 1 << 31
+
+
+class RpcError(Exception):
+    """Raised on the caller when the remote handler raised or the link died."""
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+def _pack(msg) -> bytes:
+    body = msgpack.packb(msg, use_bin_type=True)
+    return _LEN.pack(len(body)) + body
+
+
+async def _read_frame(reader: asyncio.StreamReader):
+    header = await reader.readexactly(4)
+    (length,) = _LEN.unpack(header)
+    if length > _MAX_FRAME:
+        raise RpcError(f"frame too large: {length}")
+    body = await reader.readexactly(length)
+    return msgpack.unpackb(body, raw=False, strict_map_key=False)
+
+
+class Connection:
+    """One end of a duplex RPC link. Both sides can issue requests and pushes."""
+
+    def __init__(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        handlers: Dict[str, Callable[..., Awaitable[Any]]],
+        on_close: Optional[Callable[["Connection"], None]] = None,
+    ):
+        self._reader = reader
+        self._writer = writer
+        self._handlers = handlers
+        self._on_close = on_close
+        self._msgid = itertools.count(1)
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._closed = False
+        self._write_lock = asyncio.Lock()
+        self._reader_task = asyncio.create_task(self._read_loop())
+        # Arbitrary per-connection state daemons can attach (e.g. worker id).
+        self.context: Dict[str, Any] = {}
+
+    @property
+    def peername(self) -> Optional[Tuple[str, int]]:
+        try:
+            return self._writer.get_extra_info("peername")
+        except Exception:
+            return None
+
+    async def _send(self, msg) -> None:
+        if self._closed:
+            raise ConnectionLost("connection closed")
+        data = _pack(msg)
+        async with self._write_lock:
+            self._writer.write(data)
+            await self._writer.drain()
+
+    async def call(self, method: str, payload: Any = None, timeout: Optional[float] = None):
+        """Issue a request and await the reply."""
+        msgid = next(self._msgid)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[msgid] = fut
+        try:
+            await self._send([msgid, _KIND_REQ, method, payload])
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(msgid, None)
+
+    async def push(self, method: str, payload: Any = None) -> None:
+        """One-way message; no reply expected."""
+        await self._send([0, _KIND_PUSH, method, payload])
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                msg = await _read_frame(self._reader)
+                msgid, kind, method, payload = msg
+                if kind == _KIND_REQ:
+                    asyncio.create_task(self._dispatch(msgid, method, payload))
+                elif kind == _KIND_PUSH:
+                    asyncio.create_task(self._dispatch(None, method, payload))
+                elif kind in (_KIND_REP, _KIND_ERR):
+                    fut = self._pending.get(msgid)
+                    if fut is not None and not fut.done():
+                        if kind == _KIND_REP:
+                            fut.set_result(payload)
+                        else:
+                            fut.set_exception(RpcError(payload))
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
+            pass
+        except Exception:
+            logger.exception("rpc read loop failed")
+        finally:
+            self._teardown()
+
+    async def _dispatch(self, msgid, method: str, payload) -> None:
+        handler = self._handlers.get(method)
+        try:
+            if handler is None:
+                raise RpcError(f"no handler for method {method!r}")
+            result = await handler(self, payload)
+            if msgid is not None:
+                await self._send([msgid, _KIND_REP, method, result])
+        except ConnectionLost:
+            pass
+        except Exception as e:
+            if msgid is not None:
+                err = f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
+                try:
+                    await self._send([msgid, _KIND_ERR, method, err])
+                except ConnectionLost:
+                    pass
+            else:
+                logger.exception("push handler %s failed", method)
+
+    def _teardown(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionLost("connection closed"))
+        self._pending.clear()
+        try:
+            self._writer.close()
+        except Exception:
+            pass
+        if self._on_close is not None:
+            try:
+                self._on_close(self)
+            except Exception:
+                logger.exception("on_close callback failed")
+
+    async def close(self) -> None:
+        self._reader_task.cancel()
+        self._teardown()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+
+class Server:
+    """RPC server: accepts connections, dispatches to registered handlers.
+
+    Handlers are ``async def handler(conn, payload) -> reply``.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._host = host
+        self._port = port
+        self._handlers: Dict[str, Callable] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.connections: set = set()
+        self._on_disconnect: Optional[Callable[[Connection], None]] = None
+
+    def handler(self, name: str):
+        def deco(fn):
+            self._handlers[name] = fn
+            return fn
+
+        return deco
+
+    def register(self, name: str, fn: Callable) -> None:
+        self._handlers[name] = fn
+
+    def on_disconnect(self, fn: Callable[[Connection], None]) -> None:
+        self._on_disconnect = fn
+
+    async def start(self) -> Tuple[str, int]:
+        self._server = await asyncio.start_server(self._accept, self._host, self._port)
+        sock = self._server.sockets[0]
+        self._host, self._port = sock.getsockname()[:2]
+        return self._host, self._port
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._host, self._port
+
+    async def _accept(self, reader, writer) -> None:
+        conn = Connection(reader, writer, self._handlers, on_close=self._conn_closed)
+        self.connections.add(conn)
+
+    def _conn_closed(self, conn: Connection) -> None:
+        self.connections.discard(conn)
+        if self._on_disconnect is not None:
+            self._on_disconnect(conn)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+        # Close live connections before wait_closed(): since py3.12.1
+        # wait_closed blocks until every client transport is gone.
+        for conn in list(self.connections):
+            await conn.close()
+        if self._server is not None:
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), timeout=5)
+            except asyncio.TimeoutError:
+                pass
+
+
+async def connect(
+    host: str,
+    port: int,
+    handlers: Optional[Dict[str, Callable]] = None,
+    retry: int = 30,
+    retry_interval: float = 0.1,
+) -> Connection:
+    """Dial a server, retrying while it boots. Returns a duplex Connection."""
+    last_err: Optional[Exception] = None
+    for _ in range(max(1, retry)):
+        try:
+            reader, writer = await asyncio.open_connection(host, port)
+            # NB: keep the caller's dict object (even if currently empty) so
+            # handlers registered later are visible on this connection.
+            return Connection(reader, writer, handlers if handlers is not None else {})
+        except (ConnectionRefusedError, OSError) as e:
+            last_err = e
+            await asyncio.sleep(retry_interval)
+    raise ConnectionLost(f"could not connect to {host}:{port}: {last_err}")

@@ -1,0 +1,232 @@
+"""Value serialization for the object store.
+
+Analog of the reference's SerializationContext (python/ray/_private/serialization.py:111):
+cloudpickle for arbitrary Python values, pickle protocol 5 ``buffer_callback`` for
+out-of-band zero-copy of large contiguous buffers (numpy / jax host arrays), and
+custom reducers for ObjectRef so refs travel inside task args and returns.
+
+Wire layout of a stored object (one contiguous byte region, shm- and
+socket-friendly)::
+
+    [4B header_len][msgpack header][pad to 64][buffer 0][pad][buffer 1] ...
+
+header = {"p": pickled-meta-bytes, "o": [buffer offsets], "s": [buffer sizes],
+          "e": bool is_exception}
+
+Buffers are 64-byte aligned so deserialized numpy views over shm are
+cache-line aligned and directly usable by jax.numpy / dlpack without a copy.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+import sys
+import threading
+from typing import Any, Callable, List, Optional, Tuple
+
+import msgpack
+
+import cloudpickle
+
+_ALIGN = 64
+_LEN = struct.Struct("<I")
+# Buffers smaller than this are cheaper to keep inline in the pickle stream.
+_OOB_THRESHOLD = 512
+
+
+def _align(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+class _SerializationThreadContext(threading.local):
+    def __init__(self):
+        self.contained_refs: Optional[List[Any]] = None
+        self.ref_deserializer: Optional[Callable] = None
+        self.actor_handle_deserializer: Optional[Callable] = None
+
+
+_ctx = _SerializationThreadContext()
+
+
+def record_contained_ref(ref) -> None:
+    """Called by ObjectRef.__reduce__ while a serialize() is in flight."""
+    if _ctx.contained_refs is not None:
+        _ctx.contained_refs.append(ref)
+
+
+def get_ref_deserializer():
+    return _ctx.ref_deserializer
+
+
+def get_actor_handle_deserializer():
+    return _ctx.actor_handle_deserializer
+
+
+class SerializedObject:
+    """A serialized value: header + list of out-of-band buffers.
+
+    ``contained_refs`` lists every ObjectRef found inside the value — the
+    caller uses it for distributed ref counting (the reference tracks the
+    same set in CoreWorker::Put / TaskManager).
+    """
+
+    __slots__ = ("header", "buffers", "contained_refs", "is_exception")
+
+    def __init__(self, header: bytes, buffers: List[memoryview], contained_refs, is_exception):
+        self.header = header
+        self.buffers = buffers
+        self.contained_refs = contained_refs
+        self.is_exception = is_exception
+
+    @property
+    def total_size(self) -> int:
+        size = _align(4 + len(self.header))
+        for buf in self.buffers:
+            size = _align(size + buf.nbytes)
+        # Trailing pad is harmless; reserve exact: recompute without final pad.
+        size = 4 + len(self.header)
+        for buf in self.buffers:
+            size = _align(size) + buf.nbytes
+        return size
+
+    def write_to(self, dest: memoryview) -> int:
+        """Write the full wire layout into ``dest``; returns bytes written."""
+        offset = 0
+        dest[0:4] = _LEN.pack(len(self.header))
+        offset = 4
+        dest[offset : offset + len(self.header)] = self.header
+        offset += len(self.header)
+        for buf in self.buffers:
+            offset = _align(offset)
+            dest[offset : offset + buf.nbytes] = buf.cast("B") if buf.format != "B" or buf.ndim != 1 else buf
+            offset += buf.nbytes
+        return offset
+
+    def to_bytes(self) -> bytes:
+        out = bytearray(self.total_size)
+        self.write_to(memoryview(out))
+        return bytes(out)
+
+
+def serialize(value: Any) -> SerializedObject:
+    _maybe_register_jax_reducers()
+    is_exception = isinstance(value, BaseException)
+    buffers: List[pickle.PickleBuffer] = []
+    prev = _ctx.contained_refs
+    _ctx.contained_refs = []
+    try:
+        def buffer_cb(pb: pickle.PickleBuffer) -> bool:
+            view = pb.raw()
+            if view.nbytes < _OOB_THRESHOLD:
+                return True  # keep small buffers inline
+            buffers.append(pb)
+            return False
+
+        meta = cloudpickle.dumps(value, protocol=5, buffer_callback=buffer_cb)
+        contained = _ctx.contained_refs
+    finally:
+        _ctx.contained_refs = prev
+
+    raw_views = [pb.raw() for pb in buffers]
+    # Compute offsets for the wire layout.
+    offsets: List[int] = []
+    sizes: List[int] = []
+    # Header must be built before offsets are final; offsets are relative to
+    # the start of the whole region, so build header iteratively: header size
+    # changes offsets, so instead make offsets relative to the END of the
+    # header region, which is itself aligned.
+    rel = 0
+    for view in raw_views:
+        rel = _align(rel)
+        offsets.append(rel)
+        sizes.append(view.nbytes)
+        rel += view.nbytes
+    header = msgpack.packb(
+        {"p": meta, "o": offsets, "s": sizes, "e": is_exception}, use_bin_type=True
+    )
+    return SerializedObject(header, raw_views, contained, is_exception)
+
+
+def deserialize(region) -> Tuple[Any, bool]:
+    """Inverse of serialize. ``region`` is a bytes-like over the wire layout.
+
+    Returns (value, is_exception). Out-of-band buffers are zero-copy views
+    into ``region`` — the caller must keep the backing memory alive as long
+    as the value is (the object store client pins it).
+    """
+    view = memoryview(region)
+    (header_len,) = _LEN.unpack(view[0:4])
+    header = msgpack.unpackb(view[4 : 4 + header_len], raw=False)
+    base = _align(4 + header_len)
+    # Offsets recorded relative to a zero base then shifted by aligned header.
+    bufs = []
+    for off, size in zip(header["o"], header["s"]):
+        start = base + off
+        bufs.append(view[start : start + size])
+    value = pickle.loads(header["p"], buffers=bufs)
+    return value, header["e"]
+
+
+def header_buffer_base(region) -> int:
+    view = memoryview(region)
+    (header_len,) = _LEN.unpack(view[0:4])
+    return _align(4 + header_len)
+
+
+class DeserializationContext:
+    """Installs ref/actor-handle deserializers for the current thread while
+    deserializing (the worker sets this so unpickled ObjectRefs re-attach to
+    the local core worker for ref counting and `get`)."""
+
+    def __init__(self, ref_deserializer=None, actor_handle_deserializer=None):
+        self._ref = ref_deserializer
+        self._actor = actor_handle_deserializer
+
+    def __enter__(self):
+        self._prev = (_ctx.ref_deserializer, _ctx.actor_handle_deserializer)
+        _ctx.ref_deserializer = self._ref
+        _ctx.actor_handle_deserializer = self._actor
+        return self
+
+    def __exit__(self, *exc):
+        _ctx.ref_deserializer, _ctx.actor_handle_deserializer = self._prev
+        return False
+
+
+def _rebuild_jax_array(np_val):
+    return np_val
+
+
+def _reduce_jax_array(arr):
+    import jax
+    import numpy as np
+
+    return (_rebuild_jax_array, (np.asarray(jax.device_get(arr)),))
+
+
+_jax_reducers_registered = False
+
+
+def _maybe_register_jax_reducers() -> None:
+    """Teach pickle to move jax.Arrays as host numpy arrays (out-of-band).
+
+    Device arrays are fetched to host at Put time; consumers re-place them on
+    device (device_put is cheap and sharding-aware). This mirrors how the
+    reference moves torch tensors through plasma as host memory.
+
+    Lazy by design: importing jax costs seconds, so registration only happens
+    once user code has already imported jax into this process.
+    """
+    global _jax_reducers_registered
+    if _jax_reducers_registered or "jax" not in sys.modules:
+        return
+    try:
+        import copyreg
+
+        from jax._src.array import ArrayImpl
+
+        copyreg.pickle(ArrayImpl, _reduce_jax_array)
+        _jax_reducers_registered = True
+    except Exception:
+        _jax_reducers_registered = True

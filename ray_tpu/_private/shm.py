@@ -1,0 +1,115 @@
+"""Shared-memory segment abstraction: native C++ extension with pure-Python fallback.
+
+The native path (ray_tpu._native._shm, src/shm_buffer.cc) maps POSIX shm
+segments directly; the fallback uses multiprocessing.shared_memory with its
+resource tracker disabled for attachments (the raylet owns segment lifetime,
+not whichever process happened to touch it last).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+try:
+    from ray_tpu._native import _shm as _native_shm
+
+    NATIVE = True
+except ImportError:  # pragma: no cover - exercised only in pure-python installs
+    _native_shm = None
+    NATIVE = False
+
+
+class Segment:
+    """A named shared-memory segment with a memoryview interface."""
+
+    __slots__ = ("name", "_buf", "_view", "writable")
+
+    def __init__(self, name: str, buf, writable: bool):
+        self.name = name
+        self._buf = buf
+        self.writable = writable
+        self._view: Optional[memoryview] = None
+
+    @property
+    def view(self) -> memoryview:
+        if self._view is None:
+            self._view = memoryview(self._buf)
+        return self._view
+
+    @property
+    def size(self) -> int:
+        return self.view.nbytes
+
+    def close(self) -> None:
+        if self._view is not None:
+            self._view.release()
+            self._view = None
+        if _native_shm is not None and isinstance(self._buf, _native_shm.ShmBuffer):
+            if not self._buf.closed:
+                self._buf.close()
+        else:  # multiprocessing fallback
+            self._buf.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+if NATIVE:
+
+    def create(name: str, size: int) -> Segment:
+        return Segment(name, _native_shm.create("/" + name, size), writable=True)
+
+    def open_ro(name: str) -> Segment:
+        return Segment(name, _native_shm.open_ro("/" + name), writable=False)
+
+    def open_rw(name: str) -> Segment:
+        return Segment(name, _native_shm.open_rw("/" + name), writable=True)
+
+    def unlink(name: str) -> None:
+        _native_shm.unlink("/" + name)
+
+else:  # pragma: no cover
+    from multiprocessing import resource_tracker, shared_memory
+
+    class _Shm(shared_memory.SharedMemory):
+        # Detach from the resource tracker: lifetime is managed by the raylet.
+        def __init__(self, name, create=False, size=0):
+            super().__init__(name=name, create=create, size=size)
+            if not create:
+                try:
+                    resource_tracker.unregister(self._name, "shared_memory")
+                except Exception:
+                    pass
+
+    class _FallbackBuf:
+        def __init__(self, shm):
+            self.shm = shm
+
+        def __buffer__(self, flags):
+            return self.shm.buf.__buffer__(flags)
+
+        def close(self):
+            self.shm.close()
+
+    def create(name: str, size: int) -> Segment:
+        shm = _Shm(name, create=True, size=size)
+        return Segment(name, _FallbackBuf(shm), True)
+
+    def open_ro(name: str) -> Segment:
+        shm = _Shm(name)
+        return Segment(name, _FallbackBuf(shm), False)
+
+    def open_rw(name: str) -> Segment:
+        shm = _Shm(name)
+        return Segment(name, _FallbackBuf(shm), True)
+
+    def unlink(name: str) -> None:
+        try:
+            shm = shared_memory.SharedMemory(name=name)
+            shm.close()
+            shm.unlink()
+        except FileNotFoundError:
+            pass

@@ -1,0 +1,290 @@
+"""Worker process entrypoint: executes tasks and hosts actors.
+
+Analog of the reference's default_worker.py + the Cython task-execution handler
+(python/ray/_raylet.pyx:2251 execute_task path): the asyncio loop owns RPC; user
+task code runs on executor threads (sync) or directly on the loop (async actor
+methods). Ordered actor execution follows the per-caller sequence-number design
+of the reference's ActorSchedulingQueue.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import logging
+import os
+import sys
+import traceback
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from ray_tpu._private import rpc, serialization
+from ray_tpu._private.common import TaskError, TaskSpec, config
+from ray_tpu._private.core_worker import CoreWorker, ObjectRef
+
+logger = logging.getLogger(__name__)
+
+
+class Executor:
+    """Task/actor execution engine wired onto a CoreWorker."""
+
+    def __init__(self, core: CoreWorker):
+        self.core = core
+        self.fn_cache: Dict[str, Any] = {}
+        self.actor_instance: Any = None
+        self.actor_spec: Optional[dict] = None
+        self.pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+        # Per-caller ordered execution state for the sync single-concurrency
+        # actor path (reference: sequential_actor_submit_queue.cc).
+        self.expected_seq: Dict[str, int] = {}
+        self.pending_seq: Dict[str, Dict[int, asyncio.Future]] = {}
+        self.exec_lock = asyncio.Lock()
+        core.server.register("PushTask", self.handle_push_task)
+        core.server.register("PushActorTask", self.handle_push_actor_task)
+        core.server.register("CreateActor", self.handle_create_actor)
+        core.server.register("Exit", self.handle_exit)
+
+    # -- function table ------------------------------------------------------
+
+    async def get_function(self, func_id: str):
+        fn = self.fn_cache.get(func_id)
+        if fn is None:
+            blob = await self.core.gcs.kv_get(func_id, ns="fn")
+            if blob is None:
+                raise rpc.RpcError(f"function {func_id} not found in GCS")
+            fn = cloudpickle.loads(blob)
+            self.fn_cache[func_id] = fn
+        return fn
+
+    # -- argument loading ----------------------------------------------------
+
+    async def load_args(self, wire: dict):
+        if wire.get("args_object"):
+            ref = ObjectRef(
+                wire["args_object"],
+                tuple(wire["owner_addr"]) if wire.get("owner_addr") else None,
+                self.core,
+            )
+            payload = await self.core._resolve_payload(ref, None)
+        else:
+            payload = wire["args_blob"]
+        with serialization.DeserializationContext(
+            ref_deserializer=self.core._deserialize_ref
+        ):
+            (args, kwargs), _ = serialization.deserialize(payload)
+        args = list(args)
+        # Resolve top-level ObjectRef args to values (reference semantics).
+        for i in wire.get("ref_positions") or []:
+            args[i] = await self.core.get_objects(args[i], timeout=None)
+        for k in wire.get("kw_ref_keys") or []:
+            kwargs[k] = await self.core.get_objects(kwargs[k], timeout=None)
+        return args, kwargs
+
+    # -- result storage ------------------------------------------------------
+
+    async def store_returns(self, spec_wire: dict, result: Any) -> list:
+        num_returns = spec_wire["num_returns"]
+        if num_returns == 0:
+            return []
+        if num_returns == 1:
+            values = [result]
+        else:
+            values = list(result)
+            if len(values) != num_returns:
+                raise ValueError(
+                    f"task declared num_returns={num_returns} but returned {len(values)}"
+                )
+        out = []
+        for oid, value in zip(spec_wire["return_ids"], values):
+            serialized = serialization.serialize(value)
+            if serialized.total_size <= config.max_direct_call_object_size:
+                out.append({"inline": serialized.to_bytes()})
+            else:
+                await self.core.plasma.put_serialized(oid, serialized)
+                out.append({"plasma": list(self.core.raylet_addr)})
+        return out
+
+    def _error_payload(self, exc: BaseException) -> bytes:
+        tb = traceback.format_exc()
+        try:
+            exc.task_traceback = tb  # best effort annotation
+        except Exception:
+            pass
+        try:
+            return serialization.serialize(exc).to_bytes()
+        except Exception:
+            return serialization.serialize(
+                TaskError(RuntimeError(repr(exc)), traceback_str=tb)
+            ).to_bytes()
+
+    # -- normal tasks --------------------------------------------------------
+
+    async def handle_push_task(self, conn, p):
+        wire = p["spec"]
+        try:
+            fn = await self.get_function(wire["func_id"])
+            args, kwargs = await self.load_args(wire)
+            if asyncio.iscoroutinefunction(fn):
+                result = await fn(*args, **kwargs)
+            else:
+                loop = asyncio.get_running_loop()
+                result = await loop.run_in_executor(self.pool, lambda: fn(*args, **kwargs))
+            returns = await self.store_returns(wire, result)
+            return {"returns": returns}
+        except BaseException as e:  # noqa: BLE001 - must serialize any failure
+            logger.info("task %s raised: %r", wire.get("name"), e)
+            return {"error": self._error_payload(e)}
+
+    # -- actors --------------------------------------------------------------
+
+    async def handle_create_actor(self, conn, p):
+        wire = p["spec"]
+        self.actor_spec = wire
+        max_c = wire.get("max_concurrency") or 1
+        if max_c > 1:
+            self.pool = concurrent.futures.ThreadPoolExecutor(max_workers=max_c)
+        try:
+            cls = await self.get_function(wire["func_id"])
+            args, kwargs = await self.load_args(wire)
+            loop = asyncio.get_running_loop()
+            self.actor_instance = await loop.run_in_executor(
+                self.pool, lambda: cls(*args, **kwargs)
+            )
+            await self.core.gcs.call(
+                "ReportActorReady",
+                {
+                    "actor_id": wire["actor_id"],
+                    "addr": list(self.core.addr),
+                    "worker_id": self.core.worker_id,
+                    "node_id": self.core.node_id,
+                },
+            )
+            return {"ok": True}
+        except BaseException as e:
+            logger.exception("actor creation failed")
+            await self.core.gcs.call(
+                "ReportActorReady",
+                {
+                    "actor_id": wire["actor_id"],
+                    "error": f"{type(e).__name__}: {e}\n{traceback.format_exc()}",
+                },
+            )
+            return {"ok": False}
+
+    async def handle_push_actor_task(self, conn, p):
+        wire = p["spec"]
+        caller = wire.get("caller_id") or "anon"
+        seq = wire.get("seq_no", -1)
+        ordered = (self.actor_spec or {}).get("max_concurrency", 1) == 1
+        if ordered and seq >= 0:
+            await self._wait_my_turn(caller, seq)
+        try:
+            return await self._run_actor_method(wire)
+        finally:
+            if ordered and seq >= 0:
+                self._advance_seq(caller, seq)
+
+    async def _wait_my_turn(self, caller: str, seq: int) -> None:
+        expected = self.expected_seq.get(caller, 0)
+        if seq <= expected:
+            return
+        fut = asyncio.get_running_loop().create_future()
+        self.pending_seq.setdefault(caller, {})[seq] = fut
+        await fut
+
+    def _advance_seq(self, caller: str, seq: int) -> None:
+        nxt = max(self.expected_seq.get(caller, 0), seq + 1)
+        self.expected_seq[caller] = nxt
+        pending = self.pending_seq.get(caller, {})
+        if nxt in pending:
+            fut = pending.pop(nxt)
+            if not fut.done():
+                fut.set_result(None)
+
+    async def _run_actor_method(self, wire: dict):
+        try:
+            if self.actor_instance is None:
+                raise RuntimeError("actor not initialized")
+            method = getattr(self.actor_instance, wire["actor_method"])
+            args, kwargs = await self.load_args(wire)
+            if asyncio.iscoroutinefunction(method):
+                result = await method(*args, **kwargs)
+            else:
+                loop = asyncio.get_running_loop()
+                result = await loop.run_in_executor(
+                    self.pool, lambda: method(*args, **kwargs)
+                )
+            returns = await self.store_returns(wire, result)
+            return {"returns": returns}
+        except BaseException as e:  # noqa: BLE001
+            if isinstance(e, SystemExit):
+                asyncio.get_running_loop().call_later(0.1, os._exit, 0)
+                return {"error": self._error_payload(RuntimeError("actor exited"))}
+            logger.info("actor method %s raised: %r", wire.get("actor_method"), e)
+            return {"error": self._error_payload(e)}
+
+    async def handle_exit(self, conn, p):
+        asyncio.get_running_loop().call_later(0.05, os._exit, 0)
+        return {"ok": True}
+
+
+async def amain() -> None:
+    raylet_addr = (
+        os.environ["RAY_TPU_RAYLET_HOST"],
+        int(os.environ["RAY_TPU_RAYLET_PORT"]),
+    )
+    gcs_addr = (os.environ["RAY_TPU_GCS_HOST"], int(os.environ["RAY_TPU_GCS_PORT"]))
+    worker_id = os.environ["RAY_TPU_WORKER_ID"]
+    node_id = os.environ["RAY_TPU_NODE_ID"]
+    session = os.environ["RAY_TPU_SESSION"]
+
+    server = rpc.Server("127.0.0.1", 0)
+    addr = await server.start()
+
+    raylet_conn = await rpc.connect(*raylet_addr, handlers=server._handlers)
+    gcs_conn = await rpc.connect(*gcs_addr, handlers=server._handlers)
+
+    core = CoreWorker(
+        job_id=os.environ.get("RAY_TPU_JOB_ID", ""),
+        session_name=session,
+        node_id=node_id,
+        gcs_conn=gcs_conn,
+        raylet_conn=raylet_conn,
+        is_driver=False,
+        worker_id=worker_id,
+        server=server,
+    )
+    core.addr = addr
+    core.raylet_addr = raylet_addr
+    core.start_background()
+
+    executor = Executor(core)
+
+    # Install the sync-facing global worker so user code can call
+    # ray_tpu.get()/put() from inside tasks.
+    from ray_tpu._private import worker as worker_mod
+
+    worker_mod.attach_existing(core, asyncio.get_running_loop())
+
+    reply = await raylet_conn.call(
+        "RegisterWorker", {"worker_id": worker_id, "addr": list(addr)}
+    )
+    core.job_id = core.job_id or reply.get("job_id", "")
+
+    # Exit if the raylet link dies: an unmanaged worker must not linger.
+    while not raylet_conn.closed:
+        await asyncio.sleep(0.5)
+    os._exit(0)
+
+
+def main() -> None:
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"[worker {os.environ.get('RAY_TPU_WORKER_ID', '?')[:8]}] %(message)s",
+    )
+    asyncio.run(amain())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,122 @@
+"""Actor API: ActorClass / ActorHandle / ActorMethod.
+
+Analog of python/ray/actor.py: `@ray_tpu.remote` on a class yields an
+ActorClass; `.remote(...)` asks the GCS to create the actor (GCS owns the
+placement and restart FSM); the returned ActorHandle submits method calls
+directly to the actor worker with per-handle sequence numbers. Handles
+serialize as bare actor ids — any process re-attaches via its own core worker.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu.remote_function import _build_resources, _strategy_fields
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def options(self, *, num_returns: int = 1) -> "ActorMethod":
+        return ActorMethod(self._handle, self._name, num_returns)
+
+    def remote(self, *args, **kwargs):
+        core = worker_mod._core()
+        refs = worker_mod.global_worker.run_async(
+            core.submit_actor_task(
+                self._handle._actor_id,
+                self._name,
+                args,
+                kwargs,
+                num_returns=self._num_returns,
+            )
+        )
+        if self._num_returns == 1:
+            return refs[0]
+        return refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"actor method {self._name!r} cannot be called directly; use .remote()"
+        )
+
+
+class ActorHandle:
+    def __init__(self, actor_id: str):
+        self._actor_id = actor_id
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id[:16]})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id,))
+
+    def __hash__(self):
+        return hash(self._actor_id)
+
+    def __eq__(self, other):
+        return isinstance(other, ActorHandle) and other._actor_id == self._actor_id
+
+
+class ActorClass:
+    def __init__(self, cls, **options):
+        self._cls = cls
+        self._options = options
+        self._pickled: Optional[bytes] = None
+        functools.update_wrapper(self, cls, updated=[])
+
+    def _get_pickled(self) -> bytes:
+        if self._pickled is None:
+            self._pickled = cloudpickle.dumps(self._cls)
+        return self._pickled
+
+    def options(self, **options) -> "ActorClass":
+        merged = {**self._options, **options}
+        clone = ActorClass(self._cls, **merged)
+        clone._pickled = self._pickled
+        return clone
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        opts = self._options
+        core = worker_mod._core()
+        pg_id, bundle_index, strategy = _strategy_fields(opts)
+        resources = _build_resources(opts)
+        actor_id = worker_mod.global_worker.run_async(
+            core.create_actor(
+                self._get_pickled(),
+                opts.get("name_override") or self._cls.__name__,
+                args,
+                kwargs,
+                resources=resources,
+                max_restarts=opts.get("max_restarts", 0),
+                max_concurrency=opts.get("max_concurrency", 1),
+                name=opts.get("name"),
+                namespace=opts.get("namespace") or worker_mod.global_worker.namespace,
+                lifetime=opts.get("lifetime"),
+                get_if_exists=opts.get("get_if_exists", False),
+                pg_id=pg_id,
+                bundle_index=bundle_index,
+                scheduling_strategy=strategy,
+                runtime_env=opts.get("runtime_env"),
+            ),
+            timeout=300,
+        )
+        return ActorHandle(actor_id)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"actor class {self._cls.__name__!r} cannot be instantiated directly; "
+            "use .remote()"
+        )

@@ -1,0 +1,103 @@
+"""@ray_tpu.remote functions.
+
+Analog of python/ray/remote_function.py: RemoteFunction wraps the user function,
+pickles it once, and `_remote` submits through the core worker.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from ray_tpu._private import worker as worker_mod
+
+
+def _build_resources(opts: Dict[str, Any]) -> Dict[str, float]:
+    resources = dict(opts.get("resources") or {})
+    if opts.get("num_cpus") is not None:
+        resources["CPU"] = float(opts["num_cpus"])
+    elif "CPU" not in resources:
+        resources["CPU"] = 1.0
+    if opts.get("num_tpus") is not None:
+        resources["TPU"] = float(opts["num_tpus"])
+    if opts.get("memory") is not None:
+        resources["memory"] = float(opts["memory"])
+    return resources
+
+
+def _strategy_fields(opts):
+    """Extract (pg_id, bundle_index, strategy_dict) from scheduling options."""
+    pg_id, bundle_index, strategy = None, -1, None
+    ss = opts.get("scheduling_strategy")
+    if ss is not None:
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy,
+            PlacementGroupSchedulingStrategy,
+        )
+
+        if isinstance(ss, PlacementGroupSchedulingStrategy):
+            pg_id = ss.placement_group.id_hex
+            bundle_index = ss.placement_group_bundle_index
+        elif isinstance(ss, NodeAffinitySchedulingStrategy):
+            strategy = {"node_id": ss.node_id, "soft": ss.soft}
+        elif isinstance(ss, dict):
+            strategy = ss
+    if opts.get("placement_group") is not None:
+        pg_id = opts["placement_group"].id_hex
+        bundle_index = opts.get("placement_group_bundle_index", -1)
+    return pg_id, bundle_index, strategy
+
+
+class RemoteFunction:
+    def __init__(self, fn, **options):
+        self._fn = fn
+        self._options = options
+        self._pickled: Optional[bytes] = None
+        functools.update_wrapper(self, fn)
+
+    def _get_pickled(self) -> bytes:
+        if self._pickled is None:
+            self._pickled = cloudpickle.dumps(self._fn)
+        return self._pickled
+
+    def options(self, **options) -> "RemoteFunction":
+        merged = {**self._options, **options}
+        clone = RemoteFunction(self._fn, **merged)
+        clone._pickled = self._pickled
+        return clone
+
+    def remote(self, *args, **kwargs):
+        return self._remote(args, kwargs)
+
+    def _remote(self, args, kwargs):
+        opts = self._options
+        core = worker_mod._core()
+        pg_id, bundle_index, strategy = _strategy_fields(opts)
+        refs = worker_mod.global_worker.run_async(
+            core.submit_task(
+                self._get_pickled(),
+                opts.get("name") or getattr(self._fn, "__name__", "task"),
+                args,
+                kwargs,
+                num_returns=opts.get("num_returns", 1),
+                resources=_build_resources(opts),
+                max_retries=opts.get("max_retries"),
+                retry_exceptions=opts.get("retry_exceptions", False),
+                pg_id=pg_id,
+                bundle_index=bundle_index,
+                scheduling_strategy=strategy,
+                runtime_env=opts.get("runtime_env"),
+            )
+        )
+        num_returns = opts.get("num_returns", 1)
+        if num_returns == 1:
+            return refs[0]
+        return refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"remote function {self.__name__!r} cannot be called directly; "
+            "use .remote()"
+        )

@@ -1,0 +1,235 @@
+// ray_tpu._native._shm — POSIX shared-memory segments with zero-copy buffer
+// protocol access.
+//
+// TPU-native analog of the reference's plasma store mmap layer
+// (src/ray/object_manager/plasma/{dlmalloc.cc,plasma_allocator.cc}): the
+// reference subdivides one big mmap with dlmalloc because plasma clients
+// attach a single fd; here each object gets its own shm segment (named by
+// object id) and the kernel does the sharing — the object directory, ref
+// counting and eviction live in the raylet daemon. Buffers are page-aligned
+// by construction, so numpy/jax views over them are aligned for dlpack.
+//
+// Exposed API:
+//   create(name, size)  -> ShmBuffer (read-write, O_CREAT|O_EXCL)
+//   open_ro(name)       -> ShmBuffer (read-only)
+//   open_rw(name)       -> ShmBuffer (read-write, existing)
+//   unlink(name)        -> None
+//   ShmBuffer: buffer protocol, .size, .name, .close()
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <errno.h>
+#include <fcntl.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <string>
+
+namespace {
+
+typedef struct {
+  PyObject_HEAD
+  void* addr;
+  Py_ssize_t size;
+  int writable;
+  int exports;
+  char name[256];
+} ShmBufferObject;
+
+static PyObject* ShmError;
+
+static void ShmBuffer_dealloc(ShmBufferObject* self) {
+  if (self->addr != nullptr && self->addr != MAP_FAILED) {
+    munmap(self->addr, static_cast<size_t>(self->size));
+    self->addr = nullptr;
+  }
+  Py_TYPE(self)->tp_free(reinterpret_cast<PyObject*>(self));
+}
+
+static int ShmBuffer_getbuffer(ShmBufferObject* self, Py_buffer* view, int flags) {
+  if (self->addr == nullptr) {
+    PyErr_SetString(ShmError, "buffer is closed");
+    return -1;
+  }
+  if ((flags & PyBUF_WRITABLE) && !self->writable) {
+    PyErr_SetString(PyExc_BufferError, "shm buffer is read-only");
+    return -1;
+  }
+  int rc = PyBuffer_FillInfo(view, reinterpret_cast<PyObject*>(self), self->addr,
+                             self->size, self->writable ? 0 : 1, flags);
+  if (rc == 0) self->exports++;
+  return rc;
+}
+
+static void ShmBuffer_releasebuffer(ShmBufferObject* self, Py_buffer* view) {
+  (void)view;
+  self->exports--;
+}
+
+static PyBufferProcs ShmBuffer_as_buffer = {
+    reinterpret_cast<getbufferproc>(ShmBuffer_getbuffer),
+    reinterpret_cast<releasebufferproc>(ShmBuffer_releasebuffer),
+};
+
+static PyObject* ShmBuffer_close(ShmBufferObject* self, PyObject* Py_UNUSED(args)) {
+  if (self->exports > 0) {
+    PyErr_SetString(ShmError, "cannot close shm buffer with exported views");
+    return nullptr;
+  }
+  if (self->addr != nullptr && self->addr != MAP_FAILED) {
+    munmap(self->addr, static_cast<size_t>(self->size));
+    self->addr = nullptr;
+  }
+  Py_RETURN_NONE;
+}
+
+static PyObject* ShmBuffer_get_size(ShmBufferObject* self, void*) {
+  return PyLong_FromSsize_t(self->size);
+}
+
+static PyObject* ShmBuffer_get_name(ShmBufferObject* self, void*) {
+  return PyUnicode_FromString(self->name);
+}
+
+static PyObject* ShmBuffer_get_closed(ShmBufferObject* self, void*) {
+  return PyBool_FromLong(self->addr == nullptr);
+}
+
+static PyMethodDef ShmBuffer_methods[] = {
+    {"close", reinterpret_cast<PyCFunction>(ShmBuffer_close), METH_NOARGS,
+     "Unmap the segment. Fails if memoryviews are outstanding."},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+static PyGetSetDef ShmBuffer_getset[] = {
+    {"size", reinterpret_cast<getter>(ShmBuffer_get_size), nullptr, nullptr, nullptr},
+    {"name", reinterpret_cast<getter>(ShmBuffer_get_name), nullptr, nullptr, nullptr},
+    {"closed", reinterpret_cast<getter>(ShmBuffer_get_closed), nullptr, nullptr, nullptr},
+    {nullptr, nullptr, nullptr, nullptr, nullptr},
+};
+
+static PyTypeObject ShmBufferType = {
+    PyVarObject_HEAD_INIT(nullptr, 0) "ray_tpu._native._shm.ShmBuffer", /* tp_name */
+    sizeof(ShmBufferObject),
+};
+
+static ShmBufferObject* make_buffer(const char* name, void* addr, Py_ssize_t size,
+                                    int writable) {
+  ShmBufferObject* self =
+      PyObject_New(ShmBufferObject, &ShmBufferType);
+  if (self == nullptr) return nullptr;
+  self->addr = addr;
+  self->size = size;
+  self->writable = writable;
+  self->exports = 0;
+  strncpy(self->name, name, sizeof(self->name) - 1);
+  self->name[sizeof(self->name) - 1] = '\0';
+  return self;
+}
+
+static PyObject* shm_create(PyObject*, PyObject* args) {
+  const char* name;
+  Py_ssize_t size;
+  if (!PyArg_ParseTuple(args, "sn", &name, &size)) return nullptr;
+  if (size <= 0) {
+    PyErr_SetString(ShmError, "size must be positive");
+    return nullptr;
+  }
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) {
+    PyErr_Format(ShmError, "shm_open(create %s) failed: %s", name, strerror(errno));
+    return nullptr;
+  }
+  if (ftruncate(fd, size) != 0) {
+    PyErr_Format(ShmError, "ftruncate(%s, %zd) failed: %s", name, size, strerror(errno));
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* addr = mmap(nullptr, static_cast<size_t>(size), PROT_READ | PROT_WRITE,
+                    MAP_SHARED, fd, 0);
+  close(fd);
+  if (addr == MAP_FAILED) {
+    PyErr_Format(ShmError, "mmap(%s) failed: %s", name, strerror(errno));
+    shm_unlink(name);
+    return nullptr;
+  }
+  return reinterpret_cast<PyObject*>(make_buffer(name, addr, size, 1));
+}
+
+static PyObject* shm_open_impl(PyObject* args, int writable) {
+  const char* name;
+  if (!PyArg_ParseTuple(args, "s", &name)) return nullptr;
+  int fd = shm_open(name, writable ? O_RDWR : O_RDONLY, 0600);
+  if (fd < 0) {
+    PyErr_Format(ShmError, "shm_open(%s) failed: %s", name, strerror(errno));
+    return nullptr;
+  }
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    PyErr_Format(ShmError, "fstat(%s) failed: %s", name, strerror(errno));
+    close(fd);
+    return nullptr;
+  }
+  void* addr = mmap(nullptr, static_cast<size_t>(st.st_size),
+                    writable ? (PROT_READ | PROT_WRITE) : PROT_READ, MAP_SHARED, fd, 0);
+  close(fd);
+  if (addr == MAP_FAILED) {
+    PyErr_Format(ShmError, "mmap(%s) failed: %s", name, strerror(errno));
+    return nullptr;
+  }
+  return reinterpret_cast<PyObject*>(
+      make_buffer(name, addr, static_cast<Py_ssize_t>(st.st_size), writable));
+}
+
+static PyObject* shm_open_ro(PyObject*, PyObject* args) { return shm_open_impl(args, 0); }
+static PyObject* shm_open_rw(PyObject*, PyObject* args) { return shm_open_impl(args, 1); }
+
+static PyObject* shm_unlink_py(PyObject*, PyObject* args) {
+  const char* name;
+  if (!PyArg_ParseTuple(args, "s", &name)) return nullptr;
+  if (shm_unlink(name) != 0 && errno != ENOENT) {
+    PyErr_Format(ShmError, "shm_unlink(%s) failed: %s", name, strerror(errno));
+    return nullptr;
+  }
+  Py_RETURN_NONE;
+}
+
+static PyMethodDef module_methods[] = {
+    {"create", shm_create, METH_VARARGS, "create(name, size) -> ShmBuffer (rw)"},
+    {"open_ro", shm_open_ro, METH_VARARGS, "open_ro(name) -> ShmBuffer"},
+    {"open_rw", shm_open_rw, METH_VARARGS, "open_rw(name) -> ShmBuffer"},
+    {"unlink", shm_unlink_py, METH_VARARGS, "unlink(name)"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+static struct PyModuleDef shm_module = {
+    PyModuleDef_HEAD_INIT, "_shm",
+    "POSIX shared memory segments with buffer protocol (plasma-lite).",
+    -1, module_methods,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__shm(void) {
+  ShmBufferType.tp_dealloc = reinterpret_cast<destructor>(ShmBuffer_dealloc);
+  ShmBufferType.tp_flags = Py_TPFLAGS_DEFAULT;
+  ShmBufferType.tp_doc = "A mapped POSIX shared-memory segment.";
+  ShmBufferType.tp_as_buffer = &ShmBuffer_as_buffer;
+  ShmBufferType.tp_methods = ShmBuffer_methods;
+  ShmBufferType.tp_getset = ShmBuffer_getset;
+  ShmBufferType.tp_new = nullptr;  // not constructible from Python
+  if (PyType_Ready(&ShmBufferType) < 0) return nullptr;
+
+  PyObject* m = PyModule_Create(&shm_module);
+  if (m == nullptr) return nullptr;
+  ShmError = PyErr_NewException("ray_tpu._native._shm.ShmError", nullptr, nullptr);
+  Py_INCREF(ShmError);
+  PyModule_AddObject(m, "ShmError", ShmError);
+  Py_INCREF(&ShmBufferType);
+  PyModule_AddObject(m, "ShmBuffer", reinterpret_cast<PyObject*>(&ShmBufferType));
+  return m;
+}

@@ -1,0 +1,34 @@
+"""Test fixtures (analog of python/ray/tests/conftest.py).
+
+JAX-facing tests run on a virtual 8-device CPU mesh so multi-chip sharding is
+exercised without TPU hardware; set before any jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS",
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8",
+)
+
+import pytest
+
+
+@pytest.fixture
+def ray_start_regular():
+    """Fresh single-node cluster per test (reference: conftest.py:419)."""
+    import ray_tpu
+
+    info = ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield info
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def shutdown_only():
+    """Test calls init itself; fixture guarantees teardown (conftest.py:336)."""
+    import ray_tpu
+
+    yield None
+    ray_tpu.shutdown()

@@ -114,7 +114,14 @@ class GcsServer:
         self._pending_actor_queue: List[str] = []
         self._wake_scheduler = asyncio.Event()
         self._scheduler_task: Optional[asyncio.Task] = None
+        self._bg_tasks: List[asyncio.Task] = []
         self._register_handlers()
+
+    def _spawn(self, coro) -> asyncio.Task:
+        task = asyncio.create_task(coro)
+        self._bg_tasks.append(task)
+        self._bg_tasks = [t for t in self._bg_tasks if not t.done()]
+        return task
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -128,6 +135,8 @@ class GcsServer:
     async def stop(self) -> None:
         if self._scheduler_task:
             self._scheduler_task.cancel()
+        for t in self._bg_tasks:
+            t.cancel()
         await self.server.stop()
 
     def _register_handlers(self) -> None:
@@ -153,6 +162,7 @@ class GcsServer:
         s.register("JobFinished", self._job_finished)
         s.register("ListJobs", self._list_jobs)
         s.register("CreatePlacementGroup", self._create_pg)
+        s.register("WaitPlacementGroupReady", self._wait_pg_ready)
         s.register("RemovePlacementGroup", self._remove_pg)
         s.register("GetPlacementGroup", self._get_pg)
         s.register("ListPlacementGroups", self._list_pgs)
@@ -210,7 +220,7 @@ class GcsServer:
         for pg in self.placement_groups.values():
             if pg.state == "CREATED" and node_id in pg.bundle_nodes:
                 pg.state = "RESCHEDULING"
-                asyncio.create_task(self._schedule_pg(pg))
+                self._spawn(self._schedule_pg(pg))
 
     # -- actor FSM ----------------------------------------------------------
 
@@ -479,7 +489,7 @@ class GcsServer:
         spec = PlacementGroupSpec.from_wire(p["spec"])
         pg = PlacementGroupInfo(spec)
         self.placement_groups[spec.pg_id] = pg
-        asyncio.create_task(self._schedule_pg(pg))
+        self._spawn(self._schedule_pg(pg))
         if p.get("wait_ready"):
             fut = asyncio.get_running_loop().create_future()
             pg.pending.append(fut)
@@ -507,6 +517,9 @@ class GcsServer:
                 break
             await asyncio.sleep(0.2)
         if pg.state in ("PENDING", "RESCHEDULING"):
+            # Record terminal state so later WaitPlacementGroupReady calls
+            # fail fast instead of parking a future nothing will resolve.
+            pg.state = "INFEASIBLE"
             for fut in pg.pending:
                 if not fut.done():
                     fut.set_exception(
@@ -603,6 +616,25 @@ class GcsServer:
             except rpc.RpcError:
                 pass
         return False
+
+    async def _wait_pg_ready(self, conn, p):
+        pg = self.placement_groups.get(p["pg_id"])
+        if pg is None:
+            raise rpc.RpcError(f"unknown placement group {p['pg_id'][:12]}")
+        if pg.state == "CREATED":
+            return {"pg_id": p["pg_id"], "state": "CREATED"}
+        if pg.state == "REMOVED":
+            raise rpc.RpcError("placement group was removed")
+        if pg.state == "INFEASIBLE":
+            return {"pg_id": p["pg_id"], "state": "INFEASIBLE"}
+        fut = asyncio.get_running_loop().create_future()
+        pg.pending.append(fut)
+        if p.get("timeout") is not None:
+            try:
+                return await asyncio.wait_for(fut, p["timeout"])
+            except asyncio.TimeoutError:
+                return {"pg_id": p["pg_id"], "state": pg.state}
+        return await fut
 
     async def _remove_pg(self, conn, p):
         pg = self.placement_groups.get(p["pg_id"])

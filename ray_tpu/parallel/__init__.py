@@ -1,0 +1,51 @@
+"""ray_tpu.parallel: meshes, sharding rules, and context parallelism.
+
+DP/FSDP/TP/SP are axes of one jax.sharding.Mesh; XLA lowers the collectives
+onto ICI. See mesh.py for axis conventions, ring_attention.py / ulysses.py
+for the long-context primitives.
+"""
+
+from ray_tpu.parallel.mesh import (
+    CANONICAL_ORDER,
+    DATA,
+    EXPERT,
+    FSDP,
+    SEQUENCE,
+    TENSOR,
+    MeshSpec,
+    ShardingRules,
+    batch_sharding,
+    data_parallel_spec,
+    default_transformer_rules,
+    fsdp_sharding_for_leaf,
+    make_mesh,
+    shard_pytree,
+)
+from ray_tpu.parallel.ring_attention import (
+    full_attention,
+    ring_attention,
+    ring_attention_sharded,
+)
+from ray_tpu.parallel.ulysses import ulysses_attention, ulysses_attention_sharded
+
+__all__ = [
+    "DATA",
+    "FSDP",
+    "TENSOR",
+    "SEQUENCE",
+    "EXPERT",
+    "CANONICAL_ORDER",
+    "MeshSpec",
+    "ShardingRules",
+    "make_mesh",
+    "batch_sharding",
+    "data_parallel_spec",
+    "default_transformer_rules",
+    "fsdp_sharding_for_leaf",
+    "shard_pytree",
+    "ring_attention",
+    "ring_attention_sharded",
+    "full_attention",
+    "ulysses_attention",
+    "ulysses_attention_sharded",
+]

@@ -1,0 +1,37 @@
+"""Test helpers: force JAX onto a virtual multi-device CPU mesh.
+
+TPU CI runs with one real chip (or none); sharding logic is validated on an
+N-device CPU mesh via --xla_force_host_platform_device_count. The TPU plugin
+in this image registers itself from sitecustomize and overrides JAX_PLATFORMS,
+so CPU forcing needs both the env knob (for fresh worker processes, where an
+empty PALLAS_AXON_POOL_IPS skips plugin registration) and a config update (for
+an already-running process).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+
+def cpu_mesh_worker_env(num_devices: int = 8) -> Dict[str, str]:
+    """Env for spawned worker processes so jax inside them sees N CPU devices."""
+    return {
+        "PALLAS_AXON_POOL_IPS": "",  # falsy -> TPU plugin registration skipped
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": f"--xla_force_host_platform_device_count={num_devices}",
+    }
+
+
+def force_cpu_mesh(num_devices: int = 8) -> None:
+    """Force the CURRENT process's jax onto N virtual CPU devices.
+
+    Must run before first backend use (first jit/device access).
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    want = f"--xla_force_host_platform_device_count={num_devices}"
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (flags + " " + want).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

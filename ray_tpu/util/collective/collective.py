@@ -1,0 +1,364 @@
+"""Collective communication across actors/tasks.
+
+API surface mirrors the reference's ray.util.collective
+(python/ray/util/collective/collective.py:120-615: init_collective_group,
+allreduce/allgather/reducescatter/broadcast/send/recv/barrier) with TPU-native
+backends instead of NCCL/Gloo:
+
+- "xla": multi-controller JAX. Ranks rendezvous through the GCS KV for a
+  coordinator address, call jax.distributed.initialize, and every collective
+  lowers to a jitted `jax.lax` op over the global device mesh — ICI when the
+  ranks are TPU hosts, the JAX coordination fabric otherwise. This is the
+  performance path; the group IS a mesh.
+- "store": pure control-plane fallback (the pygloo-analog): a named async
+  rendezvous actor reduces numpy payloads. Correct anywhere, including CPU
+  actors; bandwidth-bound by the object path, so use it for small tensors and
+  coordination, not gradient traffic.
+
+Like NCCL, all ranks must issue collectives in the same order; a per-group
+sequence number enforces matching.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+SUM, PRODUCT, MIN, MAX = "sum", "product", "min", "max"
+_OPS = {
+    SUM: lambda arrs: np.sum(arrs, axis=0),
+    PRODUCT: lambda arrs: np.prod(arrs, axis=0),
+    MIN: lambda arrs: np.min(arrs, axis=0),
+    MAX: lambda arrs: np.max(arrs, axis=0),
+}
+
+
+def _store_actor_cls():
+    import ray_tpu
+
+    @ray_tpu.remote
+    class _CollectiveStore:
+        """Async rendezvous actor: one per group; reduces contributions."""
+
+        def __init__(self, world_size: int):
+            import asyncio
+
+            self.world = world_size
+            self.pending: Dict[int, Dict[int, Any]] = {}
+            self.results: Dict[int, Any] = {}
+            self.events: Dict[int, asyncio.Event] = {}
+            self.reads: Dict[int, int] = {}
+            self.p2p: Dict[tuple, Any] = {}
+            self.p2p_events: Dict[tuple, asyncio.Event] = {}
+
+        def _event(self, seq):
+            import asyncio
+
+            if seq not in self.events:
+                self.events[seq] = asyncio.Event()
+            return self.events[seq]
+
+        async def contribute(self, seq: int, rank: int, arr, op: str, mode: str):
+            ev = self._event(seq)
+            bucket = self.pending.setdefault(seq, {})
+            bucket[rank] = arr
+            if len(bucket) == self.world:
+                arrs = [bucket[r] for r in sorted(bucket)]
+                if mode == "allreduce":
+                    self.results[seq] = _OPS[op](np.stack(arrs))
+                elif mode == "allgather":
+                    self.results[seq] = arrs
+                elif mode == "broadcast":
+                    src = int(op)
+                    self.results[seq] = bucket[src]
+                elif mode == "barrier":
+                    self.results[seq] = True
+                elif mode == "reducescatter":
+                    red = _OPS[SUM if op == "barrier" else op](np.stack(arrs))
+                    self.results[seq] = np.array_split(red, self.world, axis=0)
+                del self.pending[seq]
+                ev.set()
+            else:
+                await ev.wait()
+            res = self.results[seq]
+            if mode == "reducescatter":
+                res = res[rank]
+            # Evict once every rank has read its result.
+            self.reads[seq] = self.reads.get(seq, 0) + 1
+            if self.reads[seq] == self.world:
+                self.results.pop(seq, None)
+                self.events.pop(seq, None)
+                self.reads.pop(seq, None)
+            return res
+
+        async def send(self, src: int, dst: int, tag: int, arr):
+            import asyncio
+
+            key = (src, dst, tag)
+            self.p2p[key] = arr
+            if key not in self.p2p_events:
+                self.p2p_events[key] = asyncio.Event()
+            self.p2p_events[key].set()
+
+        async def recv(self, src: int, dst: int, tag: int):
+            import asyncio
+
+            key = (src, dst, tag)
+            if key not in self.p2p_events:
+                self.p2p_events[key] = asyncio.Event()
+            await self.p2p_events[key].wait()
+            arr = self.p2p.pop(key)
+            self.p2p_events.pop(key, None)
+            return arr
+
+    return _CollectiveStore
+
+
+class _Group:
+    def __init__(self, name: str, world_size: int, rank: int, backend: str):
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self.backend = backend
+        self.seq = 0
+        self.store = None  # store backend: actor handle
+        self.mesh = None  # xla backend: global mesh
+        self._jit_cache: Dict[tuple, Any] = {}
+
+    def next_seq(self) -> int:
+        self.seq += 1
+        return self.seq
+
+
+class GroupManager:
+    """Per-process registry (reference: collective.py:40)."""
+
+    def __init__(self):
+        self.groups: Dict[str, _Group] = {}
+
+    def get(self, name: str) -> _Group:
+        if name not in self.groups:
+            raise ValueError(f"collective group {name!r} is not initialized")
+        return self.groups[name]
+
+
+_manager = GroupManager()
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    return group_name in _manager.groups
+
+
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    backend: str = "store",
+    group_name: str = "default",
+) -> None:
+    """Join a collective group. Must be called by every rank (typically from
+    inside each participating actor)."""
+    import ray_tpu
+
+    if group_name in _manager.groups:
+        raise ValueError(f"group {group_name!r} already initialized")
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} out of range for world size {world_size}")
+    group = _Group(group_name, world_size, rank, backend)
+    if backend == "store":
+        cls = _store_actor_cls()
+        group.store = cls.options(
+            name=f"__collective_{group_name}", get_if_exists=True, num_cpus=0.1
+        ).remote(world_size)
+    elif backend == "xla":
+        group.mesh = _init_xla_backend(world_size, rank, group_name)
+    else:
+        raise ValueError(f"unknown collective backend {backend!r}")
+    _manager.groups[group_name] = group
+
+
+def _init_xla_backend(world_size: int, rank: int, group_name: str):
+    """Multi-controller JAX bootstrap: coordinator address rendezvous via GCS
+    KV, jax.distributed.initialize, global 1-axis mesh over all devices."""
+    import socket
+
+    import jax
+
+    import ray_tpu
+    from ray_tpu._private import worker as worker_mod
+
+    core = worker_mod._core()
+    key = f"xla_coord_{group_name}"
+    if rank == 0:
+        # Advertise this node's address (not loopback) so ranks on other
+        # hosts can reach the coordinator; raylet_addr holds the node IP.
+        host = core.raylet_addr[0] if core.raylet_addr else socket.gethostbyname(
+            socket.gethostname()
+        )
+        sock = socket.socket()
+        sock.bind((host if host != "127.0.0.1" else "0.0.0.0", 0))
+        port = sock.getsockname()[1]
+        sock.close()
+        coord = f"{host}:{port}"
+        worker_mod.global_worker.run_async(
+            core.gcs.kv_put(key, coord.encode(), ns="collective")
+        )
+    else:
+        import time
+
+        coord = None
+        for _ in range(300):
+            val = worker_mod.global_worker.run_async(
+                core.gcs.kv_get(key, ns="collective")
+            )
+            if val:
+                coord = val.decode()
+                break
+            time.sleep(0.1)
+        if coord is None:
+            raise TimeoutError("xla collective coordinator rendezvous timed out")
+    jax.distributed.initialize(
+        coordinator_address=coord, num_processes=world_size, process_id=rank
+    )
+    from jax.sharding import Mesh
+
+    devices = np.asarray(jax.devices()).reshape(world_size, -1)
+    return Mesh(devices, ("world", "local"))
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    group = _manager.groups.pop(group_name, None)
+    if group is not None and group.store is not None and group.rank == 0:
+        # Rank 0 reaps the rendezvous actor so a later group with the same
+        # name starts from clean state (fresh seq/result tables, world size).
+        import ray_tpu
+
+        try:
+            ray_tpu.kill(group.store)
+        except Exception:
+            pass
+
+
+def _roundtrip(group: _Group, arr, op: str, mode: str):
+    import ray_tpu
+
+    np_arr = np.asarray(arr)
+    seq = group.next_seq()
+    ref = group.store.contribute.remote(seq, group.rank, np_arr, op, mode)
+    return ray_tpu.get(ref, timeout=300)
+
+
+def _xla_allreduce(group: _Group, arr, op: str):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = group.mesh
+    key = ("allreduce", op, tuple(np.shape(arr)), str(np.asarray(arr).dtype))
+    fn = group._jit_cache.get(key)
+    if fn is None:
+        reducer = {SUM: jnp.sum, PRODUCT: jnp.prod, MIN: jnp.min, MAX: jnp.max}[op]
+
+        @jax.jit
+        def _reduce(g):
+            return reducer(g, axis=0)
+
+        fn = _reduce
+        group._jit_cache[key] = fn
+    local = jnp.asarray(arr)
+    global_shape = (group.world_size,) + local.shape
+    sharding = NamedSharding(mesh, P("world"))
+    garr = jax.make_array_from_single_device_arrays(
+        global_shape,
+        sharding,
+        [jax.device_put(local[None], mesh.local_devices[0])],
+    )
+    out = fn(garr)
+    return np.asarray(jax.device_get(out))
+
+
+def allreduce(tensor, group_name: str = "default", op: str = SUM):
+    """Reduce across all ranks; returns the reduced array on every rank."""
+    group = _manager.get(group_name)
+    if group.backend == "xla":
+        return _xla_allreduce(group, tensor, op)
+    return _roundtrip(group, tensor, op, "allreduce")
+
+
+def allgather(tensor, group_name: str = "default") -> List[np.ndarray]:
+    group = _manager.get(group_name)
+    if group.backend == "xla":
+        # One-hot placement + sum-allreduce: correct on any mesh; XLA fuses
+        # this into an all-gather when profitable.
+        np_arr = np.asarray(tensor)
+        world = group.world_size
+        expanded = np.zeros((world,) + np_arr.shape, dtype=np_arr.dtype)
+        expanded[group.rank] = np_arr
+        out = _xla_allreduce(group, expanded, SUM)
+        return [out[i] for i in range(world)]
+    return _roundtrip(group, tensor, SUM, "allgather")
+
+
+def reducescatter(tensor, group_name: str = "default", op: str = SUM):
+    group = _manager.get(group_name)
+    if group.backend == "xla":
+        red = _xla_allreduce(group, tensor, op)
+        return np.array_split(red, group.world_size, axis=0)[group.rank]
+    return _roundtrip(group, tensor, op, "reducescatter")
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    group = _manager.get(group_name)
+    if group.backend == "xla":
+        np_arr = np.asarray(tensor)
+        contrib = np_arr if group.rank == src_rank else np.zeros_like(np_arr)
+        return _xla_allreduce(group, contrib, SUM)
+    return _roundtrip(group, tensor, str(src_rank), "broadcast")
+
+
+def barrier(group_name: str = "default") -> None:
+    group = _manager.get(group_name)
+    if group.backend == "xla":
+        _xla_allreduce(group, np.zeros(1, dtype=np.float32), SUM)
+        return
+    _roundtrip(group, np.zeros(1), "barrier", "barrier")
+
+
+def send(tensor, dst_rank: int, group_name: str = "default", tag: int = 0) -> None:
+    import ray_tpu
+
+    group = _manager.get(group_name)
+    if group.store is None:
+        raise NotImplementedError(
+            "point-to-point send/recv requires the store backend; on the xla "
+            "backend use in-program ppermute via ray_tpu.parallel"
+        )
+    ray_tpu.get(
+        group.store.send.remote(group.rank, dst_rank, tag, np.asarray(tensor)),
+        timeout=300,
+    )
+
+
+def recv(src_rank: int, group_name: str = "default", tag: int = 0):
+    import ray_tpu
+
+    group = _manager.get(group_name)
+    if group.store is None:
+        raise NotImplementedError(
+            "point-to-point send/recv requires the store backend; on the xla "
+            "backend use in-program ppermute via ray_tpu.parallel"
+        )
+    return ray_tpu.get(
+        group.store.recv.remote(src_rank, group.rank, tag), timeout=300
+    )
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _manager.get(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _manager.get(group_name).world_size

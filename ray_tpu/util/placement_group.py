@@ -1,0 +1,99 @@
+"""Placement groups: gang resource reservations across nodes.
+
+Analog of python/ray/util/placement_group.py: bundles are reserved via the
+GCS's two-phase commit across raylets (reference:
+gcs_placement_group_scheduler.cc); tasks/actors target a bundle via
+PlacementGroupSchedulingStrategy. On TPU pods, a PG with one bundle per host
+carrying the ``TPU`` resource is the gang primitive under the Train layer.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.common import PlacementGroupError, PlacementGroupSpec, ResourceSet
+from ray_tpu._private.ids import PlacementGroupID
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+class PlacementGroup:
+    """Handle to a placement group."""
+
+    def __init__(self, pg_id: str, bundles: List[Dict[str, float]], strategy: str):
+        self.id_hex = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+
+    def ready(self, timeout: Optional[float] = None) -> bool:
+        """Block until all bundles are reserved (2PC committed)."""
+        core = worker_mod._core()
+        try:
+            reply = worker_mod.global_worker.run_async(
+                core.gcs.call(
+                    "WaitPlacementGroupReady",
+                    {"pg_id": self.id_hex, "timeout": timeout},
+                    timeout=None if timeout is None else timeout + 5,
+                ),
+                timeout=None if timeout is None else timeout + 10,
+            )
+        except Exception as e:
+            raise PlacementGroupError(str(e)) from e
+        return reply.get("state") == "CREATED"
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self.ready(timeout)
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self.bundles)
+
+    def __repr__(self):
+        return f"PlacementGroup({self.id_hex[:12]}, {self.strategy}, {len(self.bundles)} bundles)"
+
+
+def placement_group(
+    bundles: List[Dict[str, float]],
+    strategy: str = "PACK",
+    name: str = "",
+    lifetime: Optional[str] = None,
+) -> PlacementGroup:
+    """Create a placement group asynchronously; call .ready() to await it."""
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(f"strategy must be one of {VALID_STRATEGIES}")
+    if not bundles:
+        raise ValueError("placement group needs at least one bundle")
+    for b in bundles:
+        if not b or all(v == 0 for v in b.values()):
+            raise ValueError("each bundle must reserve at least one resource")
+    core = worker_mod._core()
+    pg_id = PlacementGroupID.from_random().hex()
+    pg = PlacementGroup(pg_id, bundles, strategy)
+    spec = PlacementGroupSpec(
+        pg_id=pg_id,
+        bundles=[ResourceSet(b).to_units() for b in bundles],
+        strategy=strategy,
+        name=name,
+        job_id=core.job_id,
+    )
+    worker_mod.global_worker.run_async(
+        core.gcs.call(
+            "CreatePlacementGroup", {"spec": spec.to_wire(), "wait_ready": False}
+        )
+    )
+    return pg
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    core = worker_mod._core()
+    worker_mod.global_worker.run_async(
+        core.gcs.call("RemovePlacementGroup", {"pg_id": pg.id_hex})
+    )
+
+
+def placement_group_table() -> List[dict]:
+    core = worker_mod._core()
+    return worker_mod.global_worker.run_async(core.gcs.call("ListPlacementGroups"))[
+        "pgs"
+    ]

@@ -1,16 +1,17 @@
 """Test fixtures (analog of python/ray/tests/conftest.py).
 
 JAX-facing tests run on a virtual 8-device CPU mesh so multi-chip sharding is
-exercised without TPU hardware; set before any jax import.
+exercised without TPU hardware. The image's TPU plugin self-registers and
+overrides JAX_PLATFORMS, so forcing happens via ray_tpu.testing helpers:
+XLA_FLAGS before any jax import here, jax.config.update in-process, and a
+worker_env for spawned workers.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault(
-    "XLA_FLAGS",
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8",
-)
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
 
 import pytest
 
@@ -21,6 +22,20 @@ def ray_start_regular():
     import ray_tpu
 
     info = ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield info
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cpu_mesh_workers():
+    """Cluster whose workers see 8 virtual CPU 'TPU' devices — used by
+    train/collective tests to emulate an 8-chip host."""
+    import ray_tpu
+    from ray_tpu.testing import cpu_mesh_worker_env
+
+    info = ray_tpu.init(
+        num_cpus=8, num_tpus=8, worker_env=cpu_mesh_worker_env(8)
+    )
     yield info
     ray_tpu.shutdown()
 

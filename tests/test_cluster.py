@@ -1,0 +1,137 @@
+"""Multi-node tests via the in-process Cluster harness
+(models reference python/ray/tests with ray_start_cluster)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util.placement_group import (
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+
+
+@pytest.fixture
+def cluster_3():
+    cluster = Cluster(head_node_args={"num_cpus": 1, "num_tpus": 0})
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    cluster.connect()
+    yield cluster
+    cluster.shutdown()
+
+
+def test_cluster_sees_all_nodes(cluster_3):
+    nodes = ray_tpu.nodes()
+    assert len([n for n in nodes if n["state"] == "ALIVE"]) == 3
+    assert ray_tpu.cluster_resources()["CPU"] == 5.0
+
+
+def test_spillback_scheduling(cluster_3):
+    """Head has 1 CPU; 2-CPU tasks must spill to the bigger nodes."""
+
+    @ray_tpu.remote(num_cpus=2)
+    def where():
+        import os
+
+        return os.environ["RAY_TPU_NODE_ID"]
+
+    node_ids = ray_tpu.get([where.remote() for _ in range(4)])
+    assert len(set(node_ids)) >= 1  # ran somewhere despite head infeasibility
+
+
+def test_cross_node_object_transfer(cluster_3):
+    @ray_tpu.remote(num_cpus=2)
+    def produce():
+        return np.ones((600, 600))  # ~2.9 MB: plasma on producing node
+
+    @ray_tpu.remote(num_cpus=2)
+    def consume(x):
+        return float(x.sum())
+
+    # Force different nodes via node affinity.
+    nodes = [n for n in ray_tpu.nodes() if n["total"].get("CPU", 0) >= 20000]
+    n1, n2 = nodes[0]["node_id"], nodes[1]["node_id"]
+    ref = produce.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(n1)
+    ).remote()
+    out = consume.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(n2)
+    ).remote(ref)
+    assert ray_tpu.get(out, timeout=60) == 360000.0
+
+
+def test_placement_group_spread(cluster_3):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert pg.ready(timeout=30)
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        import os
+
+        return os.environ["RAY_TPU_NODE_ID"]
+
+    a = where.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(pg, 0)
+    ).remote()
+    b = where.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(pg, 1)
+    ).remote()
+    na, nb = ray_tpu.get([a, b], timeout=60)
+    assert na != nb  # strict spread -> distinct nodes
+    remove_placement_group(pg)
+
+
+def test_placement_group_infeasible_times_out(cluster_3):
+    pg = placement_group([{"CPU": 50}], strategy="PACK")
+    assert pg.ready(timeout=2) is False
+
+
+def test_placement_group_table(cluster_3):
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=30)
+    table = placement_group_table()
+    states = {t["pg_id"]: t["state"] for t in table}
+    assert states[pg.id_hex] == "CREATED"
+
+
+def test_actor_on_specific_node(cluster_3):
+    nodes = [n for n in ray_tpu.nodes() if n["total"].get("CPU", 0) >= 20000]
+    target = nodes[0]["node_id"]
+
+    @ray_tpu.remote(num_cpus=1)
+    class A:
+        def where(self):
+            import os
+
+            return os.environ["RAY_TPU_NODE_ID"]
+
+    a = A.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(target)
+    ).remote()
+    assert ray_tpu.get(a.where.remote(), timeout=60) == target
+
+
+def test_node_death_kills_actors(cluster_3):
+    cluster = cluster_3
+    extra = cluster.add_node(num_cpus=1, resources={"special": 1})
+
+    @ray_tpu.remote(num_cpus=1, resources={"special": 1})
+    class Pinned:
+        def ping(self):
+            return "pong"
+
+    a = Pinned.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+    cluster.remove_node(extra)
+    time.sleep(1.0)
+    with pytest.raises((ray_tpu.ActorDiedError, ray_tpu.ActorUnavailableError, ray_tpu.RayTpuError)):
+        ray_tpu.get(a.ping.remote(), timeout=15)

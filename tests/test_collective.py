@@ -1,0 +1,105 @@
+"""Collective group tests over actors (models reference
+python/ray/util/collective tests) using the store backend."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def ray4(shutdown_only):
+    ray_tpu.init(num_cpus=6, num_tpus=0)
+    yield
+
+
+def _worker_cls():
+    @ray_tpu.remote(num_cpus=1)
+    class Rank:
+        def __init__(self, rank, world):
+            from ray_tpu.util import collective as col
+
+            col.init_collective_group(world, rank, backend="store", group_name="g")
+            self.rank = rank
+            self.world = world
+
+        def do_allreduce(self, value):
+            from ray_tpu.util import collective as col
+
+            return col.allreduce(np.full((4,), value, dtype=np.float32), group_name="g")
+
+        def do_allgather(self):
+            from ray_tpu.util import collective as col
+
+            return col.allgather(np.full((2,), self.rank, dtype=np.int64), group_name="g")
+
+        def do_broadcast(self):
+            from ray_tpu.util import collective as col
+
+            val = np.arange(3) if self.rank == 0 else np.zeros(3, dtype=np.int64)
+            return col.broadcast(val, src_rank=0, group_name="g")
+
+        def do_reducescatter(self):
+            from ray_tpu.util import collective as col
+
+            return col.reducescatter(
+                np.arange(8, dtype=np.float32), group_name="g"
+            )
+
+        def do_sendrecv(self):
+            from ray_tpu.util import collective as col
+
+            if self.rank == 0:
+                col.send(np.array([42.0]), dst_rank=1, group_name="g")
+                return None
+            return col.recv(src_rank=0, group_name="g")
+
+        def rank_info(self):
+            from ray_tpu.util import collective as col
+
+            return col.get_rank("g"), col.get_collective_group_size("g")
+
+    return Rank
+
+
+def test_allreduce(ray4):
+    Rank = _worker_cls()
+    actors = [Rank.remote(i, 4) for i in range(4)]
+    outs = ray_tpu.get([a.do_allreduce.remote(float(i)) for i, a in enumerate(actors)], timeout=120)
+    expect = np.full((4,), 0.0 + 1 + 2 + 3, dtype=np.float32)
+    for out in outs:
+        np.testing.assert_array_equal(out, expect)
+
+
+def test_allgather_and_rank(ray4):
+    Rank = _worker_cls()
+    actors = [Rank.remote(i, 2) for i in range(2)]
+    outs = ray_tpu.get([a.do_allgather.remote() for a in actors], timeout=120)
+    for out in outs:
+        assert [int(x[0]) for x in out] == [0, 1]
+    infos = ray_tpu.get([a.rank_info.remote() for a in actors], timeout=60)
+    assert infos == [(0, 2), (1, 2)]
+
+
+def test_broadcast(ray4):
+    Rank = _worker_cls()
+    actors = [Rank.remote(i, 3) for i in range(3)]
+    outs = ray_tpu.get([a.do_broadcast.remote() for a in actors], timeout=120)
+    for out in outs:
+        np.testing.assert_array_equal(out, np.arange(3))
+
+
+def test_reducescatter(ray4):
+    Rank = _worker_cls()
+    actors = [Rank.remote(i, 2) for i in range(2)]
+    outs = ray_tpu.get([a.do_reducescatter.remote() for a in actors], timeout=120)
+    np.testing.assert_array_equal(outs[0], np.arange(4, dtype=np.float32) * 2)
+    np.testing.assert_array_equal(outs[1], np.arange(4, 8, dtype=np.float32) * 2)
+
+
+def test_send_recv(ray4):
+    Rank = _worker_cls()
+    actors = [Rank.remote(i, 2) for i in range(2)]
+    outs = ray_tpu.get([a.do_sendrecv.remote() for a in actors], timeout=120)
+    assert outs[0] is None
+    np.testing.assert_array_equal(outs[1], np.array([42.0]))

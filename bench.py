@@ -1,0 +1,76 @@
+"""Flagship benchmark: ResNet-50 train-step throughput on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}
+
+Baseline: the reference's headline Train-ResNet e2e number, 40.7 images/s on
+one GPU worker (BASELINE.md / doc/source/train/benchmarks.rst:36). Same
+model family + train-step workload (synthetic ImageNet-shape data, bf16),
+so vs_baseline = images_per_sec / 40.7.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+BASELINE_IMAGES_PER_SEC = 40.7  # reference: 1-GPU Train ResNet e2e
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import ResNetConfig, resnet_apply, resnet_init
+
+    platform = jax.devices()[0].platform
+    batch = 256 if platform == "tpu" else 8
+    size = 224 if platform == "tpu" else 64
+    steps = 20 if platform == "tpu" else 3
+
+    cfg = ResNetConfig(depth=50, num_classes=1000, dtype=jnp.bfloat16)
+    params = resnet_init(jax.random.PRNGKey(0), cfg)
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt = tx.init(params)
+
+    def loss_fn(params, images, labels):
+        logits, new_params = resnet_apply(params, images, cfg, train=True)
+        logp = jax.nn.log_softmax(logits)
+        loss = -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+        return loss, new_params
+
+    @jax.jit
+    def step(params, opt, images, labels):
+        (loss, new_params), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params, images, labels)
+        updates, opt = tx.update(grads, opt, params)
+        params = optax.apply_updates(new_params, updates)
+        return params, opt, loss
+
+    images = jax.random.normal(
+        jax.random.PRNGKey(1), (batch, size, size, 3), jnp.bfloat16
+    )
+    labels = jax.random.randint(jax.random.PRNGKey(2), (batch,), 0, 1000)
+
+    # Warmup (compile) then timed steps.
+    params, opt, loss = step(params, opt, images, labels)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt, loss = step(params, opt, images, labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    images_per_sec = batch * steps / dt
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_1chip",
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(images_per_sec / BASELINE_IMAGES_PER_SEC, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -503,6 +503,21 @@ class GcsServer:
             placement = self._place_bundles(spec)
             if placement is not None:
                 ok = await self._try_commit_pg(pg, placement)
+                if pg.state == "REMOVED":
+                    # Removed while the 2PC was in flight: drop the fresh
+                    # reservations instead of resurrecting the PG.
+                    if ok:
+                        for nid in set(placement):
+                            node = self.nodes.get(nid)
+                            if node and node.state == "ALIVE":
+                                try:
+                                    await node.conn.call(
+                                        "ReleasePGBundles",
+                                        {"pg_id": spec.pg_id}, timeout=30,
+                                    )
+                                except rpc.RpcError:
+                                    pass
+                    return
                 if ok:
                     pg.state = "CREATED"
                     pg.bundle_nodes = placement
@@ -603,11 +618,17 @@ class GcsServer:
                 break
             prepared.append(nid)
         else:
+            committed = True
             for nid in prepared:
-                await self.nodes[nid].conn.call(
-                    "CommitPGBundles", {"pg_id": spec.pg_id}, timeout=30
-                )
-            return True
+                try:
+                    await self.nodes[nid].conn.call(
+                        "CommitPGBundles", {"pg_id": spec.pg_id}, timeout=30
+                    )
+                except rpc.RpcError:
+                    committed = False  # node died mid-commit: roll back all
+                    break
+            if committed:
+                return True
         for nid in prepared:  # rollback
             try:
                 await self.nodes[nid].conn.call(
@@ -633,6 +654,8 @@ class GcsServer:
             try:
                 return await asyncio.wait_for(fut, p["timeout"])
             except asyncio.TimeoutError:
+                if fut in pg.pending:
+                    pg.pending.remove(fut)
                 return {"pg_id": p["pg_id"], "state": pg.state}
         return await fut
 
@@ -641,6 +664,11 @@ class GcsServer:
         if pg is None:
             return {"ok": False}
         pg.state = "REMOVED"
+        # Wake any WaitPlacementGroupReady waiters parked while pending.
+        for fut in pg.pending:
+            if not fut.done():
+                fut.set_exception(rpc.RpcError("placement group was removed"))
+        pg.pending.clear()
         for nid in set(n for n in pg.bundle_nodes if n):
             node = self.nodes.get(nid)
             if node and node.state == "ALIVE":

@@ -1,0 +1,290 @@
+"""Decoder-only transformer (GPT family), designed mesh-first.
+
+The flagship model: pre-norm decoder blocks with RoPE, grouped-query
+attention, SwiGLU MLP, bf16 compute / f32 master weights. Layers are stacked
+into one pytree and iterated with `lax.scan`, so compile time is O(1) in
+depth and XLA pipelines the weight prefetch.
+
+Parallelism (ray_tpu.parallel.mesh axes):
+  data/fsdp — batch split; fsdp additionally shards params (ZeRO-3 style)
+  tensor    — heads + mlp hidden + vocab split (Megatron layout)
+  sequence  — context parallelism; attention switches to ring_attention
+
+Capability analog of what the reference reaches only through integrations
+(SURVEY §5 long-context note: reference ships no native SP); here it is
+native. Reference GPT-2 fine-tune workload: BASELINE.json config #5.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ray_tpu.ops.flash_attention import mha
+from ray_tpu.ops.fused import fused_rmsnorm, softmax_cross_entropy
+from ray_tpu.parallel import mesh as mesh_lib
+from ray_tpu.parallel.ring_attention import ring_attention
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32_000
+    d_model: int = 512
+    n_layers: int = 6
+    n_heads: int = 8
+    n_kv_heads: Optional[int] = None  # None => MHA
+    d_ff: Optional[int] = None  # None => 4 * d_model (SwiGLU sized 2/3)
+    max_seq_len: int = 2048
+    rope_theta: float = 10_000.0
+    dtype: Any = jnp.bfloat16  # compute/activation dtype
+    remat: bool = False  # jax.checkpoint each block
+    attention_impl: str = "auto"  # auto | pallas | xla | ring
+    norm_eps: float = 1e-6
+    tied_embeddings: bool = True
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def ff_dim(self) -> int:
+        if self.d_ff is not None:
+            return self.d_ff
+        return int(8 * self.d_model / 3 + 127) // 128 * 128  # SwiGLU, 128-mult
+
+
+# ------------------------------------------------------------------ params
+
+def transformer_init(rng, cfg: TransformerConfig) -> Dict[str, Any]:
+    """f32 master params. Block params are stacked on a leading layer axis."""
+    k_emb, k_blk, k_out = jax.random.split(rng, 3)
+    d, h, hk, dh, f = (
+        cfg.d_model, cfg.n_heads, cfg.kv_heads, cfg.head_dim, cfg.ff_dim,
+    )
+
+    def dense(key, shape, fan_in):
+        return jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)
+
+    L = cfg.n_layers
+    ks = jax.random.split(k_blk, 7)
+    blocks = {
+        "attn_norm": jnp.ones((L, d), jnp.float32),
+        "wq": dense(ks[0], (L, d, h * dh), d),
+        "wk": dense(ks[1], (L, d, hk * dh), d),
+        "wv": dense(ks[2], (L, d, hk * dh), d),
+        "wo": dense(ks[3], (L, h * dh, d), h * dh),
+        "mlp_norm": jnp.ones((L, d), jnp.float32),
+        "w_gate": dense(ks[4], (L, d, f), d),
+        "w_up": dense(ks[5], (L, d, f), d),
+        "w_down": dense(ks[6], (L, f, d), f),
+    }
+    params = {
+        "embed": jax.random.normal(
+            k_emb, (cfg.vocab_size, d), jnp.float32
+        ) * 0.02,
+        "blocks": blocks,
+        "final_norm": jnp.ones((d,), jnp.float32),
+    }
+    if not cfg.tied_embeddings:
+        params["unembed"] = dense(k_out, (d, cfg.vocab_size), d)
+    return params
+
+
+_LOGICAL_AXES = {
+    "embed": ("vocab", "embed"),
+    "unembed": ("embed", "vocab"),
+    "final_norm": (None,),
+    "blocks": {
+        "attn_norm": ("layers", None),
+        "wq": ("layers", "embed", "heads"),
+        "wk": ("layers", "embed", "kv"),
+        "wv": ("layers", "embed", "kv"),
+        "wo": ("layers", "heads", "embed"),
+        "mlp_norm": ("layers", None),
+        "w_gate": ("layers", "embed", "mlp"),
+        "w_up": ("layers", "embed", "mlp"),
+        "w_down": ("layers", "mlp", "embed"),
+    },
+}
+
+
+def param_shardings(mesh, cfg: TransformerConfig):
+    """NamedSharding pytree matching transformer_init's structure, derived
+    from the logical-axis table + default_transformer_rules."""
+    rules = mesh_lib.default_transformer_rules(mesh)
+
+    def build(node):
+        if isinstance(node, dict):
+            return {k: build(v) for k, v in node.items()}
+        return NamedSharding(mesh, rules.spec(node))
+
+    table = dict(_LOGICAL_AXES)
+    if cfg.tied_embeddings:
+        table.pop("unembed", None)
+    return build(table)
+
+
+# ----------------------------------------------------------------- forward
+
+def _rope(x, positions, theta: float):
+    """Rotary embedding on [B, T, H, Dh] with integer positions [B, T]."""
+    B, T, H, Dh = x.shape
+    half = Dh // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, T, half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    ).astype(x.dtype)
+
+
+def _attention(q, k, v, cfg: TransformerConfig, seq_axis: Optional[str],
+               seq_size: int):
+    if cfg.attention_impl == "ring" and seq_axis is not None:
+        # Inside shard_map over the sequence axis: exact ring attention.
+        rep = cfg.n_heads // k.shape[2]
+        if rep > 1:
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        return ring_attention(
+            q, k, v, axis_name=seq_axis, axis_size=seq_size, causal=True
+        )
+    return mha(q, k, v, causal=True, impl=(
+        cfg.attention_impl if cfg.attention_impl in ("pallas", "xla") else "auto"
+    ))
+
+
+def _block(x, blk, positions, cfg: TransformerConfig,
+           seq_axis: Optional[str], seq_size: int):
+    B, T, d = x.shape
+    h, hk, dh = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    dt = cfg.dtype
+
+    y = fused_rmsnorm(x, blk["attn_norm"], eps=cfg.norm_eps)
+    q = (y @ blk["wq"].astype(dt)).reshape(B, T, h, dh)
+    k = (y @ blk["wk"].astype(dt)).reshape(B, T, hk, dh)
+    v = (y @ blk["wv"].astype(dt)).reshape(B, T, hk, dh)
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    o = _attention(q, k, v, cfg, seq_axis, seq_size)
+    x = x + o.reshape(B, T, h * dh) @ blk["wo"].astype(dt)
+
+    y = fused_rmsnorm(x, blk["mlp_norm"], eps=cfg.norm_eps)
+    gate = jax.nn.silu(y @ blk["w_gate"].astype(dt))
+    up = y @ blk["w_up"].astype(dt)
+    x = x + (gate * up) @ blk["w_down"].astype(dt)
+    return x
+
+
+def transformer_apply(params, tokens, cfg: TransformerConfig,
+                      positions=None, seq_axis: Optional[str] = None,
+                      seq_size: int = 1):
+    """Forward: [B, T] int32 tokens -> [B, T, vocab] logits (f32).
+
+    When called under shard_map with the sequence sharded, pass seq_axis and
+    positions holding GLOBAL positions so RoPE and causal masks are correct.
+    """
+    B, T = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    x = params["embed"].astype(cfg.dtype)[tokens]
+
+    blk_fn = partial(_block, cfg=cfg, seq_axis=seq_axis, seq_size=seq_size)
+    if cfg.remat:
+        blk_fn = jax.checkpoint(blk_fn, static_argnums=())
+
+    def scan_body(x, blk):
+        return blk_fn(x, blk, positions), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+    x = fused_rmsnorm(x, params["final_norm"], eps=cfg.norm_eps)
+    unembed = (
+        params["embed"].T if cfg.tied_embeddings else params["unembed"]
+    )
+    return (x @ unembed.astype(cfg.dtype)).astype(jnp.float32)
+
+
+def transformer_loss(params, batch, cfg: TransformerConfig, **kw):
+    """Next-token CE. batch: {'tokens': [B, T+1] or ('tokens','targets')}."""
+    if "targets" in batch:
+        tokens, targets = batch["tokens"], batch["targets"]
+    else:
+        tokens, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
+    logits = transformer_apply(params, tokens, cfg, **kw)
+    loss, _ = softmax_cross_entropy(logits, targets)
+    return loss
+
+
+# -------------------------------------------------------------- train step
+
+def make_train_step(cfg: TransformerConfig, mesh, optimizer=None):
+    """Build (init_state, step) jitted over the mesh.
+
+    state = {'params': f32 sharded, 'opt': optax state, 'step': scalar}
+    step(state, batch) -> (state, metrics); params/opt donated.
+
+    DP/FSDP/TP come from the in/out shardings (XLA inserts psum /
+    all-gather / reduce-scatter over ICI); if the mesh has a 'sequence'
+    axis the batch spec additionally shards T.
+    """
+    import optax
+
+    if optimizer is None:
+        optimizer = optax.adamw(3e-4, weight_decay=0.01)
+
+    p_shard = param_shardings(mesh, cfg)
+    names = mesh.axis_names
+    batch_axes = tuple(a for a in ("data", "fsdp") if a in names) or None
+    seq_ax = "sequence" if "sequence" in names else None
+    tok_sharding = NamedSharding(mesh, P(batch_axes, seq_ax))
+    repl = NamedSharding(mesh, P())
+
+    def init_state(rng):
+        params = transformer_init(rng, cfg)
+        params = jax.tree.map(
+            lambda x, s: jax.device_put(x, s), params, p_shard
+        )
+        opt = optimizer.init(params)
+        return {"params": params, "opt": opt,
+                "step": jnp.zeros((), jnp.int32)}
+
+    def loss_fn(params, batch):
+        return transformer_loss(params, batch, cfg)
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"], batch)
+        updates, opt = optimizer.update(
+            grads, state["opt"], state["params"]
+        )
+        params = optax.apply_updates(state["params"], updates)
+        gnorm = optax.global_norm(grads)
+        return (
+            {"params": params, "opt": opt, "step": state["step"] + 1},
+            {"loss": loss, "grad_norm": gnorm},
+        )
+
+    return init_state, step, {"tokens": tok_sharding, "replicated": repl,
+                              "params": p_shard}
+
+
+def flops_per_token(cfg: TransformerConfig, seq_len: int) -> float:
+    """Approximate train FLOPs/token (6ND rule + attention quadratic term)."""
+    d, f, L = cfg.d_model, cfg.ff_dim, cfg.n_layers
+    h, hk, dh = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    per_layer = 2 * d * (h * dh + 2 * hk * dh) + 2 * h * dh * d + 2 * 3 * d * f
+    attn = 2 * 2 * h * dh * seq_len  # qk^T + pv, causal halves then bwd doubles
+    embed = 2 * d * cfg.vocab_size
+    return 3 * (L * (per_layer + attn) + embed)

@@ -1,0 +1,39 @@
+"""Small fused ops: RMSNorm and large-vocab cross entropy.
+
+XLA already fuses most elementwise chains into neighboring matmuls; these
+exist for the two spots where explicit control wins: (a) RMSNorm in f32 on
+bf16 activations without an f32 round-trip through HBM, (b) cross entropy
+that never materializes [B*T, V] probabilities in f32.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def fused_rmsnorm(x, weight, *, eps: float = 1e-6):
+    """RMSNorm with f32 statistics on any-dtype input; output in input dtype."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    y = xf * jax.lax.rsqrt(var + eps)
+    return (y * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def softmax_cross_entropy(logits, labels, *, ignore_index: int = -100):
+    """Token-level CE on [..., V] logits and integer labels.
+
+    Computed as logsumexp - label_logit in f32 without forming probabilities;
+    positions equal to ignore_index contribute 0 and are excluded from the
+    mean. Returns (mean_loss, valid_token_count).
+    """
+    lf = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(lf, axis=-1)
+    label_safe = jnp.where(labels == ignore_index, 0, labels)
+    picked = jnp.take_along_axis(
+        lf, label_safe[..., None], axis=-1
+    ).squeeze(-1)
+    per_tok = lse - picked
+    mask = (labels != ignore_index).astype(jnp.float32)
+    n = jnp.maximum(mask.sum(), 1.0)
+    return (per_tok * mask).sum() / n, n

@@ -62,7 +62,14 @@ def make_mesh(
     devices: Optional[Sequence] = None,
 ):
     """Build a jax.sharding.Mesh from an axis spec over the given devices
-    (defaults to all). `axes=None` -> pure data-parallel mesh."""
+    (defaults to all). `axes=None` -> pure data-parallel mesh.
+
+    Canonical axes (data/fsdp/expert/sequence/tensor) are ALWAYS laid out in
+    CANONICAL_ORDER regardless of dict order, so tensor/sequence collectives
+    ride the innermost (fastest) ICI groups; non-canonical axis names keep
+    their given order, outermost. Pass a pre-shaped `jax.sharding.Mesh`
+    directly to downstream APIs if full manual control over device placement
+    is needed."""
     import jax
     from jax.sharding import Mesh
 
@@ -72,6 +79,13 @@ def make_mesh(
     if axes is None:
         axes = {DATA: len(devices)}
     resolved = MeshSpec(dict(axes)).resolve(len(devices))
+    # Canonical placement: known axes ordered so tensor/sequence land
+    # innermost (fastest ICI); unknown axes keep user order, outermost.
+    resolved = dict(sorted(
+        resolved.items(),
+        key=lambda kv: CANONICAL_ORDER.index(kv[0])
+        if kv[0] in CANONICAL_ORDER else -1,
+    ))
     names = tuple(resolved.keys())
     shape = tuple(resolved.values())
     dev_array = np.asarray(devices).reshape(shape)

@@ -136,14 +136,8 @@ def full_attention(q, k, v, causal: bool = False, scale: Optional[float] = None)
         S = k.shape[1]
         mask = jnp.arange(T)[:, None] >= jnp.arange(S)[None, :]
         scores = jnp.where(mask[None, None], scores, _BIG_NEG)
-    p = _softmax(scores)
+    import jax
+
+    p = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhts,bshd->bthd", p, v.astype(jnp.float32))
     return out.astype(q.dtype)
-
-
-def _softmax(x):
-    import jax.numpy as jnp
-
-    m = x.max(axis=-1, keepdims=True)
-    e = jnp.exp(x - m)
-    return e / e.sum(axis=-1, keepdims=True)

@@ -98,8 +98,9 @@ def _store_actor_cls():
         async def send(self, src: int, dst: int, tag: int, arr):
             import asyncio
 
+            # Per-key FIFO so back-to-back sends never overwrite each other.
             key = (src, dst, tag)
-            self.p2p[key] = arr
+            self.p2p.setdefault(key, []).append(arr)
             if key not in self.p2p_events:
                 self.p2p_events[key] = asyncio.Event()
             self.p2p_events[key].set()
@@ -108,11 +109,16 @@ def _store_actor_cls():
             import asyncio
 
             key = (src, dst, tag)
-            if key not in self.p2p_events:
-                self.p2p_events[key] = asyncio.Event()
-            await self.p2p_events[key].wait()
-            arr = self.p2p.pop(key)
-            self.p2p_events.pop(key, None)
+            while not self.p2p.get(key):
+                if key not in self.p2p_events:
+                    self.p2p_events[key] = asyncio.Event()
+                await self.p2p_events[key].wait()
+                self.p2p_events[key].clear()
+            queue = self.p2p[key]
+            arr = queue.pop(0)
+            if not queue:
+                self.p2p.pop(key, None)
+                self.p2p_events.pop(key, None)
             return arr
 
     return _CollectiveStore
@@ -231,13 +237,31 @@ def _init_xla_backend(world_size: int, rank: int, group_name: str):
 
 def destroy_collective_group(group_name: str = "default") -> None:
     group = _manager.groups.pop(group_name, None)
-    if group is not None and group.store is not None and group.rank == 0:
-        # Rank 0 reaps the rendezvous actor so a later group with the same
-        # name starts from clean state (fresh seq/result tables, world size).
-        import ray_tpu
+    if group is not None and group.backend == "xla":
+        # Tear down the jax.distributed runtime so a later xla group can
+        # initialize again in this process.
+        import jax
 
         try:
-            ray_tpu.kill(group.store)
+            jax.distributed.shutdown()
+        except Exception:
+            pass
+    if group is not None and group.rank == 0:
+        # Rank 0 reaps the rendezvous state so a later group with the same
+        # name starts clean (fresh seq/result tables, coordinator address).
+        import ray_tpu
+        from ray_tpu._private import worker as worker_mod
+
+        if group.store is not None:
+            try:
+                ray_tpu.kill(group.store)
+            except Exception:
+                pass
+        try:
+            core = worker_mod._core()
+            worker_mod.global_worker.run_async(
+                core.gcs.kv_del(f"xla_coord_{group_name}", ns="collective")
+            )
         except Exception:
             pass
 
@@ -271,10 +295,12 @@ def _xla_allreduce(group: _Group, arr, op: str):
     local = jnp.asarray(arr)
     global_shape = (group.world_size,) + local.shape
     sharding = NamedSharding(mesh, P("world"))
+    # P("world") replicates over the "local" axis, so every addressable
+    # device in this process's mesh row needs a copy of the shard.
     garr = jax.make_array_from_single_device_arrays(
         global_shape,
         sharding,
-        [jax.device_put(local[None], mesh.local_devices[0])],
+        [jax.device_put(local[None], d) for d in mesh.local_devices],
     )
     out = fn(garr)
     return np.asarray(jax.device_get(out))

@@ -67,6 +67,8 @@ def placement_group(
     for b in bundles:
         if not b or all(v == 0 for v in b.values()):
             raise ValueError("each bundle must reserve at least one resource")
+        if any(v < 0 for v in b.values()):
+            raise ValueError("bundle resource amounts must be non-negative")
     core = worker_mod._core()
     pg_id = PlacementGroupID.from_random().hex()
     pg = PlacementGroup(pg_id, bundles, strategy)

@@ -107,6 +107,9 @@ def test_wait(ray_start_regular):
         time.sleep(5)
         return "slow"
 
+    # Warm the worker pool so cold-start latency can't eat the wait window.
+    assert ray_tpu.get(fast.remote()) == "fast"
+
     f, s = fast.remote(), slow.remote()
     ready, not_ready = ray_tpu.wait([f, s], num_returns=1, timeout=4)
     assert ready == [f]

@@ -45,7 +45,15 @@ def test_spillback_scheduling(cluster_3):
         return os.environ["RAY_TPU_NODE_ID"]
 
     node_ids = ray_tpu.get([where.remote() for _ in range(4)])
-    assert len(set(node_ids)) >= 1  # ran somewhere despite head infeasibility
+    # The 1-CPU head can never host a 2-CPU task: all must have spilled to
+    # the 2-CPU nodes.
+    from ray_tpu._private.common import ResourceSet
+
+    small_nodes = {
+        n["node_id"] for n in ray_tpu.nodes()
+        if ResourceSet.from_units(n["total"]).to_dict().get("CPU", 0) < 2
+    }
+    assert small_nodes and not (small_nodes & set(node_ids))
 
 
 def test_cross_node_object_transfer(cluster_3):

@@ -1,0 +1,22 @@
+"""Shared AIR-style configuration layer (reference: python/ray/air).
+
+Holds the config dataclasses used by both train and tune:
+ScalingConfig / RunConfig / FailureConfig / CheckpointConfig
+(reference: python/ray/air/config.py) and the terminal Result object.
+"""
+
+from ray_tpu.air.config import (
+    CheckpointConfig,
+    FailureConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+
+__all__ = [
+    "ScalingConfig",
+    "RunConfig",
+    "FailureConfig",
+    "CheckpointConfig",
+    "Result",
+]

@@ -1,0 +1,108 @@
+"""Run/scaling/failure/checkpoint configs (reference: python/ray/air/config.py).
+
+TPU-first deviation: ScalingConfig thinks in *hosts* — one train worker per
+TPU host (multi-controller JAX), each owning all local chips, with intra-host
+parallelism expressed as mesh axes rather than extra workers. `use_tpu` plays
+the role the reference's `use_gpu` does.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class ScalingConfig:
+    """How many train workers and what each reserves.
+
+    reference: python/ray/air/config.py ScalingConfig (num_workers/use_gpu/
+    resources_per_worker/placement_strategy).
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    # TPU-native extras: chips each worker (host) owns, and the topology
+    # (e.g. "v5e-64") used to pick the per-pod gang resource.
+    tpu_chips_per_worker: int = 0
+    topology: Optional[str] = None
+
+    def _worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        if "CPU" not in res:
+            res["CPU"] = 1.0
+        if self.use_tpu and "TPU" not in res:
+            res["TPU"] = float(self.tpu_chips_per_worker or 1)
+        return res
+
+    def as_placement_group_bundles(self) -> List[Dict[str, float]]:
+        return [self._worker_resources() for _ in range(self.num_workers)]
+
+    @property
+    def total_resources(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for b in self.as_placement_group_bundles():
+            for k, v in b.items():
+                out[k] = out.get(k, 0.0) + v
+        return out
+
+
+@dataclass
+class FailureConfig:
+    """Retries for whole training runs (reference: air/config.py FailureConfig).
+
+    On TPU a slice is all-or-nothing: any worker death tears down the gang, so
+    retry = re-gang the whole worker group and resume from the latest
+    checkpoint (SURVEY.md §7 'Gang semantics') — not per-worker restart.
+    """
+
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    """Top-K retention (reference: air/config.py CheckpointConfig)."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+    def __post_init__(self):
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be 'max' or 'min'")
+        if self.num_to_keep is not None and self.num_to_keep <= 0:
+            raise ValueError("num_to_keep must be positive or None")
+
+
+@dataclass
+class RunConfig:
+    """Experiment-level settings (reference: air/config.py RunConfig)."""
+
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    verbose: int = 1
+
+    def resolved_storage_path(self) -> str:
+        return self.storage_path or os.path.join(
+            os.path.expanduser("~"), "ray_tpu_results"
+        )
+
+
+@dataclass
+class Result:
+    """Terminal state of a run/trial (reference: air/result.py Result)."""
+
+    metrics: Optional[Dict[str, Any]]
+    checkpoint: Optional[Any]  # train.Checkpoint
+    path: Optional[str]
+    error: Optional[BaseException] = None
+    metrics_history: List[Dict[str, Any]] = field(default_factory=list)
+
+    @property
+    def best_checkpoints(self):
+        return getattr(self, "_best_checkpoints", [])

@@ -1,0 +1,43 @@
+"""ray_tpu.train — distributed training orchestration (reference:
+python/ray/train)."""
+
+from ray_tpu.air.config import (
+    CheckpointConfig,
+    FailureConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train._backend_executor import (
+    Backend,
+    BackendConfig,
+    JaxConfig,
+    TrainingFailedError,
+)
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train._session import (
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
+from ray_tpu.train.base_trainer import BaseTrainer, DataParallelTrainer
+
+__all__ = [
+    "Checkpoint",
+    "CheckpointConfig",
+    "FailureConfig",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "Backend",
+    "BackendConfig",
+    "JaxConfig",
+    "TrainingFailedError",
+    "BaseTrainer",
+    "DataParallelTrainer",
+    "report",
+    "get_checkpoint",
+    "get_context",
+    "get_dataset_shard",
+]

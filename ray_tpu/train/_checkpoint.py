@@ -1,0 +1,81 @@
+"""Checkpoint: a handle to a directory of files (reference:
+python/ray/train/_checkpoint.py:56 — `Checkpoint` is a path + filesystem,
+not an in-memory blob).
+
+Local filesystems only need the path; remote URIs go through pyarrow.fs the
+same way the reference routes them (train/_internal/storage.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import shutil
+import tempfile
+import uuid
+from typing import Iterator, Optional
+
+
+def _parse_uri(path: str):
+    """Return (pyarrow.fs.FileSystem, fs_path) for a path or URI."""
+    import pyarrow.fs as pafs
+
+    if "://" in path:
+        return pafs.FileSystem.from_uri(path)
+    return pafs.LocalFileSystem(), os.path.abspath(path)
+
+
+class Checkpoint:
+    """Directory-of-files checkpoint handle."""
+
+    def __init__(self, path: str, filesystem=None):
+        self.path = path
+        if filesystem is None:
+            filesystem, self.fs_path = _parse_uri(path)
+        else:
+            self.fs_path = path
+        self.filesystem = filesystem
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(os.path.abspath(path))
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        """Materialize the checkpoint into a local directory."""
+        dest = path or os.path.join(
+            tempfile.gettempdir(), f"ckpt_{uuid.uuid4().hex[:12]}"
+        )
+        os.makedirs(dest, exist_ok=True)
+        import pyarrow.fs as pafs
+
+        if isinstance(self.filesystem, pafs.LocalFileSystem):
+            if os.path.abspath(self.fs_path) != os.path.abspath(dest):
+                shutil.copytree(self.fs_path, dest, dirs_exist_ok=True)
+        else:
+            pafs.copy_files(
+                self.fs_path, dest, source_filesystem=self.filesystem
+            )
+        return dest
+
+    @contextlib.contextmanager
+    def as_directory(self) -> Iterator[str]:
+        """Context manager yielding a local directory view of the checkpoint."""
+        import pyarrow.fs as pafs
+
+        if isinstance(self.filesystem, pafs.LocalFileSystem):
+            yield self.fs_path
+        else:
+            tmp = self.to_directory()
+            try:
+                yield tmp
+            finally:
+                shutil.rmtree(tmp, ignore_errors=True)
+
+    def __repr__(self):
+        return f"Checkpoint(path={self.path!r})"
+
+    def __eq__(self, other):
+        return isinstance(other, Checkpoint) and other.path == self.path
+
+    def __hash__(self):
+        return hash(self.path)

@@ -1,0 +1,25 @@
+"""JaxTrainer — the flagship trainer (reference analog: TorchTrainer,
+python/ray/train/torch/torch_trainer.py:11; TPU-native per SURVEY.md §7
+step 6: one worker per TPU host, train step is one pjit/shard_map program).
+
+    from ray_tpu.train.jax import JaxTrainer
+    from ray_tpu.air import ScalingConfig
+
+    def train_fn(config):
+        mesh = ray_tpu.parallel.make_mesh(...)   # local chips of this host
+        ...
+        ray_tpu.train.report({"loss": loss}, checkpoint=ckpt)
+
+    JaxTrainer(train_fn, scaling_config=ScalingConfig(num_workers=4,
+               use_tpu=True)).fit()
+"""
+
+from ray_tpu.train._backend_executor import JaxConfig
+from ray_tpu.train.base_trainer import DataParallelTrainer
+
+
+class JaxTrainer(DataParallelTrainer):
+    _default_backend_config = JaxConfig
+
+
+__all__ = ["JaxTrainer", "JaxConfig"]

@@ -1,0 +1,150 @@
+"""ray_tpu.train tests (reference model: python/ray/train/tests)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.air import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+from ray_tpu.train import Checkpoint, TrainingFailedError
+from ray_tpu.train.base_trainer import _CheckpointManager, _shard_datasets
+from ray_tpu.train.jax import JaxTrainer
+
+
+def test_jax_trainer_reports_and_context(ray_start_regular, tmp_path):
+    def train_fn(config):
+        ctx = train.get_context()
+        for i in range(config["steps"]):
+            train.report(
+                {
+                    "step": i,
+                    "rank": ctx.get_world_rank(),
+                    "world": ctx.get_world_size(),
+                }
+            )
+
+    trainer = JaxTrainer(
+        train_fn,
+        train_loop_config={"steps": 3},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="t_basic", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert len(result.metrics_history) == 3
+    assert result.metrics["step"] == 2
+    assert result.metrics["world"] == 2
+    assert result.metrics["rank"] == 0  # driver keeps rank-0 metrics
+
+
+def test_checkpoint_save_and_resume(ray_start_regular, tmp_path):
+    def train_fn(config):
+        import json
+        import tempfile
+
+        start = 0
+        ckpt = train.get_checkpoint()
+        if ckpt:
+            with ckpt.as_directory() as d:
+                start = json.load(open(os.path.join(d, "state.json")))["step"] + 1
+        for i in range(start, start + 2):
+            if train.get_context().get_world_rank() == 0:
+                with tempfile.TemporaryDirectory() as d:
+                    json.dump({"step": i}, open(os.path.join(d, "state.json"), "w"))
+                    train.report({"step": i}, checkpoint=Checkpoint.from_directory(d))
+            else:
+                train.report({"step": i})
+
+    trainer = JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="t_ckpt", storage_path=str(tmp_path)),
+    )
+    r1 = trainer.fit()
+    assert r1.checkpoint is not None
+    assert os.path.exists(os.path.join(r1.checkpoint.path, "state.json"))
+
+    trainer2 = JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="t_ckpt2", storage_path=str(tmp_path)),
+        resume_from_checkpoint=r1.checkpoint,
+    )
+    r2 = trainer2.fit()
+    # resumed from step 1 -> steps 2,3
+    assert [m["step"] for m in r2.metrics_history] == [2, 3]
+
+
+def test_collective_across_train_workers(ray_start_regular, tmp_path):
+    def train_fn(config):
+        from ray_tpu.util import collective
+
+        ctx = train.get_context()
+        group = ctx.get_collective_group()
+        assert group is not None
+        out = collective.allreduce(
+            np.array([float(ctx.get_world_rank() + 1)]), group_name=group
+        )
+        train.report({"sum": float(np.asarray(out)[0])})
+
+    result = JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="t_coll", storage_path=str(tmp_path)),
+    ).fit()
+    assert result.metrics["sum"] == 3.0  # 1 + 2
+
+
+def test_failure_raises_training_failed(ray_start_regular, tmp_path):
+    def train_fn(config):
+        raise ValueError("boom")
+
+    trainer = JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            name="t_fail",
+            storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=0),
+        ),
+    )
+    with pytest.raises(TrainingFailedError, match="boom"):
+        trainer.fit()
+
+
+def test_checkpoint_manager_topk(tmp_path):
+    mgr = _CheckpointManager(
+        CheckpointConfig(num_to_keep=2, checkpoint_score_attribute="acc")
+    )
+    paths = []
+    for i, acc in enumerate([0.1, 0.9, 0.5]):
+        p = tmp_path / f"ckpt_{i}"
+        p.mkdir()
+        paths.append(str(p))
+        mgr.register(str(p), {"acc": acc})
+    kept = [c[0] for c in mgr.checkpoints]
+    assert str(tmp_path / "ckpt_0") not in kept  # worst dropped
+    assert not os.path.exists(paths[0])
+    assert mgr.best() == str(tmp_path / "ckpt_1")
+
+
+def test_shard_datasets_sequences():
+    shards = _shard_datasets({"train": [1, 2, 3, 4, 5]}, 2)
+    assert shards[0]["train"] == [1, 3, 5]
+    assert shards[1]["train"] == [2, 4]
+
+
+def test_dataset_shard_in_session(ray_start_regular, tmp_path):
+    def train_fn(config):
+        shard = train.get_dataset_shard("train")
+        train.report({"n": len(shard), "total": sum(shard)})
+
+    result = JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="t_ds", storage_path=str(tmp_path)),
+        datasets={"train": list(range(10))},
+    ).fit()
+    assert result.metrics["n"] == 5

@@ -151,18 +151,15 @@ class DataParallelTrainer(BaseTrainer):
 
     # -- experiment layout ---------------------------------------------------
 
-    def _make_trial_info(self, trial_id: Optional[str] = None) -> TrialInfo:
+    def _make_trial_info(self) -> TrialInfo:
         name = self.run_config.name or f"{type(self).__name__}_{uuid.uuid4().hex[:8]}"
         storage = self.run_config.resolved_storage_path()
-        trial_dir = os.path.join(storage, name)
-        fs, fs_dir = _parse_uri(trial_dir)
-        fs.create_dir(fs_dir, recursive=True)
         return TrialInfo(
             name=name,
             experiment_name=name,
-            trial_id=trial_id or uuid.uuid4().hex[:12],
+            trial_id=uuid.uuid4().hex[:12],
             storage_path=storage,
-            trial_dir=trial_dir,
+            trial_dir=os.path.join(storage, name),
         )
 
     # -- the drive loop ------------------------------------------------------
@@ -173,6 +170,9 @@ class DataParallelTrainer(BaseTrainer):
         report_cb: Optional[Callable[[Dict[str, Any], Optional[str]], None]] = None,
     ) -> Result:
         """Run (and re-run on gang failure) until training completes."""
+        if trial_info.trial_dir:
+            fs, fs_dir = _parse_uri(trial_info.trial_dir)
+            fs.create_dir(fs_dir, recursive=True)
         ckpt_manager = _CheckpointManager(self.run_config.checkpoint_config)
         latest_ckpt: Optional[str] = (
             self.resume_from_checkpoint.path if self.resume_from_checkpoint else None
@@ -256,15 +256,18 @@ class DataParallelTrainer(BaseTrainer):
         trainer = self
 
         def _trainable(config: Dict[str, Any]):
+            import copy
+
             from ray_tpu import tune
+            from ray_tpu.train import _session
 
             run_loop_config = dict(trainer.train_loop_config)
             run_loop_config.update(config.get("train_loop_config", config))
-            import copy
-
             t = copy.copy(trainer)
             t.train_loop_config = run_loop_config
-            trial_info = t._make_trial_info()
+            # Nest the inner worker gang's artifacts inside the tune trial's
+            # directory (reference: the trainer IS the trial).
+            trial_info = copy.copy(_session._get_session().trial_info)
 
             def cb(metrics, ckpt_path):
                 tune.report(

@@ -1,0 +1,129 @@
+"""Trial schedulers (reference: python/ray/tune/schedulers — FIFO,
+async_hyperband.py ASHA, median_stopping_rule.py).
+
+Schedulers see every reported result and decide CONTINUE or STOP; the
+controller enforces the decision by tearing down the trial actor.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def set_metric(self, metric: Optional[str], mode: Optional[str]) -> None:
+        if getattr(self, "metric", None) is None:
+            self.metric = metric
+        if getattr(self, "mode", None) is None:
+            self.mode = mode
+
+    def on_trial_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict[str, Any]]) -> None:
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    """Run every trial to completion."""
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA (reference: tune/schedulers/async_hyperband.py).
+
+    Rungs at t = grace_period * reduction_factor**k up to max_t. When a trial
+    reaches a rung it is compared against the top 1/reduction_factor quantile
+    of everything recorded at that rung; below the cutoff → STOP. Async: no
+    waiting for a full rung cohort.
+    """
+
+    def __init__(
+        self,
+        metric: Optional[str] = None,
+        mode: Optional[str] = None,
+        time_attr: str = "training_iteration",
+        max_t: int = 100,
+        grace_period: int = 1,
+        reduction_factor: float = 4,
+    ):
+        self.metric, self.mode = metric, mode
+        self.time_attr = time_attr
+        self.max_t, self.grace = max_t, grace_period
+        self.rf = reduction_factor
+        self.rungs: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(int(t))
+            t *= reduction_factor
+        # rung milestone -> recorded metric values of trials that reached it
+        self.recorded: Dict[int, Dict[str, float]] = collections.defaultdict(dict)
+        self._next_rung: Dict[str, int] = {}  # trial -> index into rungs
+
+    def _sign(self) -> float:
+        return 1.0 if (self.mode or "max") == "max" else -1.0
+
+    def on_trial_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr, 0)
+        metric = result.get(self.metric)
+        if metric is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        idx = self._next_rung.setdefault(trial_id, 0)
+        decision = CONTINUE
+        while idx < len(self.rungs) and t >= self.rungs[idx]:
+            milestone = self.rungs[idx]
+            rung = self.recorded[milestone]
+            rung[trial_id] = self._sign() * float(metric)
+            vals = sorted(rung.values(), reverse=True)
+            cutoff_n = max(1, int(len(vals) / self.rf))
+            cutoff = vals[cutoff_n - 1]
+            if rung[trial_id] < cutoff:
+                decision = STOP
+            idx += 1
+        self._next_rung[trial_id] = idx
+        return decision
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose running-average metric falls below the median of
+    all trials' averages at the same step (reference:
+    tune/schedulers/median_stopping_rule.py)."""
+
+    def __init__(
+        self,
+        metric: Optional[str] = None,
+        mode: Optional[str] = None,
+        time_attr: str = "training_iteration",
+        grace_period: int = 1,
+        min_samples_required: int = 3,
+    ):
+        self.metric, self.mode = metric, mode
+        self.time_attr = time_attr
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        self._history: Dict[str, List[float]] = collections.defaultdict(list)
+
+    def _sign(self) -> float:
+        return 1.0 if (self.mode or "max") == "max" else -1.0
+
+    def on_trial_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr, 0)
+        metric = result.get(self.metric)
+        if metric is None:
+            return CONTINUE
+        self._history[trial_id].append(self._sign() * float(metric))
+        if t < self.grace or len(self._history) < self.min_samples:
+            return CONTINUE
+        averages = {
+            tid: sum(h) / len(h) for tid, h in self._history.items() if h
+        }
+        vals = sorted(averages.values())
+        median = vals[len(vals) // 2]
+        if averages[trial_id] < median:
+            return STOP
+        return CONTINUE

@@ -1,0 +1,204 @@
+"""Trial lifecycle + the controller event loop (reference:
+python/ray/tune/execution/tune_controller.py:68).
+
+Each trial runs inside a TrainWorker actor (shared machinery with train:
+world-size-1 session, report queue drained by poll). The controller starts
+trials as concurrency slots free up, drains results, feeds the scheduler, and
+enforces STOP decisions by killing the trial actor.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.train._session import TrialInfo
+from ray_tpu.tune import schedulers as sched_mod
+
+PENDING, RUNNING, TERMINATED, ERROR = "PENDING", "RUNNING", "TERMINATED", "ERROR"
+
+
+@dataclass
+class Trial:
+    trial_id: str
+    config: Dict[str, Any]
+    status: str = PENDING
+    history: List[Dict[str, Any]] = field(default_factory=list)
+    checkpoint_path: Optional[str] = None
+    error: Optional[str] = None
+    early_stopped: bool = False
+    actor: Any = None
+    run_ref: Any = None
+
+    @property
+    def last_result(self) -> Optional[Dict[str, Any]]:
+        return self.history[-1] if self.history else None
+
+    def public_state(self) -> Dict[str, Any]:
+        return {
+            "trial_id": self.trial_id,
+            "config": self.config,
+            "status": self.status,
+            "history": self.history,
+            "checkpoint_path": self.checkpoint_path,
+            "error": self.error,
+            "early_stopped": self.early_stopped,
+        }
+
+
+class TuneController:
+    def __init__(
+        self,
+        trainable: Callable,
+        trials: List[Trial],
+        *,
+        experiment_name: str,
+        experiment_dir: str,
+        storage_path: str,
+        scheduler: Optional[sched_mod.TrialScheduler] = None,
+        max_concurrent: Optional[int] = None,
+        resources_per_trial: Optional[Dict[str, float]] = None,
+        poll_timeout: float = 2.0,
+    ):
+        self.trainable_blob = cloudpickle.dumps(trainable)
+        self.trials = trials
+        self.experiment_name = experiment_name
+        self.experiment_dir = experiment_dir
+        self.storage_path = storage_path
+        self.scheduler = scheduler or sched_mod.FIFOScheduler()
+        self.max_concurrent = max_concurrent or len(trials) or 1
+        self.resources = resources_per_trial or {"CPU": 1.0}
+        self.poll_timeout = poll_timeout
+
+    # -- trial actor management ---------------------------------------------
+
+    def _start_trial(self, trial: Trial) -> None:
+        from ray_tpu.train._worker_group import TrainWorker
+
+        cls = ray_tpu.remote(TrainWorker)
+        opts: Dict[str, Any] = {"max_concurrency": 4}
+        res = dict(self.resources)
+        opts["num_cpus"] = res.pop("CPU", 1.0)
+        if "TPU" in res:
+            opts["num_tpus"] = res.pop("TPU")
+        if res:
+            opts["resources"] = res
+        trial.actor = cls.options(**opts).remote(None)
+        trial_dir = os.path.join(self.experiment_dir, trial.trial_id)
+        ray_tpu.get(
+            trial.actor.setup_session.remote(
+                world_rank=0,
+                world_size=1,
+                local_rank=0,
+                local_world_size=1,
+                node_rank=0,
+                trial_info=TrialInfo(
+                    name=trial.trial_id,
+                    experiment_name=self.experiment_name,
+                    trial_id=trial.trial_id,
+                    storage_path=self.storage_path,
+                    trial_dir=trial_dir,
+                ),
+                latest_checkpoint_path=trial.checkpoint_path,
+                dataset_shards={},
+                loop_config=trial.config,
+                collective_group=None,
+            )
+        )
+        trial.run_ref = trial.actor.run.remote(self.trainable_blob)
+        trial.status = RUNNING
+
+    def _stop_trial(self, trial: Trial, status: str, error: Optional[str] = None):
+        trial.status = status
+        trial.error = error
+        if trial.actor is not None:
+            try:
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None
+
+    # -- the loop ------------------------------------------------------------
+
+    def run(self, result_cb: Optional[Callable[[Trial, Dict], None]] = None):
+        while True:
+            running = [t for t in self.trials if t.status == RUNNING]
+            pending = [t for t in self.trials if t.status == PENDING]
+            if not running and not pending:
+                break
+            # Fill free slots.
+            for t in pending[: max(0, self.max_concurrent - len(running))]:
+                self._start_trial(t)
+                running.append(t)
+            # Drain one poll round across all running trials.
+            refs = [t.actor.poll.remote(self.poll_timeout) for t in running]
+            for trial, rep in zip(running, self._safe_get(refs, running)):
+                if rep is None:  # actor died
+                    self._stop_trial(trial, ERROR, "trial actor died")
+                    self.scheduler.on_trial_complete(trial.trial_id, None)
+                    continue
+                if "result" in rep:
+                    r = rep["result"]
+                    metrics = dict(r["metrics"])
+                    metrics.setdefault("training_iteration", r["iteration"] + 1)
+                    metrics.setdefault("trial_id", trial.trial_id)
+                    trial.history.append(metrics)
+                    if r["checkpoint_path"]:
+                        trial.checkpoint_path = r["checkpoint_path"]
+                    if result_cb:
+                        result_cb(trial, metrics)
+                    decision = self.scheduler.on_trial_result(
+                        trial.trial_id, metrics
+                    )
+                    if decision == sched_mod.STOP:
+                        trial.early_stopped = True
+                        self._stop_trial(trial, TERMINATED)
+                        self.scheduler.on_trial_complete(
+                            trial.trial_id, trial.last_result
+                        )
+                elif rep.get("done"):
+                    if rep.get("error"):
+                        self._stop_trial(trial, ERROR, rep["error"])
+                    else:
+                        self._stop_trial(trial, TERMINATED)
+                    self.scheduler.on_trial_complete(
+                        trial.trial_id, trial.last_result
+                    )
+            self.save_state()
+
+    def _safe_get(self, refs, trials):
+        out = []
+        for ref, trial in zip(refs, trials):
+            try:
+                out.append(ray_tpu.get(ref, timeout=self.poll_timeout + 60))
+            except Exception:
+                out.append(None)
+        return out
+
+    # -- persistence (Tuner.restore) ----------------------------------------
+
+    def save_state(self) -> None:
+        state = {
+            "experiment_name": self.experiment_name,
+            "trials": [t.public_state() for t in self.trials],
+        }
+        os.makedirs(self.experiment_dir, exist_ok=True)
+        tmp = os.path.join(self.experiment_dir, ".experiment_state.tmp")
+        with open(tmp, "wb") as f:
+            pickle.dump(state, f)
+        os.replace(tmp, os.path.join(self.experiment_dir, "experiment_state.pkl"))
+
+    @staticmethod
+    def load_state(experiment_dir: str) -> Dict[str, Any]:
+        with open(os.path.join(experiment_dir, "experiment_state.pkl"), "rb") as f:
+            return pickle.load(f)
+
+
+def new_trial_id() -> str:
+    return uuid.uuid4().hex[:8]

@@ -1,0 +1,144 @@
+"""ray_tpu.tune tests (reference model: python/ray/tune/tests)."""
+
+import pytest
+
+from ray_tpu import tune
+from ray_tpu.tune.schedulers import CONTINUE, STOP, AsyncHyperBandScheduler
+from ray_tpu.tune.search import BasicVariantGenerator
+
+
+def test_basic_variant_grid_and_samples():
+    gen = BasicVariantGenerator(seed=0)
+    space = {
+        "lr": tune.grid_search([0.1, 0.01]),
+        "wd": tune.uniform(0.0, 1.0),
+        "fixed": 7,
+        "nested": {"bs": tune.grid_search([8, 16])},
+    }
+    configs = gen.generate(space, num_samples=2)
+    assert len(configs) == 8  # 2 grid x 2 grid x 2 samples
+    assert all(c["fixed"] == 7 for c in configs)
+    assert {c["lr"] for c in configs} == {0.1, 0.01}
+    assert {c["nested"]["bs"] for c in configs} == {8, 16}
+    assert all(0.0 <= c["wd"] <= 1.0 for c in configs)
+
+
+def test_asha_stops_bad_trials():
+    sched = AsyncHyperBandScheduler(
+        metric="score", mode="max", grace_period=1, reduction_factor=2, max_t=16
+    )
+    # Good trial reaches rung first and sets the bar.
+    assert sched.on_trial_result("good", {"training_iteration": 1, "score": 1.0}) == CONTINUE
+    assert sched.on_trial_result("bad", {"training_iteration": 1, "score": 0.1}) == STOP
+    # max_t reached -> stop regardless
+    assert sched.on_trial_result("good", {"training_iteration": 16, "score": 9.9}) == STOP
+
+
+def test_tuner_grid_search_end_to_end(ray_start_regular, tmp_path):
+    def trainable(config):
+        for i in range(3):
+            tune.report({"score": config["x"] * (i + 1)})
+
+    grid = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 5, 3])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=__import__("ray_tpu.air", fromlist=["RunConfig"]).RunConfig(
+            name="exp1", storage_path=str(tmp_path)
+        ),
+    ).fit()
+    assert len(grid) == 3
+    best = grid.get_best_result()
+    assert best.metrics["score"] == 15
+    assert best.metrics["training_iteration"] == 3
+
+
+def test_tuner_trial_error_isolated(ray_start_regular, tmp_path):
+    def trainable(config):
+        if config["x"] == 2:
+            raise RuntimeError("bad trial")
+        tune.report({"score": config["x"]})
+
+    from ray_tpu.air import RunConfig
+
+    grid = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2, 3])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="exp_err", storage_path=str(tmp_path)),
+    ).fit()
+    assert grid.num_errors == 1
+    assert grid.get_best_result().metrics["score"] == 3
+
+
+def test_tuner_with_asha_early_stops(ray_start_regular, tmp_path):
+    def trainable(config):
+        for i in range(8):
+            tune.report({"score": config["x"] * (i + 1)})
+
+    from ray_tpu.air import RunConfig
+
+    grid = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([10, 1, 1, 1])},
+        tune_config=tune.TuneConfig(
+            metric="score",
+            mode="max",
+            scheduler=AsyncHyperBandScheduler(
+                grace_period=2, reduction_factor=2, max_t=8
+            ),
+            max_concurrent_trials=2,
+        ),
+        run_config=RunConfig(name="exp_asha", storage_path=str(tmp_path)),
+    ).fit()
+    stopped = [t for t in grid._trials if t.early_stopped]
+    assert len(stopped) >= 1  # the x=1 stragglers get culled
+    assert grid.get_best_result().metrics["score"] >= 80 - 10
+
+
+def test_tuner_restore_reruns_unfinished(ray_start_regular, tmp_path):
+    import os
+
+    def trainable(config):
+        tune.report({"score": config["x"]})
+
+    from ray_tpu.air import RunConfig
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="exp_restore", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    exp_dir = os.path.join(str(tmp_path), "exp_restore")
+    restored = tune.Tuner.restore(exp_dir, trainable)
+    grid2 = restored.fit()
+    # everything already TERMINATED -> same results, no re-run
+    assert len(grid2) == 2
+    assert grid2.get_best_result(metric="score", mode="max").metrics["score"] == 2
+
+
+def test_trainer_under_tuner(ray_start_regular, tmp_path):
+    from ray_tpu import train
+    from ray_tpu.air import RunConfig, ScalingConfig
+    from ray_tpu.train.jax import JaxTrainer
+
+    def train_fn(config):
+        ctx = train.get_context()
+        train.report({"acc": config["lr"] * 10, "world": ctx.get_world_size()})
+
+    trainer = JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    )
+    grid = tune.Tuner(
+        trainer,
+        param_space={"train_loop_config": {"lr": tune.grid_search([0.1, 0.5])}},
+        tune_config=tune.TuneConfig(metric="acc", mode="max", max_concurrent_trials=1),
+        run_config=RunConfig(name="exp_trainer", storage_path=str(tmp_path)),
+    ).fit()
+    best = grid.get_best_result()
+    assert best.metrics["acc"] == 5.0
+    assert best.metrics["world"] == 2

@@ -1,0 +1,82 @@
+"""ray_tpu.data — streaming distributed datasets (reference: python/ray/data).
+
+    import ray_tpu.data as rd
+
+    ds = rd.range(1000).map_batches(lambda b: {"x": b["id"] * 2})
+    for batch in ds.iter_batches(batch_size=128):
+        ...
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from ray_tpu.data._execution import FromBlocks, Read
+from ray_tpu.data.dataset import Dataset, GroupedData
+from ray_tpu.data.iterator import DataIterator
+from ray_tpu.data import datasource as _src
+
+
+def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001
+    return Dataset([Read(read_tasks=_src.range_tasks(n, parallelism))], parallelism)
+
+
+def range_tensor(n: int, *, shape=(1,), parallelism: int = 8) -> Dataset:
+    return Dataset(
+        [Read(read_tasks=_src.range_tensor_tasks(n, shape, parallelism))],
+        parallelism,
+    )
+
+
+def from_items(items: List[Any], *, parallelism: int = 8) -> Dataset:
+    return Dataset(
+        [Read(read_tasks=_src.items_tasks(list(items), parallelism))], parallelism
+    )
+
+
+def from_pandas(df) -> Dataset:
+    import pyarrow as pa
+
+    return Dataset([FromBlocks(blocks=[pa.Table.from_pandas(df, preserve_index=False)])])
+
+
+def from_arrow(table) -> Dataset:
+    return Dataset([FromBlocks(blocks=[table])])
+
+
+def from_numpy(arr, column: str = "data") -> Dataset:
+    import pyarrow as pa
+
+    return Dataset([FromBlocks(blocks=[pa.table({column: list(arr)})])])
+
+
+def read_csv(paths, *, parallelism: int = 8, **kwargs) -> Dataset:
+    return Dataset([Read(read_tasks=_src.csv_read_tasks(paths, **kwargs))], parallelism)
+
+
+def read_parquet(
+    paths, *, columns: Optional[List[str]] = None, parallelism: int = 8
+) -> Dataset:
+    return Dataset(
+        [Read(read_tasks=_src.parquet_read_tasks(paths, columns))], parallelism
+    )
+
+
+def read_json(paths, *, parallelism: int = 8) -> Dataset:
+    return Dataset([Read(read_tasks=_src.json_read_tasks(paths))], parallelism)
+
+
+__all__ = [
+    "Dataset",
+    "GroupedData",
+    "DataIterator",
+    "range",
+    "range_tensor",
+    "from_items",
+    "from_pandas",
+    "from_arrow",
+    "from_numpy",
+    "read_csv",
+    "read_parquet",
+    "read_json",
+]

@@ -1,0 +1,457 @@
+"""Logical ops + streaming execution (reference: python/ray/data/_internal —
+logical/interfaces/logical_operator.py, execution/streaming_executor.py:48,
+execution/operators/*).
+
+Execution model: each stage is a generator over block ObjectRefs with a
+bounded in-flight window — downstream pulling makes upstream submit, so the
+whole pipeline streams with backpressure, like the reference's pull-based
+StreamingExecutor. Output order is preserved (head-of-line yield), which the
+reference also guarantees by default.
+
+Map-chains are fused into one task per block (reference: operator fusion in
+plan optimization) so a read->map->filter pipeline costs one task per block.
+"""
+
+from __future__ import annotations
+
+import collections
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterator, List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+import ray_tpu
+from ray_tpu.data import block as B
+
+# -- logical ops -------------------------------------------------------------
+
+
+@dataclass
+class LogicalOp:
+    pass
+
+
+@dataclass
+class Read(LogicalOp):
+    """Each read_task() -> pa.Table; one task per input partition."""
+
+    read_tasks: List[Callable[[], pa.Table]] = field(default_factory=list)
+    name: str = "Read"
+
+
+@dataclass
+class FromBlocks(LogicalOp):
+    blocks: List[pa.Table] = field(default_factory=list)
+
+
+@dataclass
+class MapBlocks(LogicalOp):
+    """fn(pa.Table) -> pa.Table. Covers map/filter/flat_map/map_batches."""
+
+    fn: Callable[[pa.Table], pa.Table] = None
+    name: str = "Map"
+    # Class-based UDF → actor pool (reference: ActorPoolMapOperator).
+    actor_cls: Optional[bytes] = None  # cloudpickled class
+    actor_args: Tuple = ()
+    pool_size: int = 2
+
+
+@dataclass
+class Limit(LogicalOp):
+    n: int = 0
+
+
+@dataclass
+class Union(LogicalOp):
+    others: List[List[LogicalOp]] = field(default_factory=list)
+
+
+@dataclass
+class Zip(LogicalOp):
+    other: List[LogicalOp] = field(default_factory=list)
+
+
+@dataclass
+class Repartition(LogicalOp):
+    num_blocks: int = 1
+
+
+@dataclass
+class Sort(LogicalOp):
+    key: str = ""
+    descending: bool = False
+
+
+@dataclass
+class RandomShuffle(LogicalOp):
+    seed: Optional[int] = None
+
+
+@dataclass
+class GroupByAgg(LogicalOp):
+    key: str = ""
+    aggs: List[Tuple[str, str]] = field(default_factory=list)  # (col, fn)
+
+
+# -- remote kernels ----------------------------------------------------------
+
+
+def _remote(fn, **opts):
+    return ray_tpu.remote(**{"num_cpus": 1, **opts})(fn)
+
+
+def _exec_read(task_blob):
+    import cloudpickle
+
+    return cloudpickle.loads(task_blob)()
+
+
+def _exec_map(fn_blob, table):
+    import cloudpickle
+
+    return cloudpickle.loads(fn_blob)(table)
+
+
+def _num_rows(table):
+    return table.num_rows
+
+
+def _slice_concat(ranges, *tables):
+    """ranges: list of (table_idx, start, end) over the varargs tables.
+
+    Block refs ride as top-level varargs because only top-level ObjectRef
+    args are resolved to values before execution (same contract as the
+    reference's task arg resolution)."""
+    from ray_tpu.data import block as B
+
+    return B.concat_blocks([B.slice_block(tables[i], s, e) for i, s, e in ranges])
+
+
+def _partition_block(table, key, n, seed, boundaries):
+    from ray_tpu.data import block as B
+
+    if boundaries is not None:
+        return tuple(B.range_partition_block(table, key, boundaries))
+    return tuple(B.hash_partition_block(table, key, n, seed))
+
+
+def _merge_sort(key, descending, *parts):
+    from ray_tpu.data import block as B
+
+    return B.sort_block(B.concat_blocks(list(parts)), key, descending)
+
+
+def _merge_shuffle(seed, *parts):
+    from ray_tpu.data import block as B
+
+    merged = B.concat_blocks(list(parts))
+    if merged.num_rows == 0:
+        return merged
+    rng = np.random.RandomState(seed)
+    return merged.take(pa.array(rng.permutation(merged.num_rows)))
+
+
+def _merge_groupby(key, aggs, *parts):
+    from ray_tpu.data import block as B
+
+    merged = B.concat_blocks(list(parts))
+    if merged.num_rows == 0:
+        return merged
+    agg_specs = [(col, fn) for col, fn in aggs]
+    return merged.group_by(key).aggregate(agg_specs)
+
+
+def _sample_block(table, key, k, seed):
+    if table.num_rows == 0:
+        return []
+    rng = np.random.RandomState(seed)
+    idx = rng.randint(0, table.num_rows, size=min(k, table.num_rows))
+    return table.take(pa.array(idx)).column(key).to_pylist()
+
+
+class _MapActor:
+    """Actor-pool worker hosting a stateful UDF instance
+    (reference: _MapWorker in actor_pool_map_operator.py)."""
+
+    def __init__(self, cls_blob, ctor_args):
+        import cloudpickle
+
+        self.udf = cloudpickle.loads(cls_blob)(*ctor_args)
+
+    def apply(self, wrapper_blob, table):
+        import cloudpickle
+
+        return cloudpickle.loads(wrapper_blob)(self.udf, table)
+
+
+# -- the executor ------------------------------------------------------------
+
+
+class StreamingExecutor:
+    def __init__(self, parallelism: int = 8):
+        self.parallelism = parallelism
+        self._actor_pools: List[List[Any]] = []
+
+    # Each stage: Iterator[ObjectRef[pa.Table]] -> Iterator[ObjectRef]
+
+    def execute(self, ops: List[LogicalOp]) -> Iterator[Any]:
+        """Yields block ObjectRefs for the fully-applied plan."""
+        try:
+            it = self._build(ops)
+            yield from it
+        finally:
+            self._teardown_pools()
+
+    def _teardown_pools(self):
+        for pool in self._actor_pools:
+            for a in pool:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
+        self._actor_pools = []
+
+    def _build(self, ops: List[LogicalOp]) -> Iterator[Any]:
+        ops = _fuse_maps(list(ops))
+        it: Optional[Iterator[Any]] = None
+        for op in ops:
+            if isinstance(op, Read):
+                it = self._read_stage(op)
+            elif isinstance(op, FromBlocks):
+                it = iter([ray_tpu.put(b) for b in op.blocks])
+            elif isinstance(op, MapBlocks):
+                if op.actor_cls is not None:
+                    it = self._actor_map_stage(op, it)
+                else:
+                    it = self._map_stage(op, it)
+            elif isinstance(op, Limit):
+                it = self._limit_stage(op, it)
+            elif isinstance(op, Union):
+                it = self._union_stage(op, it)
+            elif isinstance(op, Zip):
+                it = self._zip_stage(op, it)
+            elif isinstance(op, (Repartition, Sort, RandomShuffle, GroupByAgg)):
+                it = self._all_to_all_stage(op, it)
+            else:
+                raise TypeError(f"unknown logical op {op}")
+        return it if it is not None else iter([])
+
+    # -- stages --------------------------------------------------------------
+
+    def _windowed(self, submit_iter) -> Iterator[Any]:
+        """Ordered bounded-window pipeline: submit up to `parallelism`,
+        yield head as it completes."""
+        window: collections.deque = collections.deque()
+        for ref in submit_iter:
+            window.append(ref)
+            while len(window) >= self.parallelism:
+                yield window.popleft()
+        while window:
+            yield window.popleft()
+
+    def _read_stage(self, op: Read) -> Iterator[Any]:
+        import cloudpickle
+
+        read = _remote(_exec_read, name=op.name)
+        return self._windowed(
+            read.remote(cloudpickle.dumps(t)) for t in op.read_tasks
+        )
+
+    def _map_stage(self, op: MapBlocks, upstream) -> Iterator[Any]:
+        import cloudpickle
+
+        blob = cloudpickle.dumps(op.fn)
+        mapper = _remote(_exec_map, name=op.name)
+        return self._windowed(mapper.remote(blob, ref) for ref in upstream)
+
+    def _actor_map_stage(self, op: MapBlocks, upstream) -> Iterator[Any]:
+        import cloudpickle
+
+        cls = ray_tpu.remote(_MapActor)
+        pool = [
+            cls.options(max_concurrency=2, num_cpus=1).remote(
+                op.actor_cls, op.actor_args
+            )
+            for _ in range(op.pool_size)
+        ]
+        self._actor_pools.append(pool)
+        blob = cloudpickle.dumps(op.fn)
+
+        def submit():
+            for i, ref in enumerate(upstream):
+                yield pool[i % len(pool)].apply.remote(blob, ref)
+
+        return self._windowed(submit())
+
+    def _limit_stage(self, op: Limit, upstream) -> Iterator[Any]:
+        counter = _remote(_num_rows, num_cpus=0.5)
+        slicer = _remote(_slice_concat, num_cpus=0.5)
+        remaining = op.n
+        for ref in upstream:
+            if remaining <= 0:
+                break
+            n = ray_tpu.get(counter.remote(ref))
+            if n <= remaining:
+                remaining -= n
+                yield ref
+            else:
+                yield slicer.remote([(0, 0, remaining)], ref)
+                remaining = 0
+
+    def _union_stage(self, op: Union, upstream) -> Iterator[Any]:
+        yield from upstream
+        for other_plan in op.others:
+            sub = StreamingExecutor(self.parallelism)
+            yield from sub.execute(other_plan)
+
+    def _zip_stage(self, op: Zip, upstream) -> Iterator[Any]:
+        """Blockwise zip: re-slice the right side to the left side's block
+        boundaries, then one zip task per left block (no global concat —
+        reference: ZipOperator aligns blocks the same way)."""
+        left = list(upstream)
+        sub = StreamingExecutor(self.parallelism)
+        right = list(sub.execute(op.other))
+        counter = _remote(_num_rows, num_cpus=0.5)
+        l_counts = ray_tpu.get([counter.remote(r) for r in left])
+        r_counts = ray_tpu.get([counter.remote(r) for r in right])
+        if sum(l_counts) != sum(r_counts):
+            raise ValueError(
+                f"zip requires equal row counts: {sum(l_counts)} vs "
+                f"{sum(r_counts)}"
+            )
+        slicer = _remote(_slice_concat, num_cpus=0.5)
+        zipper = _remote(_zip_tables)
+        r_offsets = np.cumsum([0] + r_counts)
+        lo = 0
+        for l_ref, n in zip(left, l_counts):
+            hi = lo + n
+            ranges, tables = [], []
+            for i, r_ref in enumerate(right):
+                s = max(lo, r_offsets[i])
+                e = min(hi, r_offsets[i + 1])
+                if s < e:
+                    ranges.append(
+                        (len(tables), int(s - r_offsets[i]), int(e - r_offsets[i]))
+                    )
+                    tables.append(r_ref)
+            aligned = slicer.remote(ranges, *tables)
+            yield zipper.remote(1, l_ref, aligned)
+            lo = hi
+
+    def _all_to_all_stage(self, op, upstream) -> Iterator[Any]:
+        refs = list(upstream)
+        if not refs:
+            return
+        if isinstance(op, Repartition):
+            yield from self._repartition(refs, op.num_blocks)
+            return
+        n_parts = max(1, min(len(refs), self.parallelism))
+        key = getattr(op, "key", None)
+        seed = getattr(op, "seed", None)
+        seed = 0 if seed is None else seed
+        boundaries = None
+        if isinstance(op, Sort):
+            sampler = _remote(_sample_block, num_cpus=0.5)
+            samples = sorted(
+                s
+                for chunk in ray_tpu.get(
+                    [sampler.remote(r, op.key, 16, i) for i, r in enumerate(refs)]
+                )
+                for s in chunk
+            )
+            if samples and n_parts > 1:
+                step = max(1, len(samples) // n_parts)
+                boundaries = sorted(set(samples[step::step]))[: n_parts - 1]
+            else:
+                boundaries = []
+            n_parts = len(boundaries) + 1
+        part = _remote(_partition_block, num_returns=n_parts)
+        parts_per_block = [
+            part.remote(r, key, n_parts, seed + i, boundaries)
+            if n_parts > 1
+            else [r]
+            for i, r in enumerate(refs)
+        ]
+        if isinstance(op, Sort):
+            merge = _remote(_merge_sort)
+            order = range(n_parts - 1, -1, -1) if op.descending else range(n_parts)
+            for p in order:
+                yield merge.remote(
+                    op.key, op.descending, *[pb[p] for pb in parts_per_block]
+                )
+        elif isinstance(op, RandomShuffle):
+            merge = _remote(_merge_shuffle)
+            for p in range(n_parts):
+                yield merge.remote(seed + p, *[pb[p] for pb in parts_per_block])
+        elif isinstance(op, GroupByAgg):
+            merge = _remote(_merge_groupby)
+            for p in range(n_parts):
+                yield merge.remote(
+                    op.key, op.aggs, *[pb[p] for pb in parts_per_block]
+                )
+
+    def _repartition(self, refs, num_blocks: int) -> Iterator[Any]:
+        counter = _remote(_num_rows, num_cpus=0.5)
+        counts = ray_tpu.get([counter.remote(r) for r in refs])
+        total = sum(counts)
+        slicer = _remote(_slice_concat)
+        # Global row offsets -> num_blocks contiguous output ranges.
+        starts = [round(total * j / num_blocks) for j in range(num_blocks)]
+        ends = starts[1:] + [total]
+        offsets = np.cumsum([0] + counts)
+        for j in range(num_blocks):
+            ranges, tables = [], []
+            for i, r in enumerate(refs):
+                lo = max(starts[j], offsets[i])
+                hi = min(ends[j], offsets[i + 1])
+                if lo < hi:
+                    ranges.append(
+                        (len(tables), int(lo - offsets[i]), int(hi - offsets[i]))
+                    )
+                    tables.append(r)
+            yield slicer.remote(ranges, *tables)
+
+
+def _zip_tables(n_left, *blocks):
+    from ray_tpu.data import block as B
+
+    lt = B.concat_blocks(list(blocks[:n_left]))
+    rt = B.concat_blocks(list(blocks[n_left:]))
+    if lt.num_rows != rt.num_rows:
+        raise ValueError(
+            f"zip requires equal row counts: {lt.num_rows} vs {rt.num_rows}"
+        )
+    cols = {}
+    for name in lt.column_names:
+        cols[name] = lt.column(name)
+    for name in rt.column_names:
+        out = name
+        while out in cols:
+            out = out + "_1"
+        cols[out] = rt.column(name)
+    return pa.table(cols)
+
+
+def _fuse_maps(ops: List[LogicalOp]) -> List[LogicalOp]:
+    """Fuse consecutive task-pool MapBlocks into one task per block."""
+    out: List[LogicalOp] = []
+    for op in ops:
+        if (
+            isinstance(op, MapBlocks)
+            and op.actor_cls is None
+            and out
+            and isinstance(out[-1], MapBlocks)
+            and out[-1].actor_cls is None
+        ):
+            prev = out.pop()
+            f, g = prev.fn, op.fn
+            out.append(
+                MapBlocks(
+                    fn=lambda t, f=f, g=g: g(f(t)),
+                    name=f"{prev.name}->{op.name}",
+                )
+            )
+        else:
+            out.append(op)
+    return out

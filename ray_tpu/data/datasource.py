@@ -1,0 +1,154 @@
+"""Datasources: read task construction + writers (reference:
+python/ray/data/datasource/ + read_api.py)."""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from ray_tpu.data import block as B
+
+
+def _expand_paths(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(
+                sorted(
+                    f
+                    for f in glob.glob(os.path.join(p, "**", "*"), recursive=True)
+                    if os.path.isfile(f)
+                )
+            )
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths}")
+    return out
+
+
+def range_tasks(n: int, parallelism: int) -> List[Callable[[], pa.Table]]:
+    parallelism = max(1, min(parallelism, n or 1))
+    tasks = []
+    for i in range(parallelism):
+        lo = n * i // parallelism
+        hi = n * (i + 1) // parallelism
+
+        def task(lo=lo, hi=hi):
+            return pa.table({"id": np.arange(lo, hi, dtype=np.int64)})
+
+        tasks.append(task)
+    return tasks
+
+
+def range_tensor_tasks(n: int, shape, parallelism: int):
+    parallelism = max(1, min(parallelism, n or 1))
+    tasks = []
+    for i in range(parallelism):
+        lo = n * i // parallelism
+        hi = n * (i + 1) // parallelism
+
+        def task(lo=lo, hi=hi, shape=tuple(shape)):
+            data = [
+                (np.ones(shape, dtype=np.int64) * j).tolist()
+                for j in range(lo, hi)
+            ]
+            return pa.table({"data": data})
+
+        tasks.append(task)
+    return tasks
+
+
+def items_tasks(items: List[Any], parallelism: int):
+    parallelism = max(1, min(parallelism, len(items) or 1))
+    tasks = []
+    for i in range(parallelism):
+        chunk = items[len(items) * i // parallelism : len(items) * (i + 1) // parallelism]
+
+        def task(chunk=chunk):
+            return B.rows_to_block(chunk)
+
+        tasks.append(task)
+    return tasks
+
+
+def csv_read_tasks(paths, **read_options):
+    files = _expand_paths(paths)
+    tasks = []
+    for f in files:
+
+        def task(f=f, read_options=read_options):
+            from pyarrow import csv as pacsv
+
+            return pacsv.read_csv(f, **read_options)
+
+        tasks.append(task)
+    return tasks
+
+
+def parquet_read_tasks(paths, columns: Optional[List[str]] = None):
+    files = _expand_paths(paths)
+    tasks = []
+    for f in files:
+
+        def task(f=f, columns=columns):
+            import pyarrow.parquet as pq
+
+            return pq.read_table(f, columns=columns)
+
+        tasks.append(task)
+    return tasks
+
+
+def json_read_tasks(paths):
+    files = _expand_paths(paths)
+    tasks = []
+    for f in files:
+
+        def task(f=f):
+            from pyarrow import json as pajson
+
+            return pajson.read_json(f)
+
+        tasks.append(task)
+    return tasks
+
+
+# -- writers (run as remote tasks, one file per block) -----------------------
+
+
+def write_block_parquet(table: pa.Table, path: str, idx: int) -> str:
+    import pyarrow.parquet as pq
+
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{idx:05d}.parquet")
+    pq.write_table(table, out)
+    return out
+
+
+def write_block_csv(table: pa.Table, path: str, idx: int) -> str:
+    from pyarrow import csv as pacsv
+
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{idx:05d}.csv")
+    pacsv.write_csv(table, out)
+    return out
+
+
+def write_block_json(table: pa.Table, path: str, idx: int) -> str:
+    import json
+
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{idx:05d}.json")
+    with open(out, "w") as f:
+        for row in B.block_to_rows(table):
+            f.write(json.dumps(row) + "\n")
+    return out

@@ -1,0 +1,127 @@
+"""DataIterator + streaming_split coordination (reference:
+python/ray/data/iterator.py DataIterator and
+_internal/execution/streaming_split coordination via
+StreamSplitDataIterator — an actor serves blocks to N consumers).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional
+
+import pyarrow as pa
+
+import ray_tpu
+from ray_tpu.data import block as B
+
+
+def batches_from_blocks(
+    blocks: Iterator[pa.Table],
+    batch_size: Optional[int],
+    batch_format: str = "numpy",
+    drop_last: bool = False,
+) -> Iterator[Any]:
+    """Re-chunk a stream of blocks into fixed-size batches."""
+    if batch_size is None:
+        for blk in blocks:
+            if blk.num_rows:
+                yield B.block_to_batch(blk, batch_format)
+        return
+    buf: List[pa.Table] = []
+    buffered = 0
+    for blk in blocks:
+        if blk.num_rows == 0:
+            continue
+        buf.append(blk)
+        buffered += blk.num_rows
+        while buffered >= batch_size:
+            merged = B.concat_blocks(buf)
+            batch = B.slice_block(merged, 0, batch_size)
+            rest = B.slice_block(merged, batch_size, merged.num_rows)
+            buf = [rest] if rest.num_rows else []
+            buffered = rest.num_rows
+            yield B.block_to_batch(batch, batch_format)
+    if buffered and not drop_last:
+        yield B.block_to_batch(B.concat_blocks(buf), batch_format)
+
+
+class _SplitCoordinator:
+    """Actor owning one dataset execution, serving blocks to N splits.
+
+    Blocks are assigned round-robin at execution time; each epoch restarts
+    iteration over the materialized block refs (first epoch materializes).
+    """
+
+    def __init__(self, plan_blob: bytes, n: int, parallelism: int):
+        import threading
+
+        import cloudpickle
+
+        self.ops = cloudpickle.loads(plan_blob)
+        self.n = n
+        self.parallelism = parallelism
+        self.refs: Optional[List[Any]] = None
+        self.positions: Dict[int, int] = {}
+        self._lock = threading.Lock()  # splits call in concurrently
+
+    def _ensure(self):
+        with self._lock:
+            if self.refs is None:
+                from ray_tpu.data._execution import StreamingExecutor
+
+                ex = StreamingExecutor(self.parallelism)
+                self.refs = list(ex.execute(self.ops))
+
+    def start_epoch(self, split_idx: int) -> None:
+        self._ensure()
+        self.positions[split_idx] = 0
+
+    def next_block(self, split_idx: int):
+        """Next block (as a table) for this split, or None when exhausted."""
+        self._ensure()
+        pos = self.positions.get(split_idx, 0)
+        idx = pos * self.n + split_idx
+        if idx >= len(self.refs):
+            return None
+        self.positions[split_idx] = pos + 1
+        return ray_tpu.get(self.refs[idx])
+
+
+class DataIterator:
+    """Per-consumer view of a streaming split; picklable (ships the
+    coordinator actor handle)."""
+
+    def __init__(self, coordinator, split_idx: int):
+        self._coord = coordinator
+        self._idx = split_idx
+
+    def _blocks(self) -> Iterator[pa.Table]:
+        ray_tpu.get(self._coord.start_epoch.remote(self._idx))
+        while True:
+            blk = ray_tpu.get(self._coord.next_block.remote(self._idx))
+            if blk is None:
+                return
+            yield blk
+
+    def iter_batches(
+        self,
+        *,
+        batch_size: Optional[int] = 256,
+        batch_format: str = "numpy",
+        drop_last: bool = False,
+    ) -> Iterator[Any]:
+        yield from batches_from_blocks(
+            self._blocks(), batch_size, batch_format, drop_last
+        )
+
+    def iter_rows(self) -> Iterator[Any]:
+        for blk in self._blocks():
+            yield from B.block_to_rows(blk)
+
+    def materialize(self):
+        from ray_tpu.data.dataset import Dataset
+        from ray_tpu.data._execution import FromBlocks
+
+        return Dataset([FromBlocks(list(self._blocks()))])
+
+    def __reduce__(self):
+        return (DataIterator, (self._coord, self._idx))

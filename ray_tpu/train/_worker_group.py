@@ -91,26 +91,41 @@ class TrainWorker:
         finally:
             s.finished.set()
 
-    def poll(self, timeout: float = 5.0) -> Dict[str, Any]:
-        """Next TrainingResult, or done/pending status."""
+    def poll(
+        self, timeout: float = 5.0, max_results: Optional[int] = 1
+    ) -> Dict[str, Any]:
+        """Blocking-drain of queued TrainingResults.
+
+        max_results=1 → lock-step drain (train's per-round rank sync);
+        None → drain everything queued (tune, where a fast trial may have
+        reported many times between controller rounds)."""
         import queue as _q
 
         assert self.session is not None
         s = self.session
+        out = []
         try:
-            r = s.result_queue.get(timeout=timeout)
-            return {
-                "result": {
+            out.append(s.result_queue.get(timeout=timeout))
+            while max_results is None or len(out) < max_results:
+                out.append(s.result_queue.get_nowait())
+        except _q.Empty:
+            pass
+        if out:
+            results = [
+                {
                     "metrics": r.metrics,
                     "checkpoint_path": r.checkpoint_path,
                     "iteration": r.iteration,
                     "world_rank": r.world_rank,
                 }
-            }
-        except _q.Empty:
-            if s.finished.is_set() and s.result_queue.empty():
-                return {"done": True, "error": repr(s.error) if s.error else None}
-            return {"pending": True}
+                for r in out
+            ]
+            if max_results == 1:
+                return {"result": results[0]}
+            return {"results": results}
+        if s.finished.is_set() and s.result_queue.empty():
+            return {"done": True, "error": repr(s.error) if s.error else None}
+        return {"pending": True}
 
     def shutdown_collective(self, group_name: str) -> None:
         from ray_tpu.util import collective

@@ -136,32 +136,38 @@ class TuneController:
             for t in pending[: max(0, self.max_concurrent - len(running))]:
                 self._start_trial(t)
                 running.append(t)
-            # Drain one poll round across all running trials.
-            refs = [t.actor.poll.remote(self.poll_timeout) for t in running]
+            # Drain one poll round across all running trials (each poll
+            # batch-drains the trial's whole result queue).
+            refs = [
+                t.actor.poll.remote(self.poll_timeout, None) for t in running
+            ]
             for trial, rep in zip(running, self._safe_get(refs, running)):
                 if rep is None:  # actor died
                     self._stop_trial(trial, ERROR, "trial actor died")
                     self.scheduler.on_trial_complete(trial.trial_id, None)
                     continue
-                if "result" in rep:
-                    r = rep["result"]
-                    metrics = dict(r["metrics"])
-                    metrics.setdefault("training_iteration", r["iteration"] + 1)
-                    metrics.setdefault("trial_id", trial.trial_id)
-                    trial.history.append(metrics)
-                    if r["checkpoint_path"]:
-                        trial.checkpoint_path = r["checkpoint_path"]
-                    if result_cb:
-                        result_cb(trial, metrics)
-                    decision = self.scheduler.on_trial_result(
-                        trial.trial_id, metrics
-                    )
-                    if decision == sched_mod.STOP:
-                        trial.early_stopped = True
-                        self._stop_trial(trial, TERMINATED)
-                        self.scheduler.on_trial_complete(
-                            trial.trial_id, trial.last_result
+                if "results" in rep:
+                    for r in rep["results"]:
+                        metrics = dict(r["metrics"])
+                        metrics.setdefault(
+                            "training_iteration", r["iteration"] + 1
                         )
+                        metrics.setdefault("trial_id", trial.trial_id)
+                        trial.history.append(metrics)
+                        if r["checkpoint_path"]:
+                            trial.checkpoint_path = r["checkpoint_path"]
+                        if result_cb:
+                            result_cb(trial, metrics)
+                        decision = self.scheduler.on_trial_result(
+                            trial.trial_id, metrics
+                        )
+                        if decision == sched_mod.STOP:
+                            trial.early_stopped = True
+                            self._stop_trial(trial, TERMINATED)
+                            self.scheduler.on_trial_complete(
+                                trial.trial_id, trial.last_result
+                            )
+                            break
                 elif rep.get("done"):
                     if rep.get("error"):
                         self._stop_trial(trial, ERROR, rep["error"])
@@ -186,6 +192,8 @@ class TuneController:
     def save_state(self) -> None:
         state = {
             "experiment_name": self.experiment_name,
+            "metric": getattr(self, "metric", None),
+            "mode": getattr(self, "mode", None),
             "trials": [t.public_state() for t in self.trials],
         }
         os.makedirs(self.experiment_dir, exist_ok=True)

@@ -153,6 +153,8 @@ class Tuner:
             max_concurrent=self.tune_config.max_concurrent_trials,
             resources_per_trial=_with_resources_of(self.trainable),
         )
+        controller.metric = self.tune_config.metric
+        controller.mode = self.tune_config.mode
         controller.run()
         results = [
             Result(
@@ -203,6 +205,10 @@ class Tuner:
         run_config = RunConfig(
             name=os.path.basename(path), storage_path=os.path.dirname(path)
         )
+        if tune_config is None and state.get("metric") is not None:
+            tune_config = TuneConfig(
+                metric=state["metric"], mode=state.get("mode") or "max"
+            )
         return cls(
             trainable,
             tune_config=tune_config,

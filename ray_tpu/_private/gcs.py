@@ -148,6 +148,7 @@ class GcsServer:
         s.register("GetActor", self._get_actor)
         s.register("GetNamedActor", self._get_named_actor)
         s.register("ListActors", self._list_actors)
+        s.register("ListNamedActors", self._list_named_actors)
         s.register("ReportActorReady", self._report_actor_ready)
         s.register("ReportWorkerDied", self._report_worker_died)
         s.register("KillActor", self._kill_actor)
@@ -391,6 +392,20 @@ class GcsServer:
 
     async def _list_actors(self, conn, p):
         return {"actors": [a.to_wire() for a in self.actors.values()]}
+
+    async def _list_named_actors(self, conn, p):
+        """Live named actors, optionally filtered by namespace (parity:
+        ray.util.list_named_actors)."""
+        ns_filter = p.get("namespace")
+        names = []
+        for (ns, name), actor_id in self.named_actors.items():
+            actor = self.actors.get(actor_id)
+            if actor is None or actor.state == DEAD:
+                continue
+            if ns_filter is not None and ns != ns_filter:
+                continue
+            names.append(name if ns_filter is not None else f"{ns}:{name}")
+        return {"names": names}
 
     async def _kill_actor(self, conn, p):
         actor = self.actors.get(p["actor_id"])

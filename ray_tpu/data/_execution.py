@@ -15,6 +15,8 @@ plan optimization) so a read->map->filter pipeline costs one task per block.
 from __future__ import annotations
 
 import collections
+import itertools
+import random
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterator, List, Optional, Tuple
 
@@ -192,6 +194,7 @@ class StreamingExecutor:
     def __init__(self, parallelism: int = 8):
         self.parallelism = parallelism
         self._actor_pools: List[List[Any]] = []
+        self._actor_stage_refs: List[Any] = []
 
     # Each stage: Iterator[ObjectRef[pa.Table]] -> Iterator[ObjectRef]
 
@@ -204,6 +207,19 @@ class StreamingExecutor:
             self._teardown_pools()
 
     def _teardown_pools(self):
+        # Wait for every ref produced by an actor stage to materialize before
+        # killing the pool: the consumer may not have fetched them yet, and a
+        # killed actor can no longer seal its in-flight results.
+        if self._actor_stage_refs:
+            try:
+                ray_tpu.wait(
+                    self._actor_stage_refs,
+                    num_returns=len(self._actor_stage_refs),
+                    timeout=60,
+                )
+            except Exception:
+                pass
+            self._actor_stage_refs = []
         for pool in self._actor_pools:
             for a in pool:
                 try:
@@ -280,7 +296,9 @@ class StreamingExecutor:
 
         def submit():
             for i, ref in enumerate(upstream):
-                yield pool[i % len(pool)].apply.remote(blob, ref)
+                out = pool[i % len(pool)].apply.remote(blob, ref)
+                self._actor_stage_refs.append(out)
+                yield out
 
         return self._windowed(submit())
 
@@ -288,16 +306,23 @@ class StreamingExecutor:
         counter = _remote(_num_rows, num_cpus=0.5)
         slicer = _remote(_slice_concat, num_cpus=0.5)
         remaining = op.n
-        for ref in upstream:
-            if remaining <= 0:
+        upstream = iter(upstream)
+        while remaining > 0:
+            # Count a window of blocks concurrently instead of one round-trip
+            # per block.
+            chunk = list(itertools.islice(upstream, self.parallelism))
+            if not chunk:
                 break
-            n = ray_tpu.get(counter.remote(ref))
-            if n <= remaining:
-                remaining -= n
-                yield ref
-            else:
-                yield slicer.remote([(0, 0, remaining)], ref)
-                remaining = 0
+            counts = ray_tpu.get([counter.remote(r) for r in chunk])
+            for ref, n in zip(chunk, counts):
+                if remaining <= 0:
+                    break
+                if n <= remaining:
+                    remaining -= n
+                    yield ref
+                else:
+                    yield slicer.remote([(0, 0, remaining)], ref)
+                    remaining = 0
 
     def _union_stage(self, op: Union, upstream) -> Iterator[Any]:
         yield from upstream
@@ -349,7 +374,10 @@ class StreamingExecutor:
         n_parts = max(1, min(len(refs), self.parallelism))
         key = getattr(op, "key", None)
         seed = getattr(op, "seed", None)
-        seed = 0 if seed is None else seed
+        if seed is None:
+            # Unseeded shuffle must differ across runs/epochs (reference
+            # ray.data semantics).
+            seed = random.randrange(2**31)
         boundaries = None
         if isinstance(op, Sort):
             sampler = _remote(_sample_block, num_cpus=0.5)

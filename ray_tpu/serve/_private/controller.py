@@ -1,0 +1,525 @@
+"""ServeController: the singleton control-plane actor.
+
+Analog of python/ray/serve/_private/controller.py (ServeController:86) +
+application_state.py / deployment_state.py: holds target state per
+application/deployment, runs a reconciliation loop that starts/stops/heals
+replica actors, autoscales on queue metrics, and fans config out to routers
+and proxies via a long-poll host. The data plane never touches the controller.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu.serve._private.common import (
+    ApplicationStatus,
+    DeploymentID,
+    DeploymentStatus,
+    ReplicaID,
+    RunningReplicaInfo,
+)
+from ray_tpu.serve._private.long_poll import LongPollHost
+from ray_tpu.serve.schema import AutoscalingConfig, DeploymentConfig
+
+logger = logging.getLogger(__name__)
+
+RECONCILE_PERIOD_S = 0.25
+
+
+class _ReplicaRecord:
+    def __init__(self, replica_id: ReplicaID, actor_id: str, max_ongoing: int):
+        self.replica_id = replica_id
+        self.actor_id = actor_id
+        self.max_ongoing = max_ongoing
+        self.ready = False
+        self.health_task: Optional[asyncio.Task] = None
+        self.consecutive_health_failures = 0
+
+    def info(self) -> RunningReplicaInfo:
+        return RunningReplicaInfo(
+            replica_id_str=self.replica_id.unique_id,
+            deployment_id_str=str(self.replica_id.deployment_id),
+            actor_id=self.actor_id,
+            max_ongoing_requests=self.max_ongoing,
+        )
+
+
+class _DeploymentState:
+    """Target + actual state for one deployment (reference
+    deployment_state.py DeploymentState)."""
+
+    def __init__(self, dep_id: DeploymentID, spec: Dict[str, Any]):
+        self.dep_id = dep_id
+        self.spec = spec
+        self.config = DeploymentConfig.from_dict(spec["config"])
+        self.replicas: Dict[str, _ReplicaRecord] = {}
+        self.starting: Dict[str, asyncio.Task] = {}
+        self.stopping: Dict[str, asyncio.Task] = {}
+        self.status = DeploymentStatus.UPDATING
+        self.message = ""
+        self.deleting = False
+        # autoscaling bookkeeping
+        self.metrics_window: List[tuple] = []  # (t, total_ongoing)
+        self.autoscale_decision_ts = 0.0
+        self.current_target: Optional[int] = None
+
+    @property
+    def target_replicas(self) -> int:
+        if self.deleting:
+            return 0
+        ac = self.config.autoscaling_config
+        if ac is not None:
+            if self.current_target is None:
+                self.current_target = max(ac.min_replicas, 1)
+            return self.current_target
+        return self.config.num_replicas
+
+    def running_infos(self) -> List[RunningReplicaInfo]:
+        return [r.info() for r in self.replicas.values() if r.ready]
+
+
+class ServeController:
+    """Created as a detached named actor with high max_concurrency so
+    long-poll listens don't block control operations."""
+
+    def __init__(self, http_options: Optional[Dict[str, Any]] = None):
+        self._http_options = http_options or {}
+        self._apps: Dict[str, Dict[str, Any]] = {}  # app -> app spec + status
+        self._deployments: Dict[str, _DeploymentState] = {}  # str(dep_id) -> state
+        self._long_poll = LongPollHost()
+        self._loop_task: Optional[asyncio.Task] = None
+        self._proxy_actor_id: Optional[str] = None
+        self._shutdown = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self) -> bool:
+        if self._loop_task is None:
+            self._loop_task = asyncio.ensure_future(self._run_control_loop())
+            if self._http_options.get("enabled", True):
+                await self._ensure_proxy()
+        return True
+
+    async def _ensure_proxy(self) -> None:
+        if self._proxy_actor_id is not None:
+            return
+        from ray_tpu.serve._private.proxy import ProxyActor
+
+        core = worker_mod._core()
+        actor_id = await core.create_actor(
+            cloudpickle.dumps(ProxyActor),
+            "ServeProxy",
+            (
+                self._http_options.get("host", "127.0.0.1"),
+                self._http_options.get("port", 8000),
+            ),
+            {},
+            resources={"CPU": 0.0},
+            max_concurrency=1000,
+            name="SERVE_PROXY",
+            namespace="serve",
+            lifetime="detached",
+        )
+        self._proxy_actor_id = actor_id
+        # Tell the proxy to bind its HTTP server.
+        refs = await core.submit_actor_task(actor_id, "ready", (), {}, num_returns=1)
+        bound = await core.get_objects(refs[0], timeout=None)
+        self._http_options["port"] = bound["port"]
+        logger.info("serve proxy listening on %s", bound)
+
+    async def get_http_config(self) -> Dict[str, Any]:
+        return dict(self._http_options)
+
+    async def check_alive(self) -> bool:
+        return True
+
+    # -- long poll -----------------------------------------------------------
+
+    async def listen_for_change(self, keys_to_snapshot_ids: Dict[str, int]):
+        return await self._long_poll.listen_for_change(keys_to_snapshot_ids)
+
+    def _broadcast_replicas(self, dep_id_str: str) -> None:
+        state = self._deployments.get(dep_id_str)
+        infos = [] if state is None else [i.to_dict() for i in state.running_infos()]
+        self._long_poll.notify_changed(f"replicas::{dep_id_str}", infos)
+
+    def _broadcast_routes(self) -> None:
+        table = {}
+        for app_name, app in self._apps.items():
+            if app.get("route_prefix") and app["status"] in (
+                ApplicationStatus.RUNNING,
+                ApplicationStatus.DEPLOYING,
+            ):
+                table[app["route_prefix"]] = {
+                    "app": app_name,
+                    "ingress": app["ingress"],
+                }
+        self._long_poll.notify_changed("route_table", table)
+
+    # -- deploy / delete API -------------------------------------------------
+
+    async def deploy_application(self, app_spec: Dict[str, Any]) -> None:
+        """app_spec: {name, route_prefix, ingress, deployments: [dep_spec]}.
+        dep_spec: {name, serialized_cls, init_args_blob, config}."""
+        name = app_spec["name"]
+        old = self._apps.get(name)
+        if old is not None:
+            # Redeploy: drop deployments no longer present.
+            new_names = {d["name"] for d in app_spec["deployments"]}
+            for dep in old["deployments"]:
+                if dep not in new_names:
+                    key = str(DeploymentID(dep, name))
+                    if key in self._deployments:
+                        self._deployments[key].deleting = True
+        self._apps[name] = {
+            "name": name,
+            "route_prefix": app_spec.get("route_prefix"),
+            "ingress": app_spec.get("ingress"),
+            "deployments": [d["name"] for d in app_spec["deployments"]],
+            "status": ApplicationStatus.DEPLOYING,
+            "message": "",
+        }
+        for dep_spec in app_spec["deployments"]:
+            dep_id = DeploymentID(dep_spec["name"], name)
+            key = str(dep_id)
+            existing = self._deployments.get(key)
+            if existing is not None and not existing.deleting:
+                # In-place update: new config; replicas restart only if the
+                # code/init args changed (version hash).
+                if existing.spec.get("version") == dep_spec.get("version"):
+                    existing.spec = dep_spec
+                    existing.config = DeploymentConfig.from_dict(dep_spec["config"])
+                    existing.current_target = None
+                    existing.status = DeploymentStatus.UPDATING
+                    continue
+                for rec in list(existing.replicas.values()):
+                    self._start_stopping(existing, rec)
+                existing.spec = dep_spec
+                existing.config = DeploymentConfig.from_dict(dep_spec["config"])
+                existing.current_target = None
+                existing.status = DeploymentStatus.UPDATING
+            else:
+                self._deployments[key] = _DeploymentState(dep_id, dep_spec)
+        self._broadcast_routes()
+
+    async def delete_application(self, name: str) -> None:
+        app = self._apps.get(name)
+        if app is None:
+            return
+        app["status"] = ApplicationStatus.DELETING
+        for dep in app["deployments"]:
+            key = str(DeploymentID(dep, name))
+            if key in self._deployments:
+                self._deployments[key].deleting = True
+        self._broadcast_routes()
+
+    async def graceful_shutdown(self) -> None:
+        self._shutdown = True
+        for state in self._deployments.values():
+            state.deleting = True
+        # Wait for replicas to drain.
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if not any(
+                s.replicas or s.starting or s.stopping
+                for s in self._deployments.values()
+            ):
+                break
+            await asyncio.sleep(0.1)
+        core = worker_mod._core()
+        if self._proxy_actor_id:
+            try:
+                await core.kill_actor(self._proxy_actor_id)
+            except Exception:
+                pass
+
+    # -- status --------------------------------------------------------------
+
+    async def get_serve_status(self) -> Dict[str, Any]:
+        out = {}
+        for app_name, app in self._apps.items():
+            deps = {}
+            for dep in app["deployments"]:
+                state = self._deployments.get(str(DeploymentID(dep, app_name)))
+                if state is None:
+                    continue
+                counts = {
+                    "RUNNING": sum(1 for r in state.replicas.values() if r.ready),
+                    "STARTING": len(state.starting)
+                    + sum(1 for r in state.replicas.values() if not r.ready),
+                    "STOPPING": len(state.stopping),
+                }
+                deps[dep] = {
+                    "status": state.status.value,
+                    "message": state.message,
+                    "replica_states": counts,
+                    "target_replicas": state.target_replicas,
+                }
+            out[app_name] = {
+                "status": app["status"].value
+                if isinstance(app["status"], ApplicationStatus)
+                else app["status"],
+                "message": app.get("message", ""),
+                "route_prefix": app.get("route_prefix"),
+                "ingress": app.get("ingress"),
+                "deployments": deps,
+            }
+        return out
+
+    # -- reconciliation ------------------------------------------------------
+
+    async def _run_control_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                await self._reconcile_once()
+            except Exception:
+                logger.error("reconcile error:\n%s", traceback.format_exc())
+            await asyncio.sleep(RECONCILE_PERIOD_S)
+
+    async def _reconcile_once(self) -> None:
+        for key, state in list(self._deployments.items()):
+            self._autoscale(state)
+            target = state.target_replicas
+            actual = len(state.replicas) + len(state.starting)
+            if actual < target:
+                for _ in range(target - actual):
+                    self._start_replica(state)
+            elif actual > target:
+                excess = actual - target
+                # Prefer stopping not-yet-ready replicas.
+                ordered = sorted(state.replicas.values(), key=lambda r: r.ready)
+                for rec in ordered[:excess]:
+                    self._start_stopping(state, rec)
+            self._update_deployment_status(state)
+            if state.deleting and not (
+                state.replicas or state.starting or state.stopping
+            ):
+                del self._deployments[key]
+                self._broadcast_replicas(key)
+        self._update_app_statuses()
+
+    def _update_deployment_status(self, state: _DeploymentState) -> None:
+        if state.deleting:
+            state.status = DeploymentStatus.DELETING
+            return
+        ready = sum(1 for r in state.replicas.values() if r.ready)
+        if ready == state.target_replicas and not state.starting:
+            state.status = DeploymentStatus.HEALTHY
+        elif state.status != DeploymentStatus.UNHEALTHY:
+            state.status = (
+                DeploymentStatus.UPSCALING
+                if ready < state.target_replicas
+                else DeploymentStatus.DOWNSCALING
+            )
+
+    def _update_app_statuses(self) -> None:
+        for app_name, app in self._apps.items():
+            if app["status"] == ApplicationStatus.DELETING:
+                if not any(
+                    str(DeploymentID(d, app_name)) in self._deployments
+                    for d in app["deployments"]
+                ):
+                    del self._apps[app_name]
+                    self._broadcast_routes()
+                    return
+                continue
+            statuses = []
+            for d in app["deployments"]:
+                state = self._deployments.get(str(DeploymentID(d, app_name)))
+                if state is not None:
+                    statuses.append(state.status)
+            if any(s == DeploymentStatus.UNHEALTHY for s in statuses):
+                new = ApplicationStatus.DEPLOY_FAILED
+            elif statuses and all(s == DeploymentStatus.HEALTHY for s in statuses):
+                new = ApplicationStatus.RUNNING
+            else:
+                new = ApplicationStatus.DEPLOYING
+            if new != app["status"]:
+                app["status"] = new
+                self._broadcast_routes()
+
+    # -- replica lifecycle ---------------------------------------------------
+
+    def _start_replica(self, state: _DeploymentState) -> None:
+        replica_id = ReplicaID.generate(state.dep_id)
+        task = asyncio.ensure_future(self._create_replica(state, replica_id))
+        state.starting[replica_id.unique_id] = task
+
+    async def _create_replica(
+        self, state: _DeploymentState, replica_id: ReplicaID
+    ) -> None:
+        from ray_tpu.serve._private.replica import Replica
+
+        core = worker_mod._core()
+        cfg = state.config
+        try:
+            opts = dict(cfg.ray_actor_options)
+            resources = {"CPU": float(opts.get("num_cpus", 0.1))}
+            if opts.get("num_tpus"):
+                resources["TPU"] = float(opts["num_tpus"])
+            for k, v in (opts.get("resources") or {}).items():
+                resources[k] = float(v)
+            init_args, init_kwargs = cloudpickle.loads(state.spec["init_args_blob"])
+            actor_id = await core.create_actor(
+                cloudpickle.dumps(Replica),
+                f"ServeReplica:{state.dep_id.app_name}:{state.dep_id.name}",
+                (
+                    state.spec["serialized_cls"],
+                    init_args,
+                    init_kwargs,
+                    str(state.dep_id),
+                    replica_id.unique_id,
+                    cfg.user_config,
+                ),
+                {},
+                resources=resources,
+                max_concurrency=max(cfg.max_ongoing_requests, 8),
+                name=replica_id.to_actor_name(),
+                namespace="serve",
+                lifetime="detached",
+            )
+            # Readiness ping (also surfaces user __init__ errors).
+            refs = await core.submit_actor_task(
+                actor_id, "check_health", (), {}, num_returns=1
+            )
+            await asyncio.wait_for(
+                core.get_objects(refs[0], timeout=None),
+                timeout=cfg.health_check_timeout_s,
+            )
+            rec = _ReplicaRecord(replica_id, actor_id, cfg.max_ongoing_requests)
+            rec.ready = True
+            state.replicas[replica_id.unique_id] = rec
+            rec.health_task = asyncio.ensure_future(self._health_loop(state, rec))
+            state.message = ""
+            self._broadcast_replicas(str(state.dep_id))
+        except Exception as e:
+            state.status = DeploymentStatus.UNHEALTHY
+            state.message = f"replica start failed: {type(e).__name__}: {e}"
+            logger.warning(
+                "replica %s of %s failed to start: %s",
+                replica_id.unique_id,
+                state.dep_id,
+                state.message,
+            )
+        finally:
+            state.starting.pop(replica_id.unique_id, None)
+
+    async def _health_loop(self, state: _DeploymentState, rec: _ReplicaRecord) -> None:
+        """Periodic replica health check (reference deployment_state.py
+        check_health path): 3 consecutive failures -> replace the replica."""
+        core = worker_mod._core()
+        period = state.config.health_check_period_s
+        while rec.replica_id.unique_id in state.replicas and not self._shutdown:
+            await asyncio.sleep(period)
+            try:
+                refs = await core.submit_actor_task(
+                    rec.actor_id, "check_health", (), {}, num_returns=1
+                )
+                await asyncio.wait_for(
+                    core.get_objects(refs[0], timeout=None),
+                    timeout=state.config.health_check_timeout_s,
+                )
+                rec.consecutive_health_failures = 0
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                rec.consecutive_health_failures += 1
+                if rec.consecutive_health_failures >= 3:
+                    if rec.replica_id.unique_id in state.replicas:
+                        logger.warning(
+                            "replica %s of %s failed health checks; replacing",
+                            rec.replica_id.unique_id,
+                            state.dep_id,
+                        )
+                        self._start_stopping(state, rec)
+                    return
+
+    def _start_stopping(self, state: _DeploymentState, rec: _ReplicaRecord) -> None:
+        if rec.health_task is not None:
+            rec.health_task.cancel()
+            rec.health_task = None
+        state.replicas.pop(rec.replica_id.unique_id, None)
+        self._broadcast_replicas(str(state.dep_id))
+        task = asyncio.ensure_future(self._stop_replica(state, rec))
+        state.stopping[rec.replica_id.unique_id] = task
+
+    async def _stop_replica(self, state: _DeploymentState, rec: _ReplicaRecord) -> None:
+        core = worker_mod._core()
+        try:
+            refs = await core.submit_actor_task(
+                rec.actor_id,
+                "prepare_for_shutdown",
+                (state.config.graceful_shutdown_timeout_s,),
+                {},
+                num_returns=1,
+            )
+            await asyncio.wait_for(
+                core.get_objects(refs[0], timeout=None),
+                timeout=state.config.graceful_shutdown_timeout_s + 5,
+            )
+        except Exception:
+            pass
+        try:
+            await core.kill_actor(rec.actor_id)
+        except Exception:
+            pass
+        state.stopping.pop(rec.replica_id.unique_id, None)
+
+    # -- autoscaling ---------------------------------------------------------
+
+    def _autoscale(self, state: _DeploymentState) -> None:
+        ac = state.config.autoscaling_config
+        if ac is None or state.deleting:
+            return
+        now = time.monotonic()
+        # Sample metrics (fire-and-forget gather; cheap at control-loop rate).
+        asyncio.ensure_future(self._sample_metrics(state, now, ac))
+        window = [(t, v) for (t, v) in state.metrics_window if now - t <= ac.look_back_period_s]
+        state.metrics_window = window
+        if not window:
+            return
+        avg_total = sum(v for _, v in window) / len(window)
+        desired = max(
+            ac.min_replicas,
+            min(ac.max_replicas, round(avg_total / max(ac.target_ongoing_requests, 1e-9))),
+        )
+        cur = state.target_replicas
+        if desired > cur and now - state.autoscale_decision_ts >= ac.upscale_delay_s:
+            state.current_target = desired
+            state.autoscale_decision_ts = now
+        elif desired < cur and now - state.autoscale_decision_ts >= ac.downscale_delay_s:
+            state.current_target = desired
+            state.autoscale_decision_ts = now
+
+    async def _sample_metrics(
+        self, state: _DeploymentState, ts: float, ac: AutoscalingConfig
+    ) -> None:
+        core = worker_mod._core()
+        total = 0
+        for rec in list(state.replicas.values()):
+            if not rec.ready:
+                continue
+            try:
+                refs = await core.submit_actor_task(
+                    rec.actor_id, "get_metrics", (), {}, num_returns=1
+                )
+                m = await asyncio.wait_for(
+                    core.get_objects(refs[0], timeout=None), timeout=2
+                )
+                total += m.get("num_ongoing_requests", 0)
+                rec.consecutive_health_failures = 0
+            except Exception:
+                rec.consecutive_health_failures += 1
+                if rec.consecutive_health_failures >= 3:
+                    logger.warning(
+                        "replica %s unhealthy; replacing", rec.replica_id.unique_id
+                    )
+                    self._start_stopping(state, rec)
+        state.metrics_window.append((ts, total))

@@ -1,0 +1,164 @@
+"""HTTP ingress proxy actor.
+
+Analog of python/ray/serve/_private/proxy.py (ProxyActor): an aiohttp server
+inside an async actor. Routes by longest matching route prefix (route table
+pushed from the controller via long-poll), then hands the request to the
+ingress deployment through the shared pow-2 Router. The controller is never
+on the request path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu.actor import ActorHandle
+from ray_tpu.serve._private.long_poll import LongPollClient
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class HTTPRequest:
+    """Picklable request passed to ingress deployments (stand-in for the
+    reference's starlette Request)."""
+
+    method: str = "GET"
+    path: str = "/"
+    query: Dict[str, str] = field(default_factory=dict)
+    headers: Dict[str, str] = field(default_factory=dict)
+    body: bytes = b""
+
+    def json(self) -> Any:
+        return json.loads(self.body or b"null")
+
+    def text(self) -> str:
+        return (self.body or b"").decode()
+
+
+def _to_response(result: Any) -> Tuple[int, bytes, str]:
+    if isinstance(result, tuple) and len(result) == 2 and isinstance(result[0], int):
+        status, body = result
+        _, b, ct = _to_response(body)
+        return status, b, ct
+    if isinstance(result, bytes):
+        return 200, result, "application/octet-stream"
+    if isinstance(result, str):
+        return 200, result.encode(), "text/plain; charset=utf-8"
+    return 200, json.dumps(result).encode(), "application/json"
+
+
+class ProxyActor:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self._host = host
+        self._port = port
+        self._route_table: Dict[str, Dict[str, str]] = {}
+        self._router = None
+        self._runner = None
+        self._poll: Optional[LongPollClient] = None
+
+    async def _get_controller_handle(self) -> ActorHandle:
+        core = worker_mod._core()
+        reply = await core.gcs.call(
+            "GetNamedActor", {"name": "SERVE_CONTROLLER", "namespace": "serve"}
+        )
+        return ActorHandle(reply["actor"]["actor_id"])
+
+    async def ready(self) -> Dict[str, Any]:
+        """Bind the HTTP server; returns the bound address."""
+        if self._runner is not None:
+            return {"host": self._host, "port": self._port}
+        from aiohttp import web
+
+        from ray_tpu.serve._private.router import Router
+
+        core = worker_mod._core()
+        controller = await self._get_controller_handle()
+        self._router = Router(controller, core)
+
+        async def listen(keys_to_ids):
+            refs = await core.submit_actor_task(
+                controller._actor_id,
+                "listen_for_change",
+                (keys_to_ids,),
+                {},
+                num_returns=1,
+            )
+            return await core.get_objects(refs[0], timeout=None)
+
+        self._poll = LongPollClient(
+            listen, {"route_table": self._set_route_table}
+        )
+        self._poll.start()
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self._host, self._port)
+        await site.start()
+        if self._port == 0:
+            self._port = site._server.sockets[0].getsockname()[1]
+        return {"host": self._host, "port": self._port}
+
+    def _set_route_table(self, table: Dict[str, Dict[str, str]]) -> None:
+        self._route_table = table or {}
+
+    def _match_route(self, path: str) -> Optional[Tuple[str, Dict[str, str]]]:
+        best = None
+        for prefix, target in self._route_table.items():
+            norm = prefix.rstrip("/") or "/"
+            if path == norm or path.startswith(norm if norm != "/" else "/"):
+                if norm != "/" and not (
+                    path == norm or path[len(norm) :].startswith("/")
+                ):
+                    continue
+                if best is None or len(norm) > len(best[0]):
+                    best = (norm, target)
+        return best
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        path = request.path
+        if path == "/-/healthz":
+            return web.Response(text="success")
+        if path == "/-/routes":
+            return web.json_response(
+                {p: t["app"] for p, t in self._route_table.items()}
+            )
+        match = self._match_route(path)
+        if match is None:
+            return web.Response(status=404, text=f"no route for {path}")
+        prefix, target = match
+        dep_id_str = f"{target['app']}#{target['ingress']}"
+        body = await request.read()
+        http_req = HTTPRequest(
+            method=request.method,
+            path=path[len(prefix) :] if prefix != "/" else path,
+            query=dict(request.query),
+            headers=dict(request.headers),
+            body=body,
+        )
+        try:
+            result = await self._router.assign_request(
+                dep_id_str,
+                {"call_method": "__call__", "is_http_request": True},
+                (http_req,),
+                {},
+                timeout_s=60.0,
+            )
+        except TimeoutError as e:
+            return web.Response(status=503, text=str(e))
+        except Exception as e:
+            logger.warning("request to %s failed: %r", dep_id_str, e)
+            return web.Response(status=500, text=f"{type(e).__name__}: {e}")
+        status, payload, ctype = _to_response(result)
+        return web.Response(status=status, body=payload, content_type=ctype.split(";")[0])
+
+    async def check_health(self) -> bool:
+        return True

@@ -1,0 +1,114 @@
+"""Replica actor: hosts one copy of a user deployment.
+
+Analog of python/ray/serve/_private/replica.py (ReplicaActor:231): wraps the
+user callable, tracks ongoing-request count (consumed by the pow-2 router and
+the autoscaler), exposes health checks and reconfigure.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import logging
+from typing import Any, Dict, Optional, Tuple
+
+import cloudpickle
+
+logger = logging.getLogger(__name__)
+
+
+class Replica:
+    """The actor class the controller instantiates per replica."""
+
+    def __init__(
+        self,
+        serialized_cls: bytes,
+        init_args: Tuple,
+        init_kwargs: Dict,
+        deployment_id_str: str,
+        replica_id_str: str,
+        user_config: Any = None,
+    ):
+        cls = cloudpickle.loads(serialized_cls)
+        self._deployment_id_str = deployment_id_str
+        self._replica_id_str = replica_id_str
+        self._num_ongoing = 0
+        self._total_served = 0
+        self._shutting_down = False
+        if inspect.isfunction(cls):
+            # Function deployments: wrap into a callable instance.
+            fn = cls
+
+            class _FnWrapper:
+                def __call__(self, *a, **k):
+                    return fn(*a, **k)
+
+            self._user = _FnWrapper()
+        else:
+            self._user = cls(*init_args, **init_kwargs)
+        if user_config is not None:
+            self._apply_reconfigure(user_config)
+
+    def _apply_reconfigure(self, user_config: Any) -> None:
+        reconfigure = getattr(self._user, "reconfigure", None)
+        if reconfigure is None:
+            raise RuntimeError(
+                "user_config was set but the deployment has no reconfigure()"
+            )
+        reconfigure(user_config)
+
+    # -- data plane ----------------------------------------------------------
+
+    async def handle_request(
+        self, request_meta: Dict[str, Any], args: Tuple, kwargs: Dict
+    ) -> Any:
+        """Run one request through the user callable. Called concurrently up
+        to max_ongoing_requests (actor max_concurrency)."""
+        self._num_ongoing += 1
+        self._total_served += 1
+        try:
+            method_name = request_meta.get("call_method", "__call__")
+            method = getattr(self._user, method_name)
+            if inspect.iscoroutinefunction(method):
+                return await method(*args, **kwargs)
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(None, lambda: method(*args, **kwargs))
+        finally:
+            self._num_ongoing -= 1
+
+    # -- control plane -------------------------------------------------------
+
+    async def get_metrics(self) -> Dict[str, Any]:
+        return {
+            "replica_id": self._replica_id_str,
+            "num_ongoing_requests": self._num_ongoing,
+            "total_served": self._total_served,
+        }
+
+    async def check_health(self) -> bool:
+        user_check = getattr(self._user, "check_health", None)
+        if user_check is not None:
+            if inspect.iscoroutinefunction(user_check):
+                await user_check()
+            else:
+                user_check()
+        return True
+
+    async def reconfigure(self, user_config: Any) -> None:
+        self._apply_reconfigure(user_config)
+
+    async def prepare_for_shutdown(self, timeout_s: float = 10.0) -> None:
+        """Drain: wait for ongoing requests to finish (graceful shutdown,
+        reference replica.py perform_graceful_shutdown)."""
+        self._shutting_down = True
+        deadline = asyncio.get_running_loop().time() + timeout_s
+        while self._num_ongoing > 0:
+            if asyncio.get_running_loop().time() > deadline:
+                break
+            await asyncio.sleep(0.05)
+        user_del = getattr(self._user, "__del__", None)
+        if user_del is not None:
+            try:
+                user_del()
+            except Exception:
+                pass

@@ -1,0 +1,176 @@
+"""Request router: pow-2-choices replica scheduling with local in-flight counts.
+
+Analog of python/ray/serve/_private/router.py (Router:312) +
+replica_scheduler/pow_2_scheduler.py: the router keeps a live replica set per
+deployment (pushed from the controller via long-poll) and assigns each request
+to the less-loaded of two randomly sampled replicas, respecting
+max_ongoing_requests with backpressure.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.actor import ActorHandle
+from ray_tpu.serve._private.common import RunningReplicaInfo
+from ray_tpu.serve._private.long_poll import LongPollClient
+
+logger = logging.getLogger(__name__)
+
+
+class _ReplicaSet:
+    def __init__(self):
+        self.replicas: List[RunningReplicaInfo] = []
+        self.handles: Dict[str, ActorHandle] = {}
+        self.ongoing: Dict[str, int] = {}
+        self.nonempty = asyncio.Event()
+        self.slot_freed = asyncio.Event()
+
+    def update(self, infos: List[RunningReplicaInfo]) -> None:
+        self.replicas = infos
+        new_ids = {r.replica_id_str for r in infos}
+        for info in infos:
+            if info.replica_id_str not in self.handles:
+                self.handles[info.replica_id_str] = ActorHandle(info.actor_id)
+                self.ongoing.setdefault(info.replica_id_str, 0)
+        for rid in list(self.handles):
+            if rid not in new_ids:
+                del self.handles[rid]
+                self.ongoing.pop(rid, None)
+        if infos:
+            self.nonempty.set()
+        else:
+            self.nonempty.clear()
+
+
+class Router:
+    """One per handle-owning process per deployment-consumer (driver, replica,
+    or proxy)."""
+
+    def __init__(self, controller_handle: ActorHandle, core):
+        self._controller = controller_handle
+        self._core = core
+        self._sets: Dict[str, _ReplicaSet] = {}
+        self._poll_client: Optional[LongPollClient] = None
+        self._watched: Dict[str, bool] = {}
+
+    def _replica_set(self, deployment_id_str: str) -> _ReplicaSet:
+        rs = self._sets.get(deployment_id_str)
+        if rs is None:
+            rs = _ReplicaSet()
+            self._sets[deployment_id_str] = rs
+        return rs
+
+    async def _listen(self, keys_to_ids: Dict[str, int]):
+        refs = await self._core.submit_actor_task(
+            self._controller._actor_id,
+            "listen_for_change",
+            (keys_to_ids,),
+            {},
+            num_returns=1,
+        )
+        return await self._core.get_objects(refs[0], timeout=None)
+
+    def watch(self, deployment_id_str: str) -> None:
+        """Subscribe to replica-set updates for a deployment (idempotent).
+        Restarts the long-poll client with the union of watched keys."""
+        if self._watched.get(deployment_id_str):
+            return
+        self._watched[deployment_id_str] = True
+        if self._poll_client is not None:
+            self._poll_client.stop()
+        listeners = {}
+        for dep in self._watched:
+            key = f"replicas::{dep}"
+
+            def make_cb(dep_id=dep):
+                def cb(value):
+                    infos = [RunningReplicaInfo.from_dict(d) for d in (value or [])]
+                    self._replica_set(dep_id).update(infos)
+
+                return cb
+
+            listeners[key] = make_cb()
+        self._poll_client = LongPollClient(self._listen, listeners)
+        self._poll_client.start()
+
+    def shutdown(self) -> None:
+        if self._poll_client is not None:
+            self._poll_client.stop()
+
+    # -- scheduling ----------------------------------------------------------
+
+    def _pick_replica(self, rs: _ReplicaSet) -> Optional[RunningReplicaInfo]:
+        candidates = [
+            r
+            for r in rs.replicas
+            if rs.ongoing.get(r.replica_id_str, 0) < r.max_ongoing_requests
+        ]
+        if not candidates:
+            return None
+        sampled = random.sample(candidates, min(2, len(candidates)))
+        return min(sampled, key=lambda r: rs.ongoing.get(r.replica_id_str, 0))
+
+    async def assign_request(
+        self,
+        deployment_id_str: str,
+        request_meta: Dict[str, Any],
+        args: Tuple,
+        kwargs: Dict,
+        timeout_s: Optional[float] = None,
+    ) -> Any:
+        """Route one request and return its result value."""
+        self.watch(deployment_id_str)
+        rs = self._replica_set(deployment_id_str)
+        loop = asyncio.get_running_loop()
+        deadline = None if timeout_s is None else loop.time() + timeout_s
+        while True:
+            if not rs.replicas:
+                wait = None if deadline is None else max(0, deadline - loop.time())
+                try:
+                    await asyncio.wait_for(rs.nonempty.wait(), timeout=wait)
+                except asyncio.TimeoutError:
+                    raise TimeoutError(
+                        f"no replicas of {deployment_id_str} available"
+                    ) from None
+            replica = self._pick_replica(rs)
+            if replica is not None:
+                break
+            # All replicas at max_ongoing_requests: wait for a slot.
+            rs.slot_freed.clear()
+            try:
+                await asyncio.wait_for(
+                    rs.slot_freed.wait(),
+                    timeout=0.5
+                    if deadline is None
+                    else min(0.5, max(0.01, deadline - loop.time())),
+                )
+            except asyncio.TimeoutError:
+                if deadline is not None and loop.time() > deadline:
+                    raise TimeoutError(
+                        f"backpressure timeout for {deployment_id_str}"
+                    ) from None
+        rid = replica.replica_id_str
+        rs.ongoing[rid] = rs.ongoing.get(rid, 0) + 1
+        try:
+            refs = await self._core.submit_actor_task(
+                self._handle_for(rs, replica)._actor_id,
+                "handle_request",
+                (request_meta, args, kwargs),
+                {},
+                num_returns=1,
+            )
+            return await self._core.get_objects(refs[0], timeout=None)
+        finally:
+            rs.ongoing[rid] = max(0, rs.ongoing.get(rid, 1) - 1)
+            rs.slot_freed.set()
+
+    def _handle_for(self, rs: _ReplicaSet, info: RunningReplicaInfo) -> ActorHandle:
+        h = rs.handles.get(info.replica_id_str)
+        if h is None:
+            h = ActorHandle(info.actor_id)
+            rs.handles[info.replica_id_str] = h
+        return h

@@ -1,0 +1,76 @@
+"""User-facing Serve config dataclasses.
+
+Analog of python/ray/serve/schema.py + config.py (DeploymentConfig,
+AutoscalingConfig, HTTPOptions) — plain dataclasses, no pydantic dependency.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class AutoscalingConfig:
+    """Queue-length autoscaling (reference: serve/config.py AutoscalingConfig;
+    policy in serve/_private/autoscaling_state.py).
+
+    Desired replicas = total ongoing requests / target_ongoing_requests,
+    clamped to [min_replicas, max_replicas], smoothed by upscale/downscale
+    delays.
+    """
+
+    min_replicas: int = 1
+    max_replicas: int = 1
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 3.0
+    downscale_delay_s: float = 60.0
+    metrics_interval_s: float = 0.5
+    look_back_period_s: float = 5.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "AutoscalingConfig":
+        return cls(**d)
+
+
+@dataclass
+class DeploymentConfig:
+    """Per-deployment config (reference: serve/config.py DeploymentConfig)."""
+
+    num_replicas: int = 1
+    max_ongoing_requests: int = 16
+    user_config: Optional[Any] = None
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    health_check_period_s: float = 2.0
+    health_check_timeout_s: float = 30.0
+    graceful_shutdown_timeout_s: float = 10.0
+    ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = asdict(self)
+        if self.autoscaling_config is not None:
+            d["autoscaling_config"] = self.autoscaling_config.to_dict()
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "DeploymentConfig":
+        d = dict(d)
+        if d.get("autoscaling_config"):
+            d["autoscaling_config"] = AutoscalingConfig.from_dict(
+                d["autoscaling_config"]
+            )
+        return cls(**d)
+
+
+@dataclass
+class HTTPOptions:
+    """Proxy config (reference: serve/config.py HTTPOptions)."""
+
+    host: str = "127.0.0.1"
+    port: int = 8000
+
+    def to_dict(self) -> Dict[str, Any]:
+        return asdict(self)

@@ -1,0 +1,233 @@
+"""Serve tests (analog of python/ray/serve/tests: basic deploy, handles,
+composition, HTTP ingress, autoscaling config, redeploy, replica recovery)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+
+@pytest.fixture
+def serve_cluster():
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=8, num_tpus=0)
+    yield serve
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _http_get(url: str, timeout: float = 10.0) -> bytes:
+    return urllib.request.urlopen(url, timeout=timeout).read()
+
+
+def test_deploy_and_handle(serve_cluster):
+    serve = serve_cluster
+
+    @serve.deployment
+    class Doubler:
+        def __call__(self, x):
+            return x * 2
+
+        def triple(self, x):
+            return x * 3
+
+    handle = serve.run(Doubler.bind(), route_prefix=None)
+    assert handle.remote(21).result(timeout_s=30) == 42
+    # Method routing via attribute access.
+    assert handle.triple.remote(10).result(timeout_s=30) == 30
+
+
+def test_multiple_replicas_and_status(serve_cluster):
+    serve = serve_cluster
+
+    @serve.deployment(num_replicas=2)
+    class Echo:
+        def __call__(self, x):
+            import os
+
+            return (os.getpid(), x)
+
+    handle = serve.run(Echo.bind(), route_prefix=None)
+    pids = {handle.remote(i).result(timeout_s=30)[0] for i in range(20)}
+    assert len(pids) == 2, f"expected both replicas used, saw pids {pids}"
+
+    st = serve.status()
+    app = st["default"]
+    assert app["status"] == "RUNNING"
+    dep = app["deployments"]["Echo"]
+    assert dep["replica_states"]["RUNNING"] == 2
+
+
+def test_model_composition(serve_cluster):
+    serve = serve_cluster
+
+    @serve.deployment
+    class Adder:
+        def __init__(self, increment):
+            self.increment = increment
+
+        def __call__(self, x):
+            return x + self.increment
+
+    @serve.deployment
+    class Combiner:
+        def __init__(self, a, b):
+            self.a = a
+            self.b = b
+
+        async def __call__(self, x):
+            ra = self.a.remote(x)
+            rb = self.b.remote(x)
+            return (await ra) + (await rb)
+
+    app = Combiner.bind(Adder.bind(1), Adder.options(name="Adder2").bind(2))
+    handle = serve.run(app, route_prefix=None)
+    # (10+1) + (10+2) = 23
+    assert handle.remote(10).result(timeout_s=30) == 23
+
+
+def test_http_ingress(serve_cluster):
+    serve = serve_cluster
+
+    @serve.deployment
+    class Api:
+        def __call__(self, request):
+            if request.path.endswith("/json"):
+                return {"method": request.method, "q": request.query.get("q")}
+            return f"hello {request.text() or 'world'}"
+
+    serve.run(Api.bind(), name="app1", route_prefix="/api")
+    http = serve.status()  # ensure running
+    assert http["app1"]["status"] == "RUNNING"
+
+    import ray_tpu
+
+    controller = ray_tpu.get_actor("SERVE_CONTROLLER", namespace="serve")
+    cfg = ray_tpu.get(controller.get_http_config.remote())
+    base = f"http://{cfg['host']}:{cfg['port']}"
+
+    assert _http_get(f"{base}/-/healthz") == b"success"
+    body = _http_get(f"{base}/api/json?q=5")
+    assert json.loads(body) == {"method": "GET", "q": "5"}
+    assert _http_get(f"{base}/api") == b"hello world"
+    with pytest.raises(Exception):
+        _http_get(f"{base}/nope")
+
+
+def test_redeploy_and_delete(serve_cluster):
+    serve = serve_cluster
+
+    @serve.deployment
+    class V:
+        def __call__(self, _):
+            return "v1"
+
+    serve.run(V.bind(), route_prefix=None)
+    h = serve.get_app_handle()
+    assert h.remote(None).result(timeout_s=30) == "v1"
+
+    @serve.deployment(name="V")
+    class V2:
+        def __call__(self, _):
+            return "v2"
+
+    serve.run(V2.bind(), route_prefix=None)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if serve.get_app_handle().remote(None).result(timeout_s=30) == "v2":
+            break
+        time.sleep(0.2)
+    assert serve.get_app_handle().remote(None).result(timeout_s=30) == "v2"
+
+    serve.delete("default")
+    assert "default" not in serve.status()
+
+
+def test_autoscaling_scales_up(serve_cluster):
+    serve = serve_cluster
+
+    @serve.deployment(
+        autoscaling_config=serve.AutoscalingConfig(
+            min_replicas=1,
+            max_replicas=3,
+            target_ongoing_requests=1.0,
+            upscale_delay_s=0.5,
+            look_back_period_s=2.0,
+            metrics_interval_s=0.2,
+        ),
+        max_ongoing_requests=2,
+    )
+    class Slow:
+        async def __call__(self, _):
+            import asyncio
+
+            await asyncio.sleep(0.4)
+            return "ok"
+
+    handle = serve.run(Slow.bind(), route_prefix=None)
+    # Flood with concurrent requests to trigger upscale.
+    responses = [handle.remote(None) for _ in range(24)]
+    for r in responses:
+        assert r.result(timeout_s=60) == "ok"
+    deadline = time.monotonic() + 20
+    saw = 1
+    while time.monotonic() < deadline:
+        dep = serve.status()["default"]["deployments"]["Slow"]
+        saw = max(saw, dep["target_replicas"])
+        if saw > 1:
+            break
+        responses = [handle.remote(None) for _ in range(12)]
+        for r in responses:
+            r.result(timeout_s=60)
+    assert saw > 1, "autoscaler never scaled up"
+
+
+def test_replica_recovery_after_kill(serve_cluster):
+    serve = serve_cluster
+    import ray_tpu
+
+    @serve.deployment
+    class Sturdy:
+        def __call__(self, x):
+            return x + 1
+
+    handle = serve.run(Sturdy.bind(), route_prefix=None)
+    assert handle.remote(1).result(timeout_s=30) == 2
+
+    # Kill the replica actor out from under the controller.
+    st = serve.status()
+    assert st["default"]["deployments"]["Sturdy"]["replica_states"]["RUNNING"] == 1
+    names = [
+        a
+        for a in ray_tpu.get(
+            ray_tpu.get_actor("SERVE_CONTROLLER", namespace="serve")
+            .get_serve_status.remote()
+        )
+    ]
+    # Find replica actor by its registered name prefix.
+    import ray_tpu._private.worker as worker_mod
+
+    reply = worker_mod.global_worker.run_async(
+        worker_mod._core().gcs.call("ListNamedActors", {"namespace": "serve"})
+    )
+    replica_names = [
+        n for n in reply.get("names", []) if n.startswith("SERVE_REPLICA::")
+    ]
+    assert replica_names, f"no replica actors registered: {reply}"
+    victim = ray_tpu.get_actor(replica_names[0], namespace="serve")
+    ray_tpu.kill(victim)
+
+    # Controller should notice (health checks) and start a replacement.
+    deadline = time.monotonic() + 60
+    ok = False
+    while time.monotonic() < deadline:
+        try:
+            if handle.remote(5).result(timeout_s=10) == 6:
+                ok = True
+                break
+        except Exception:
+            time.sleep(0.5)
+    assert ok, "service did not recover after replica kill"

@@ -194,7 +194,12 @@ class StreamingExecutor:
     def __init__(self, parallelism: int = 8):
         self.parallelism = parallelism
         self._actor_pools: List[List[Any]] = []
-        self._actor_stage_refs: List[Any] = []
+        # Trailing window of actor-stage outputs: only tasks that may still be
+        # in flight at teardown need sealing; a bounded deque avoids pinning
+        # the whole stage output in the object store.
+        self._actor_stage_refs: collections.deque = collections.deque(
+            maxlen=2 * parallelism + 8
+        )
 
     # Each stage: Iterator[ObjectRef[pa.Table]] -> Iterator[ObjectRef]
 
@@ -211,15 +216,12 @@ class StreamingExecutor:
         # killing the pool: the consumer may not have fetched them yet, and a
         # killed actor can no longer seal its in-flight results.
         if self._actor_stage_refs:
+            pending = list(self._actor_stage_refs)
             try:
-                ray_tpu.wait(
-                    self._actor_stage_refs,
-                    num_returns=len(self._actor_stage_refs),
-                    timeout=60,
-                )
+                ray_tpu.wait(pending, num_returns=len(pending), timeout=60)
             except Exception:
                 pass
-            self._actor_stage_refs = []
+            self._actor_stage_refs.clear()
         for pool in self._actor_pools:
             for a in pool:
                 try:
@@ -307,10 +309,13 @@ class StreamingExecutor:
         slicer = _remote(_slice_concat, num_cpus=0.5)
         remaining = op.n
         upstream = iter(upstream)
+        # Geometric window ramp: small limits stop after 1-2 blocks without
+        # forcing a full parallelism window of upstream work; large limits
+        # still amortize the count round-trips.
+        window = 1
         while remaining > 0:
-            # Count a window of blocks concurrently instead of one round-trip
-            # per block.
-            chunk = list(itertools.islice(upstream, self.parallelism))
+            chunk = list(itertools.islice(upstream, window))
+            window = min(self.parallelism, window * 2)
             if not chunk:
                 break
             counts = ray_tpu.get([counter.remote(r) for r in chunk])

@@ -1,0 +1,57 @@
+"""ray_tpu.rllib: reinforcement learning on the ray_tpu runtime.
+
+Same architecture as the reference's RLlib new API stack (rllib/algorithms,
+rllib/core, rllib/env), JAX-native: RLModules are pure (init, forward)
+function pairs, Learners jit the whole loss→grad→apply step (MXU-friendly on
+TPU), env runners are CPU actors, and multi-learner data parallelism averages
+grads across a learner gang instead of wrapping torch DDP.
+
+    from ray_tpu.rllib import PPOConfig
+
+    algo = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=2)
+        .build_algo()
+    )
+    while algo.train()["episode_return_mean"] < 200:
+        pass
+"""
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.impala import (
+    APPO,
+    APPOConfig,
+    IMPALA,
+    IMPALAConfig,
+)
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.core.learner_group import LearnerGroup
+from ray_tpu.rllib.core.rl_module import RLModuleSpec
+from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
+from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
+from ray_tpu.rllib.utils.actor_manager import FaultTolerantActorManager
+from ray_tpu.rllib.utils.replay_buffer import ReplayBuffer
+
+__all__ = [
+    "Algorithm",
+    "AlgorithmConfig",
+    "APPO",
+    "APPOConfig",
+    "DQN",
+    "DQNConfig",
+    "EnvRunnerGroup",
+    "FaultTolerantActorManager",
+    "IMPALA",
+    "IMPALAConfig",
+    "Learner",
+    "LearnerGroup",
+    "PPO",
+    "PPOConfig",
+    "ReplayBuffer",
+    "RLModuleSpec",
+    "SingleAgentEnvRunner",
+]

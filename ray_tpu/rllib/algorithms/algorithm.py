@@ -1,0 +1,199 @@
+"""Algorithm: the top-level RL training loop object.
+
+Analog of rllib/algorithms/algorithm.py:210 (training_step:1589): owns an
+EnvRunnerGroup and a LearnerGroup, `train()` runs one iteration and returns
+a result dict. `as_trainable()` adapts it to the Tune function-trainable
+protocol so `Tuner(PPOConfig()...build_algo-less)` works the same way the
+reference couples Algorithm to Tune.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.learner_group import LearnerGroup
+from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
+
+
+class Algorithm:
+    # Subclasses set these.
+    policy_kind = "pi_vf"
+
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        self.iteration = 0
+        self._env_steps_total = 0
+        self._start_time = time.time()
+        self._weights_version = 0
+
+        self.env_runner_group = EnvRunnerGroup(
+            env=config.env,
+            env_config=config.env_config,
+            num_env_runners=config.num_env_runners,
+            num_envs_per_env_runner=config.num_envs_per_env_runner,
+            policy_kind=self.policy_kind,
+            module_spec_dict=self._module_spec_dict(),
+            seed=config.seed,
+            restart_failed=config.restart_failed_env_runners,
+            sample_timeout_s=config.sample_timeout_s,
+        )
+        obs_dim, num_actions = self.env_runner_group.get_spaces()
+        self.obs_dim, self.num_actions = obs_dim, num_actions
+
+        self.learner_group = LearnerGroup(
+            self._learner_builder(obs_dim, num_actions),
+            num_learners=config.num_learners,
+            num_cpus_per_learner=config.num_cpus_per_learner,
+            num_tpus_per_learner=config.num_tpus_per_learner,
+        )
+        self._sync_weights()
+
+    # -- subclass hooks ------------------------------------------------------
+
+    def _module_spec_dict(self) -> Dict[str, Any]:
+        m = self.config.model
+        return {
+            "hidden": tuple(m.get("hidden", (64, 64))),
+            "vf_share_layers": bool(m.get("vf_share_layers", False)),
+        }
+
+    def _learner_builder(self, obs_dim: int, num_actions: int) -> Callable[[], Any]:
+        raise NotImplementedError
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    # -- public API ----------------------------------------------------------
+
+    def train(self) -> Dict[str, Any]:
+        self.iteration += 1
+        t0 = time.time()
+        metrics = self.training_step()
+        elapsed = time.time() - t0
+        result = {
+            "training_iteration": self.iteration,
+            "time_this_iter_s": elapsed,
+            "time_total_s": time.time() - self._start_time,
+            "num_env_steps_sampled_lifetime": self._env_steps_total,
+            **metrics,
+        }
+        return result
+
+    def _sync_weights(self) -> None:
+        self._weights_version += 1
+        self.env_runner_group.sync_weights(
+            self.learner_group.get_weights(), self._weights_version
+        )
+
+    def _episode_metrics(self, batches: List[Dict[str, Any]]) -> Dict[str, float]:
+        stats = []
+        for b in batches:
+            stats.extend(b.get("episode_stats", []))
+        if not stats:
+            return {
+                "episode_return_mean": float("nan"),
+                "episode_len_mean": float("nan"),
+            }
+        returns = [s[0] for s in stats]
+        lens = [s[1] for s in stats]
+        return {
+            "episode_return_mean": float(np.mean(returns)),
+            "episode_return_max": float(np.max(returns)),
+            "episode_return_min": float(np.min(returns)),
+            "episode_len_mean": float(np.mean(lens)),
+        }
+
+    # -- checkpointing (reference: Algorithm.save/restore) -------------------
+
+    def save(self, checkpoint_dir: str) -> str:
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
+        state = {
+            "learner": self.learner_group.get_state(),
+            "iteration": self.iteration,
+            "env_steps": self._env_steps_total,
+            "config": self.config.to_dict(),
+        }
+        with open(path, "wb") as f:
+            pickle.dump(state, f)
+        return checkpoint_dir
+
+    def restore(self, checkpoint_dir: str) -> None:
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        self.learner_group.set_state(state["learner"])
+        self.iteration = state["iteration"]
+        self._env_steps_total = state["env_steps"]
+        self._sync_weights()
+
+    def stop(self) -> None:
+        self.env_runner_group.stop()
+        self.learner_group.shutdown()
+
+    # -- Tune integration ----------------------------------------------------
+
+    @classmethod
+    def as_trainable(
+        cls, base_config: AlgorithmConfig, *, stop: Optional[Dict[str, Any]] = None
+    ) -> Callable[[Dict[str, Any]], None]:
+        """Returns a Tune function-trainable: hyperparams from the trial
+        config are applied over base_config via .training()."""
+        stop = stop or {"training_iteration": 10}
+
+        def trainable(trial_config: Dict[str, Any]) -> None:
+            from ray_tpu import train as train_session
+
+            cfg = base_config.copy()
+            for k, v in (trial_config or {}).items():
+                if hasattr(cfg, k):
+                    setattr(cfg, k, v)
+            algo = cls(config=cfg)
+            try:
+                while True:
+                    result = algo.train()
+                    train_session.report(result)
+                    if any(
+                        result.get(k) is not None and result[k] >= v
+                        for k, v in stop.items()
+                    ):
+                        break
+            finally:
+                algo.stop()
+
+        trainable.__name__ = cls.__name__
+        return trainable
+
+
+def gae_advantages(
+    rewards: np.ndarray,
+    values: np.ndarray,
+    terminateds: np.ndarray,
+    truncateds: np.ndarray,
+    bootstrap_value: np.ndarray,
+    gamma: float,
+    lam: float,
+):
+    """Generalized advantage estimation over time-major [T, N] arrays
+    (reference: rllib/evaluation/postprocessing.py compute_gae_for_sample_batch,
+    vectorized). Truncation bootstraps with V(s_t+1); termination zeroes it."""
+    T, N = rewards.shape
+    adv = np.zeros((T, N), dtype=np.float32)
+    next_value = bootstrap_value.astype(np.float32)
+    gae = np.zeros(N, dtype=np.float32)
+    for t in range(T - 1, -1, -1):
+        # Episode boundary handling: terminated -> no bootstrap; truncated ->
+        # bootstrap but reset the GAE accumulator.
+        nonterminal = 1.0 - terminateds[t].astype(np.float32)
+        boundary = np.logical_or(terminateds[t], truncateds[t])
+        delta = rewards[t] + gamma * next_value * nonterminal - values[t]
+        gae = delta + gamma * lam * nonterminal * np.where(boundary, 0.0, 1.0) * gae
+        adv[t] = gae
+        next_value = values[t]
+    returns = adv + values
+    return adv, returns

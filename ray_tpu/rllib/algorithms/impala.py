@@ -1,0 +1,292 @@
+"""IMPALA: asynchronous sampling with V-trace off-policy correction.
+
+Analog of rllib/algorithms/impala/impala.py (async pipeline + weight
+broadcast at impala.py:1152–1217): env runners sample continuously (no sync
+barrier); the learner consumes batches as they land, corrects for policy lag
+with V-trace (Espeholt et al. 2018), and broadcasts fresh weights to each
+runner as its next sample request is issued. APPO = same pipeline with the
+PPO surrogate on top of V-trace advantages.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.core.rl_module import RLModuleSpec, forward_pi_vf, init_pi_vf
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or IMPALA)
+        self.lr = 5e-4
+        self.rollout_fragment_length = 50
+        self.vtrace_clip_rho_threshold = 1.0
+        self.vtrace_clip_c_threshold = 1.0
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.batches_per_iteration = 8
+        self.broadcast_interval = 1  # updates between weight pushes
+        self.num_env_runners = 2
+
+
+def _vtrace(
+    behavior_logp,
+    target_logp,
+    rewards,
+    values,
+    bootstrap_value,
+    terminateds,
+    gamma,
+    clip_rho,
+    clip_c,
+):
+    """V-trace targets/advantages over time-major [T, B] jnp arrays, computed
+    inside the jitted loss (lax.scan over reversed time)."""
+    import jax
+    import jax.numpy as jnp
+
+    rhos = jnp.exp(target_logp - behavior_logp)
+    clipped_rhos = jnp.minimum(clip_rho, rhos)
+    clipped_cs = jnp.minimum(clip_c, rhos)
+    discounts = gamma * (1.0 - terminateds)
+    values_tp1 = jnp.concatenate([values[1:], bootstrap_value[None]], axis=0)
+    deltas = clipped_rhos * (rewards + discounts * values_tp1 - values)
+
+    def scan_fn(acc, xs):
+        delta, discount, c = xs
+        acc = delta + discount * c * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        scan_fn,
+        jnp.zeros_like(bootstrap_value),
+        (deltas[::-1], discounts[::-1], clipped_cs[::-1]),
+    )
+    vs_minus_v = vs_minus_v[::-1]
+    vs = values + vs_minus_v
+    vs_tp1 = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
+    pg_advantages = clipped_rhos * (rewards + discounts * vs_tp1 - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_advantages)
+
+
+class IMPALALearner(Learner):
+    def __init__(self, spec: RLModuleSpec, cfg: Dict[str, Any], **kw):
+        self.cfg = cfg
+        super().__init__(spec, **kw)
+
+    def init_params(self, rng):
+        return init_pi_vf(rng, self.spec)
+
+    def loss_fn(self, params, batch):
+        import jax
+        import jax.numpy as jnp
+
+        c = self.cfg
+        T, B = batch["rewards"].shape
+        obs = batch["obs"].reshape(T * B, -1)
+        logits, values = forward_pi_vf(params, obs)
+        logits = logits.reshape(T, B, -1)
+        values = values.reshape(T, B)
+        logp_all = jax.nn.log_softmax(logits)
+        target_logp = jnp.take_along_axis(
+            logp_all, batch["actions"][..., None], axis=-1
+        )[..., 0]
+
+        vs, pg_adv = _vtrace(
+            batch["behavior_logp"],
+            target_logp,
+            batch["rewards"],
+            jax.lax.stop_gradient(values),
+            batch["bootstrap_value"],
+            batch["terminateds"].astype(jnp.float32),
+            c["gamma"],
+            c["clip_rho"],
+            c["clip_c"],
+        )
+        policy_loss = -jnp.mean(target_logp * pg_adv)
+        vf_loss = 0.5 * jnp.mean(jnp.square(values - vs))
+        probs = jax.nn.softmax(logits)
+        entropy = -jnp.mean(jnp.sum(probs * logp_all, axis=-1))
+        loss = policy_loss + c["vf_loss_coeff"] * vf_loss - c["entropy_coeff"] * entropy
+        return loss, {
+            "policy_loss": policy_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+        }
+
+
+class IMPALA(Algorithm):
+    policy_kind = "pi_vf"
+
+    def _learner_builder(self, obs_dim: int, num_actions: int) -> Callable[[], Any]:
+        cfg = self.config
+        spec = RLModuleSpec(
+            obs_dim=obs_dim,
+            num_actions=num_actions,
+            hidden=tuple(cfg.model.get("hidden", (64, 64))),
+        )
+        loss_cfg = {
+            "gamma": cfg.gamma,
+            "clip_rho": cfg.vtrace_clip_rho_threshold,
+            "clip_c": cfg.vtrace_clip_c_threshold,
+            "vf_loss_coeff": cfg.vf_loss_coeff,
+            "entropy_coeff": cfg.entropy_coeff,
+        }
+        lr, grad_clip, seed = cfg.lr, cfg.grad_clip, cfg.seed
+
+        def build():
+            return IMPALALearner(spec, loss_cfg, lr=lr, grad_clip=grad_clip, seed=seed)
+
+        return build
+
+    def __init__(self, config: AlgorithmConfig):
+        if config.num_env_runners < 1:
+            raise ValueError("IMPALA requires num_env_runners >= 1")
+        super().__init__(config)
+        self._inflight: Dict[Any, int] = {}  # ref -> actor_idx
+        self._updates_since_broadcast: Dict[int, int] = {}
+
+    def _ensure_inflight(self) -> None:
+        cfg = self.config
+        have = set(self._inflight.values())
+        mgr = self.env_runner_group._manager
+        for i in mgr.healthy_actor_ids():
+            if i not in have:
+                ref = self.env_runner_group.submit_sample(
+                    i, cfg.rollout_fragment_length
+                )
+                self._inflight[ref] = i
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        batches_done: List[Dict[str, Any]] = []
+        metrics: Dict[str, float] = {}
+        stale_total = 0
+        while len(batches_done) < cfg.batches_per_iteration:
+            self._ensure_inflight()
+            if not self._inflight:
+                raise RuntimeError("no healthy env runners for IMPALA")
+            ready, _ = ray_tpu.wait(
+                list(self._inflight), num_returns=1, timeout=cfg.sample_timeout_s
+            )
+            if not ready:
+                continue
+            ref = ready[0]
+            actor_idx = self._inflight.pop(ref)
+            try:
+                batch = ray_tpu.get(ref)
+            except Exception:
+                self.env_runner_group.mark_unhealthy(actor_idx)
+                continue
+            self._env_steps_total += batch["env_steps"]
+            stale_total += self._weights_version - batch["weights_version"]
+
+            train_batch = {
+                "obs": batch["obs"],
+                "actions": batch["actions"],
+                "behavior_logp": batch["logp"],
+                "rewards": batch["rewards"],
+                "terminateds": batch["terminateds"],
+                "bootstrap_value": batch["bootstrap_value"],
+            }
+            metrics = self.learner_group.update_from_batch(train_batch)
+            batches_done.append(batch)
+
+            # Async weight push to this runner, then immediately resubmit its
+            # next sample so it never idles (reference impala.py broadcast).
+            n = self._updates_since_broadcast.get(actor_idx, 0) + 1
+            if n >= cfg.broadcast_interval:
+                self._weights_version += 1
+                self.env_runner_group._manager.actors[actor_idx].set_weights.remote(
+                    self.learner_group.get_weights(), self._weights_version
+                )
+                self._updates_since_broadcast[actor_idx] = 0
+            else:
+                self._updates_since_broadcast[actor_idx] = n
+            new_ref = self.env_runner_group.submit_sample(
+                actor_idx, cfg.rollout_fragment_length
+            )
+            self._inflight[new_ref] = actor_idx
+        return {
+            **self._episode_metrics(batches_done),
+            **metrics,
+            "mean_weight_staleness": stale_total / max(1, len(batches_done)),
+        }
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self):
+        super().__init__(algo_class=APPO)
+        self.clip_param = 0.2
+
+
+class APPOLearner(IMPALALearner):
+    def loss_fn(self, params, batch):
+        import jax
+        import jax.numpy as jnp
+
+        c = self.cfg
+        T, B = batch["rewards"].shape
+        obs = batch["obs"].reshape(T * B, -1)
+        logits, values = forward_pi_vf(params, obs)
+        logits = logits.reshape(T, B, -1)
+        values = values.reshape(T, B)
+        logp_all = jax.nn.log_softmax(logits)
+        target_logp = jnp.take_along_axis(
+            logp_all, batch["actions"][..., None], axis=-1
+        )[..., 0]
+        vs, pg_adv = _vtrace(
+            batch["behavior_logp"],
+            target_logp,
+            batch["rewards"],
+            jax.lax.stop_gradient(values),
+            batch["bootstrap_value"],
+            batch["terminateds"].astype(jnp.float32),
+            c["gamma"],
+            c["clip_rho"],
+            c["clip_c"],
+        )
+        # PPO clipped surrogate on V-trace advantages (reference APPO loss).
+        ratio = jnp.exp(target_logp - batch["behavior_logp"])
+        surr1 = ratio * pg_adv
+        surr2 = jnp.clip(ratio, 1 - c["clip_param"], 1 + c["clip_param"]) * pg_adv
+        policy_loss = -jnp.mean(jnp.minimum(surr1, surr2))
+        vf_loss = 0.5 * jnp.mean(jnp.square(values - vs))
+        probs = jax.nn.softmax(logits)
+        entropy = -jnp.mean(jnp.sum(probs * logp_all, axis=-1))
+        loss = policy_loss + c["vf_loss_coeff"] * vf_loss - c["entropy_coeff"] * entropy
+        return loss, {
+            "policy_loss": policy_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+        }
+
+
+class APPO(IMPALA):
+    def _learner_builder(self, obs_dim: int, num_actions: int) -> Callable[[], Any]:
+        cfg = self.config
+        spec = RLModuleSpec(
+            obs_dim=obs_dim,
+            num_actions=num_actions,
+            hidden=tuple(cfg.model.get("hidden", (64, 64))),
+        )
+        loss_cfg = {
+            "gamma": cfg.gamma,
+            "clip_rho": cfg.vtrace_clip_rho_threshold,
+            "clip_c": cfg.vtrace_clip_c_threshold,
+            "vf_loss_coeff": cfg.vf_loss_coeff,
+            "entropy_coeff": cfg.entropy_coeff,
+            "clip_param": cfg.clip_param,
+        }
+        lr, grad_clip, seed = cfg.lr, cfg.grad_clip, cfg.seed
+
+        def build():
+            return APPOLearner(spec, loss_cfg, lr=lr, grad_clip=grad_clip, seed=seed)
+
+        return build

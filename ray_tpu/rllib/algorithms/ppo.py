@@ -1,0 +1,165 @@
+"""PPO: clipped-surrogate policy optimization.
+
+Analog of rllib/algorithms/ppo/ (ppo.py, ppo_learner.py, torch loss at
+ppo_torch_learner.py): sync sampling from the env-runner gang, GAE
+postprocessing, minibatched multi-epoch SGD on one jitted loss — policy
+clip + value clip + entropy bonus.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, gae_advantages
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.core.rl_module import RLModuleSpec, forward_pi_vf, init_pi_vf
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=PPO)
+        self.lr = 3e-4
+        self.train_batch_size = 2048
+        self.minibatch_size = 128
+        self.num_epochs = 8
+        self.lambda_ = 0.95
+        self.clip_param = 0.2
+        self.vf_clip_param = 10.0
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.0
+        self.kl_target = 0.02  # accepted for parity; adaptive KL not applied
+        self.grad_clip = 0.5
+
+
+class PPOLearner(Learner):
+    def __init__(self, spec: RLModuleSpec, cfg: Dict[str, Any], **kw):
+        self.cfg = cfg
+        super().__init__(spec, **kw)
+
+    def init_params(self, rng):
+        return init_pi_vf(rng, self.spec)
+
+    def loss_fn(self, params, batch):
+        import jax
+        import jax.numpy as jnp
+
+        c = self.cfg
+        logits, values = forward_pi_vf(params, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(logp_all, batch["actions"][:, None], axis=-1)[:, 0]
+        ratio = jnp.exp(logp - batch["logp_old"])
+        adv = batch["advantages"]
+        surr1 = ratio * adv
+        surr2 = jnp.clip(ratio, 1 - c["clip_param"], 1 + c["clip_param"]) * adv
+        policy_loss = -jnp.mean(jnp.minimum(surr1, surr2))
+
+        vf_err = values - batch["value_targets"]
+        vf_clipped = batch["values_old"] + jnp.clip(
+            values - batch["values_old"], -c["vf_clip_param"], c["vf_clip_param"]
+        )
+        vf_err_clipped = vf_clipped - batch["value_targets"]
+        vf_loss = 0.5 * jnp.mean(
+            jnp.maximum(jnp.square(vf_err), jnp.square(vf_err_clipped))
+        )
+
+        probs = jax.nn.softmax(logits)
+        entropy = -jnp.mean(jnp.sum(probs * logp_all, axis=-1))
+        kl = jnp.mean(batch["logp_old"] - logp)
+
+        loss = (
+            policy_loss
+            + c["vf_loss_coeff"] * vf_loss
+            - c["entropy_coeff"] * entropy
+        )
+        return loss, {
+            "policy_loss": policy_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+            "kl": kl,
+        }
+
+
+class PPO(Algorithm):
+    policy_kind = "pi_vf"
+
+    def _learner_builder(self, obs_dim: int, num_actions: int) -> Callable[[], Any]:
+        cfg = self.config
+        spec = RLModuleSpec(
+            obs_dim=obs_dim,
+            num_actions=num_actions,
+            hidden=tuple(cfg.model.get("hidden", (64, 64))),
+            vf_share_layers=bool(cfg.model.get("vf_share_layers", False)),
+        )
+        loss_cfg = {
+            "clip_param": cfg.clip_param,
+            "vf_clip_param": cfg.vf_clip_param,
+            "vf_loss_coeff": cfg.vf_loss_coeff,
+            "entropy_coeff": cfg.entropy_coeff,
+        }
+        lr, grad_clip, seed = cfg.lr, cfg.grad_clip, cfg.seed
+
+        def build():
+            return PPOLearner(spec, loss_cfg, lr=lr, grad_clip=grad_clip, seed=seed)
+
+        return build
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        n_runners = max(1, cfg.num_env_runners)
+        steps_per_runner = max(
+            1,
+            cfg.train_batch_size
+            // (n_runners * cfg.num_envs_per_env_runner),
+        )
+        batches = self.env_runner_group.sample(steps_per_runner)
+        self._env_steps_total += sum(b["env_steps"] for b in batches)
+
+        # GAE per runner batch, then flatten to one train batch.
+        flat: Dict[str, list] = {
+            k: []
+            for k in (
+                "obs",
+                "actions",
+                "logp_old",
+                "advantages",
+                "value_targets",
+                "values_old",
+            )
+        }
+        for b in batches:
+            adv, ret = gae_advantages(
+                b["rewards"],
+                b["values"],
+                b["terminateds"],
+                b["truncateds"],
+                b["bootstrap_value"],
+                cfg.gamma,
+                cfg.lambda_,
+            )
+            flat["obs"].append(b["obs"].reshape(-1, self.obs_dim))
+            flat["actions"].append(b["actions"].reshape(-1))
+            flat["logp_old"].append(b["logp"].reshape(-1))
+            flat["advantages"].append(adv.reshape(-1))
+            flat["value_targets"].append(ret.reshape(-1))
+            flat["values_old"].append(b["values"].reshape(-1))
+        train_batch = {k: np.concatenate(v) for k, v in flat.items()}
+        adv = train_batch["advantages"]
+        train_batch["advantages"] = (adv - adv.mean()) / (adv.std() + 1e-8)
+
+        # Minibatched multi-epoch SGD.
+        size = len(train_batch["obs"])
+        mb = min(cfg.minibatch_size, size)
+        rng = np.random.RandomState(cfg.seed + self.iteration)
+        last_metrics: Dict[str, float] = {}
+        for _ in range(cfg.num_epochs):
+            perm = rng.permutation(size)
+            for start in range(0, size - mb + 1, mb):
+                idx = perm[start : start + mb]
+                minibatch = {k: v[idx] for k, v in train_batch.items()}
+                last_metrics = self.learner_group.update_from_batch(minibatch)
+
+        self._sync_weights()
+        return {**self._episode_metrics(batches), **last_metrics}

@@ -1,0 +1,169 @@
+"""LearnerGroup: one local learner, or a gang of learner actors.
+
+Analog of rllib/core/learner/learner_group.py:69. TPU-first data
+parallelism: each learner computes grads on its batch shard and the group
+averages them (the reference wraps torch DDP instead — torch_learner.py:354).
+On a real pod slice the learner gang is one actor per TPU host and the
+in-actor update itself is a sharded jit program; the actor tier here handles
+multi-host fan-out and fault tolerance.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+import jax
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.utils.actor_manager import FaultTolerantActorManager
+
+logger = logging.getLogger(__name__)
+
+
+class _LearnerActor:
+    """Actor shell hosting a Learner (reference: learner actors under
+    FaultTolerantActorManager, learner_group.py:178)."""
+
+    def __init__(self, learner_blob: bytes):
+        build = cloudpickle.loads(learner_blob)
+        self.learner = build()
+
+    def ping(self):
+        return "pong"
+
+    def update_from_batch(self, batch):
+        return self.learner.update_from_batch(batch)
+
+    def compute_gradients(self, batch):
+        grads, metrics = self.learner.compute_gradients(batch)
+        return jax.device_get(grads), metrics
+
+    def apply_gradients(self, grads):
+        self.learner.apply_gradients(grads)
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def set_weights(self, weights):
+        self.learner.set_weights(weights)
+
+    def get_state(self):
+        return self.learner.get_state()
+
+    def set_state(self, state):
+        self.learner.set_state(state)
+
+
+def _mean_tree(trees: List[Any]):
+    return jax.tree_util.tree_map(lambda *xs: sum(xs) / len(xs), *trees)
+
+
+class LearnerGroup:
+    def __init__(
+        self,
+        learner_builder: Callable[[], Any],
+        *,
+        num_learners: int = 0,
+        num_cpus_per_learner: float = 1.0,
+        num_tpus_per_learner: float = 0.0,
+    ):
+        self._builder = learner_builder
+        self.num_learners = num_learners
+        if num_learners == 0:
+            self._local = learner_builder()
+            self._manager = None
+        else:
+            self._local = None
+            blob = cloudpickle.dumps(learner_builder)
+            cls = ray_tpu.remote(_LearnerActor)
+            actors = [
+                cls.options(
+                    num_cpus=num_cpus_per_learner,
+                    num_tpus=num_tpus_per_learner or None,
+                    max_restarts=1,
+                ).remote(blob)
+                for _ in range(num_learners)
+            ]
+            self._manager = FaultTolerantActorManager(actors)
+
+    @property
+    def is_local(self) -> bool:
+        return self._local is not None
+
+    def update_from_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        """One synchronous update. Remote mode: shard batch across healthy
+        learners, average grads, apply everywhere (keeps learners in sync)."""
+        if self._local is not None:
+            return self._local.update_from_batch(batch)
+        ids = self._manager.healthy_actor_ids()
+        if not ids:
+            raise RuntimeError("no healthy learner actors")
+        shards = _shard_batch(batch, len(ids))
+        refs = [
+            (i, self._manager.actors[i].compute_gradients.remote(shard))
+            for i, shard in zip(ids, shards)
+        ]
+        metrics_list = []
+        grads_list = []
+        for i, ref in refs:
+            try:
+                grads, metrics = ray_tpu.get(ref)
+                grads_list.append(grads)
+                metrics_list.append(metrics)
+            except Exception as e:
+                self._manager.set_actor_state(i, False)
+                logger.warning("learner %d failed update: %r", i, e)
+        if not grads_list:
+            raise RuntimeError("all learner actors failed the update")
+        mean_grads = _mean_tree(grads_list)
+        self._manager.foreach_actor(
+            lambda a: a.apply_gradients.remote(mean_grads)
+        )
+        out = {
+            k: float(np.mean([m[k] for m in metrics_list]))
+            for k in metrics_list[0]
+        }
+        return out
+
+    def get_weights(self):
+        if self._local is not None:
+            return self._local.get_weights()
+        ids = self._manager.healthy_actor_ids()
+        return ray_tpu.get(self._manager.actors[ids[0]].get_weights.remote())
+
+    def set_weights(self, weights) -> None:
+        if self._local is not None:
+            self._local.set_weights(weights)
+        else:
+            self._manager.foreach_actor(lambda a: a.set_weights.remote(weights))
+
+    def get_state(self):
+        if self._local is not None:
+            return self._local.get_state()
+        ids = self._manager.healthy_actor_ids()
+        return ray_tpu.get(self._manager.actors[ids[0]].get_state.remote())
+
+    def set_state(self, state) -> None:
+        if self._local is not None:
+            self._local.set_state(state)
+        else:
+            self._manager.foreach_actor(lambda a: a.set_state.remote(state))
+
+    def shutdown(self) -> None:
+        if self._manager is not None:
+            for a in self._manager.actors:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
+
+
+def _shard_batch(batch: Dict[str, np.ndarray], n: int) -> List[Dict[str, np.ndarray]]:
+    if n == 1:
+        return [batch]
+    size = len(next(iter(batch.values())))
+    idx = np.array_split(np.arange(size), n)
+    return [{k: v[ix] for k, v in batch.items()} for ix in idx]

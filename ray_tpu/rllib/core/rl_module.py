@@ -1,0 +1,107 @@
+"""RLModule: the neural-net component of an RL algorithm, as pure JAX.
+
+Analog of the reference's rllib/core/rl_module/ (RLModule torch/tf classes),
+re-designed TPU-first: a module is a (init, forward) pair of pure functions
+over a param pytree, so the learner can jit/shard the whole update and the
+env-runner can jit inference — no stateful nn.Module graph.
+
+Supported spaces: Box observations, Discrete actions (the reference's
+CartPole/Atari-class configs in rllib/tuned_examples/).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class RLModuleSpec:
+    """Declarative module spec (reference: rl_module/rl_module.py RLModuleSpec)."""
+
+    obs_dim: int
+    num_actions: int
+    hidden: Tuple[int, ...] = (64, 64)
+    # "shared" (one torso, two heads) or "separate" (independent pi/vf nets).
+    vf_share_layers: bool = False
+    dtype: Any = jnp.float32
+
+
+def _init_mlp(rng, sizes: Sequence[int], dtype) -> list:
+    layers = []
+    for i, (d_in, d_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        rng, k = jax.random.split(rng)
+        scale = jnp.sqrt(2.0 / d_in)
+        layers.append(
+            {
+                "w": (jax.random.normal(k, (d_in, d_out)) * scale).astype(dtype),
+                "b": jnp.zeros((d_out,), dtype),
+            }
+        )
+    return layers
+
+
+def _mlp(layers: list, x, final_tanh: bool = False):
+    for i, lyr in enumerate(layers):
+        x = x @ lyr["w"] + lyr["b"]
+        if i < len(layers) - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+def init_pi_vf(rng, spec: RLModuleSpec) -> Dict[str, Any]:
+    """Policy + value params for actor-critic algorithms (PPO/IMPALA/APPO)."""
+    k1, k2 = jax.random.split(rng)
+    if spec.vf_share_layers:
+        torso_sizes = (spec.obs_dim, *spec.hidden)
+        return {
+            "torso": _init_mlp(k1, torso_sizes, spec.dtype),
+            "pi_head": _init_mlp(k2, (spec.hidden[-1], spec.num_actions), spec.dtype),
+            "vf_head": _init_mlp(
+                jax.random.fold_in(k2, 1), (spec.hidden[-1], 1), spec.dtype
+            ),
+        }
+    return {
+        "pi": _init_mlp(k1, (spec.obs_dim, *spec.hidden, spec.num_actions), spec.dtype),
+        "vf": _init_mlp(k2, (spec.obs_dim, *spec.hidden, 1), spec.dtype),
+    }
+
+
+def forward_pi_vf(params: Dict[str, Any], obs) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (action_logits [B, A], value [B])."""
+    if "torso" in params:
+        h = _mlp(params["torso"], obs)
+        h = jnp.tanh(h)
+        logits = _mlp(params["pi_head"], h)
+        value = _mlp(params["vf_head"], h)[..., 0]
+    else:
+        logits = _mlp(params["pi"], obs)
+        value = _mlp(params["vf"], obs)[..., 0]
+    return logits, value
+
+
+def init_q(rng, spec: RLModuleSpec) -> Dict[str, Any]:
+    """Q-network params for value-based algorithms (DQN)."""
+    return {
+        "q": _init_mlp(rng, (spec.obs_dim, *spec.hidden, spec.num_actions), spec.dtype)
+    }
+
+
+def forward_q(params: Dict[str, Any], obs) -> jnp.ndarray:
+    return _mlp(params["q"], obs)
+
+
+def sample_actions(rng, logits):
+    """Categorical sample + logp, jit-friendly."""
+    actions = jax.random.categorical(rng, logits)
+    logp = jax.nn.log_softmax(logits)
+    logp_a = jnp.take_along_axis(logp, actions[:, None], axis=-1)[:, 0]
+    return actions, logp_a
+
+
+def num_params(params) -> int:
+    return int(sum(np.prod(x.shape) for x in jax.tree_util.tree_leaves(params)))

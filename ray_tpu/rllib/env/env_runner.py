@@ -1,0 +1,189 @@
+"""SingleAgentEnvRunner: actor that collects experience from vector envs.
+
+Analog of rllib/env/single_agent_env_runner.py:42 (sample:120): gymnasium
+vector env stepping with jitted policy inference. Returns time-major
+[T, num_envs, ...] numpy batches plus episode stats; the learner side turns
+these into train batches (GAE / replay) — mirroring the reference's
+EnvRunner -> ConnectorV2 -> Learner pipeline.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class SingleAgentEnvRunner:
+    """Runs on CPU workers; policy inference is jitted JAX on host."""
+
+    def __init__(
+        self,
+        env_name_or_factory,
+        *,
+        num_envs: int = 1,
+        policy_kind: str = "pi_vf",  # "pi_vf" (actor-critic) or "q" (DQN)
+        module_spec_dict: Optional[Dict[str, Any]] = None,
+        seed: int = 0,
+        worker_index: int = 0,
+        env_config: Optional[Dict[str, Any]] = None,
+    ):
+        import gymnasium as gym
+        import jax
+
+        self._jax = jax
+        if isinstance(env_name_or_factory, str):
+            name = env_name_or_factory
+            cfg = env_config or {}
+            self.envs = gym.vector.SyncVectorEnv(
+                [lambda: gym.make(name, **cfg) for _ in range(num_envs)]
+            )
+        else:
+            factory = env_name_or_factory
+            cfg = env_config or {}
+            self.envs = gym.vector.SyncVectorEnv(
+                [lambda: factory(cfg) for _ in range(num_envs)]
+            )
+        self.num_envs = num_envs
+        self.policy_kind = policy_kind
+        self.worker_index = worker_index
+        self.rng = jax.random.PRNGKey(seed * 10007 + worker_index)
+
+        from ray_tpu.rllib.core import rl_module as M
+
+        obs_space = self.envs.single_observation_space
+        act_space = self.envs.single_action_space
+        self.obs_dim = int(np.prod(obs_space.shape))
+        self.num_actions = int(act_space.n)
+        spec_kwargs = dict(module_spec_dict or {})
+        spec_kwargs.setdefault("obs_dim", self.obs_dim)
+        spec_kwargs.setdefault("num_actions", self.num_actions)
+        self.spec = M.RLModuleSpec(**spec_kwargs)
+
+        if policy_kind == "pi_vf":
+            self.params = M.init_pi_vf(self._next_rng(), self.spec)
+
+            def _step(params, rng, obs):
+                logits, value = M.forward_pi_vf(params, obs)
+                actions, logp = M.sample_actions(rng, logits)
+                return actions, logp, value
+
+            self._policy_step = jax.jit(_step)
+        elif policy_kind == "q":
+            self.params = M.init_q(self._next_rng(), self.spec)
+
+            def _greedy(params, obs):
+                return M.forward_q(params, obs).argmax(axis=-1)
+
+            self._greedy = jax.jit(_greedy)
+        else:
+            raise ValueError(f"unknown policy_kind {policy_kind!r}")
+
+        self._obs, _ = self.envs.reset(seed=seed * 7919 + worker_index)
+        self._episode_returns = np.zeros(num_envs)
+        self._episode_lens = np.zeros(num_envs, dtype=np.int64)
+        self._completed: collections.deque = collections.deque(maxlen=100)
+        self._weights_version = 0
+
+    def ping(self):
+        return "pong"
+
+    def _next_rng(self):
+        self.rng, k = self._jax.random.split(self.rng)
+        return k
+
+    # -- weight sync ---------------------------------------------------------
+
+    def set_weights(self, weights, version: int = 0) -> None:
+        import jax.numpy as jnp
+
+        self.params = self._jax.tree_util.tree_map(jnp.asarray, weights)
+        self._weights_version = version
+
+    def get_weights_version(self) -> int:
+        return self._weights_version
+
+    # -- sampling ------------------------------------------------------------
+
+    def sample(
+        self, num_steps: int, *, epsilon: float = 0.0, random_actions: bool = False
+    ) -> Dict[str, Any]:
+        """Collect num_steps steps from every env. Time-major output."""
+        from ray_tpu.rllib.core import rl_module as M
+
+        T, N = num_steps, self.num_envs
+        obs_buf = np.empty((T, N, self.obs_dim), dtype=np.float32)
+        act_buf = np.empty((T, N), dtype=np.int64)
+        rew_buf = np.empty((T, N), dtype=np.float32)
+        # `done` = terminated only; truncation bootstraps instead of zeroing.
+        term_buf = np.empty((T, N), dtype=np.bool_)
+        trunc_buf = np.empty((T, N), dtype=np.bool_)
+        next_obs_buf = np.empty((T, N, self.obs_dim), dtype=np.float32)
+        logp_buf = np.zeros((T, N), dtype=np.float32)
+        val_buf = np.zeros((T, N), dtype=np.float32)
+
+        for t in range(T):
+            obs_flat = self._obs.reshape(N, -1).astype(np.float32)
+            obs_buf[t] = obs_flat
+            if self.policy_kind == "pi_vf":
+                actions, logp, value = self._policy_step(
+                    self.params, self._next_rng(), obs_flat
+                )
+                actions = np.asarray(actions)
+                logp_buf[t] = np.asarray(logp)
+                val_buf[t] = np.asarray(value)
+            else:
+                if random_actions:
+                    actions = np.random.randint(0, self.num_actions, size=N)
+                else:
+                    greedy = np.asarray(self._greedy(self.params, obs_flat))
+                    explore = np.random.rand(N) < epsilon
+                    randoms = np.random.randint(0, self.num_actions, size=N)
+                    actions = np.where(explore, randoms, greedy)
+            next_obs, rewards, terminated, truncated, _ = self.envs.step(actions)
+            act_buf[t] = actions
+            rew_buf[t] = rewards
+            term_buf[t] = terminated
+            trunc_buf[t] = truncated
+            next_obs_buf[t] = next_obs.reshape(N, -1).astype(np.float32)
+
+            self._episode_returns += rewards
+            self._episode_lens += 1
+            done = np.logical_or(terminated, truncated)
+            for i in np.nonzero(done)[0]:
+                self._completed.append(
+                    (float(self._episode_returns[i]), int(self._episode_lens[i]))
+                )
+                self._episode_returns[i] = 0.0
+                self._episode_lens[i] = 0
+            self._obs = next_obs
+
+        out: Dict[str, Any] = {
+            "obs": obs_buf,
+            "actions": act_buf,
+            "rewards": rew_buf,
+            "terminateds": term_buf,
+            "truncateds": trunc_buf,
+            "next_obs": next_obs_buf,
+            "episode_stats": list(self._completed),
+            "weights_version": self._weights_version,
+            "env_steps": T * N,
+        }
+        if self.policy_kind == "pi_vf":
+            out["logp"] = logp_buf
+            out["values"] = val_buf
+            # Bootstrap value for the obs after the last step.
+            _, _, bootstrap = self._policy_step(
+                self.params,
+                self._next_rng(),
+                self._obs.reshape(N, -1).astype(np.float32),
+            )
+            out["bootstrap_value"] = np.asarray(bootstrap)
+        return out
+
+    def get_spaces(self) -> Tuple[int, int]:
+        return self.obs_dim, self.num_actions
+
+    def stop(self) -> None:
+        self.envs.close()

@@ -69,6 +69,9 @@ class _DeploymentState:
         self.metrics_window: List[tuple] = []  # (t, total_ongoing)
         self.autoscale_decision_ts = 0.0
         self.current_target: Optional[int] = None
+        # start-failure backoff
+        self.consecutive_start_failures = 0
+        self.backoff_until = 0.0
 
     @property
     def target_replicas(self) -> int:
@@ -194,10 +197,21 @@ class ServeController:
                 # In-place update: new config; replicas restart only if the
                 # code/init args changed (version hash).
                 if existing.spec.get("version") == dep_spec.get("version"):
+                    old_cfg = existing.config
                     existing.spec = dep_spec
                     existing.config = DeploymentConfig.from_dict(dep_spec["config"])
                     existing.current_target = None
                     existing.status = DeploymentStatus.UPDATING
+                    # Lightweight (same-code) config change: push user_config
+                    # to live replicas and refresh router-visible limits.
+                    new_cfg = existing.config
+                    for rec in existing.replicas.values():
+                        rec.max_ongoing = new_cfg.max_ongoing_requests
+                    if new_cfg.user_config != old_cfg.user_config:
+                        asyncio.ensure_future(
+                            self._reconfigure_replicas(existing, new_cfg.user_config)
+                        )
+                    self._broadcast_replicas(key)
                     continue
                 for rec in list(existing.replicas.values()):
                     self._start_stopping(existing, rec)
@@ -221,18 +235,19 @@ class ServeController:
         self._broadcast_routes()
 
     async def graceful_shutdown(self) -> None:
-        self._shutdown = True
+        # Mark everything deleting and let the reconcile loop (still running)
+        # drain and kill replicas; only then stop the loop.
+        for app in self._apps.values():
+            app["status"] = ApplicationStatus.DELETING
         for state in self._deployments.values():
             state.deleting = True
-        # Wait for replicas to drain.
-        deadline = time.monotonic() + 10
+        self._broadcast_routes()
+        deadline = time.monotonic() + 15
         while time.monotonic() < deadline:
-            if not any(
-                s.replicas or s.starting or s.stopping
-                for s in self._deployments.values()
-            ):
+            if not self._deployments:
                 break
             await asyncio.sleep(0.1)
+        self._shutdown = True
         core = worker_mod._core()
         if self._proxy_actor_id:
             try:
@@ -288,7 +303,7 @@ class ServeController:
             self._autoscale(state)
             target = state.target_replicas
             actual = len(state.replicas) + len(state.starting)
-            if actual < target:
+            if actual < target and time.monotonic() >= state.backoff_until:
                 for _ in range(target - actual):
                     self._start_replica(state)
             elif actual > target:
@@ -359,6 +374,7 @@ class ServeController:
 
         core = worker_mod._core()
         cfg = state.config
+        actor_id = None
         try:
             opts = dict(cfg.ray_actor_options)
             resources = {"CPU": float(opts.get("num_cpus", 0.1))}
@@ -398,10 +414,22 @@ class ServeController:
             state.replicas[replica_id.unique_id] = rec
             rec.health_task = asyncio.ensure_future(self._health_loop(state, rec))
             state.message = ""
+            state.consecutive_start_failures = 0
+            state.backoff_until = 0.0
             self._broadcast_replicas(str(state.dep_id))
         except Exception as e:
             state.status = DeploymentStatus.UNHEALTHY
             state.message = f"replica start failed: {type(e).__name__}: {e}"
+            state.consecutive_start_failures += 1
+            state.backoff_until = time.monotonic() + min(
+                30.0, 0.5 * 2**state.consecutive_start_failures
+            )
+            if actor_id is not None:
+                # Don't leak the half-started detached actor.
+                try:
+                    await core.kill_actor(actor_id)
+                except Exception:
+                    pass
             logger.warning(
                 "replica %s of %s failed to start: %s",
                 replica_id.unique_id,
@@ -410,6 +438,24 @@ class ServeController:
             )
         finally:
             state.starting.pop(replica_id.unique_id, None)
+
+    async def _reconfigure_replicas(
+        self, state: _DeploymentState, user_config: Any
+    ) -> None:
+        core = worker_mod._core()
+        for rec in list(state.replicas.values()):
+            try:
+                refs = await core.submit_actor_task(
+                    rec.actor_id, "reconfigure", (user_config,), {}, num_returns=1
+                )
+                await asyncio.wait_for(core.get_objects(refs[0], timeout=None), 30)
+            except Exception as e:
+                logger.warning(
+                    "reconfigure of replica %s failed: %r; replacing",
+                    rec.replica_id.unique_id,
+                    e,
+                )
+                self._start_stopping(state, rec)
 
     async def _health_loop(self, state: _DeploymentState, rec: _ReplicaRecord) -> None:
         """Periodic replica health check (reference deployment_state.py

@@ -123,9 +123,13 @@ class DeploymentHandle:
 
     def __reduce__(self):
         return (
-            DeploymentHandle,
-            (self.deployment_id.name, self.deployment_id.app_name),
+            _rebuild_handle,
+            (self.deployment_id.name, self.deployment_id.app_name, self._method_name),
         )
 
     def __repr__(self):
         return f"DeploymentHandle({self.deployment_id})"
+
+
+def _rebuild_handle(name: str, app_name: str, method_name: str) -> DeploymentHandle:
+    return DeploymentHandle(name, app_name, method_name=method_name)

@@ -708,7 +708,7 @@ class Raylet:
         return {"ok": True}
 
     async def _node_stats(self, conn, p):
-        return {
+        out = {
             "node_id": self.node_id,
             "total": self.total.to_units(),
             "available": self.available.to_units(),
@@ -720,6 +720,33 @@ class Raylet:
             "num_objects": len(self.objects),
             "pending_leases": len(self.pending_leases),
         }
+        # Detail payloads for the state API (reference: raylet
+        # GetTasksInfo/GetObjectsInfo, node_manager.proto:424-426).
+        if p.get("include_workers"):
+            idle = {w.worker_id for w in self.idle_workers}
+            out["workers"] = [
+                {
+                    "worker_id": w.worker_id,
+                    "pid": getattr(w.proc, "pid", None),
+                    "actor_id": w.actor_id,
+                    "lease_id": w.lease_id,
+                    "state": "IDLE" if w.worker_id in idle else "BUSY",
+                    "node_id": self.node_id,
+                }
+                for w in self.workers.values()
+            ]
+        if p.get("include_objects"):
+            out["objects"] = [
+                {
+                    "object_id": o.oid,
+                    "size": o.size,
+                    "sealed": o.sealed,
+                    "pinned": o.pinned,
+                    "node_id": self.node_id,
+                }
+                for o in self.objects.values()
+            ]
+        return out
 
 
 async def main() -> None:

@@ -101,8 +101,18 @@ def init(
 
     With no address, boots a head node in-process (GCS + raylet on a
     background event loop; reference: ray.init at worker.py:1214).
-    `address="host:port"` connects to an existing GCS.
+    `address="host:port"` connects to an existing GCS. `address="auto"` (or
+    the RAY_TPU_ADDRESS env var, set for submitted jobs) finds the running
+    cluster.
     """
+    import os as _os
+
+    if address == "auto":
+        address = _os.environ.get("RAY_TPU_ADDRESS") or _read_cluster_address()
+        if address is None:
+            raise RayTpuError("address='auto' but no running cluster found")
+    elif address is None and _os.environ.get("RAY_TPU_ADDRESS"):
+        address = _os.environ["RAY_TPU_ADDRESS"]
     with _init_lock:
         w = global_worker
         if w.connected:
@@ -171,6 +181,21 @@ def init(
         return {"address": f"{gcs_addr[0]}:{gcs_addr[1]}", "session": core.session_name}
 
 
+def _read_cluster_address() -> Optional[str]:
+    """Address of a cluster started via `ray-tpu start` on this machine."""
+    import json
+    import os
+
+    path = os.path.join(
+        os.environ.get("TMPDIR", "/tmp"), "ray_tpu_cluster.json"
+    )
+    try:
+        with open(path) as f:
+            return json.load(f)["address"]
+    except Exception:
+        return None
+
+
 def attach_existing(core: CoreWorker, loop: asyncio.AbstractEventLoop) -> None:
     """Used by worker processes: the loop already exists (main thread)."""
     w = global_worker
@@ -211,6 +236,24 @@ def shutdown() -> None:
         w.run_async(_down(), timeout=30)
     except Exception:
         pass
+
+    async def _cancel_remaining():
+        tasks = [
+            t
+            for t in asyncio.all_tasks()
+            if t is not asyncio.current_task() and not t.done()
+        ]
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+
+    # Only sweep the loop when we own it: in worker mode the loop is the
+    # process's serving loop and its RPC/heartbeat tasks must keep running.
+    if w._owns_loop:
+        try:
+            w.run_async(_cancel_remaining(), timeout=5)
+        except Exception:
+            pass
     if w._owns_loop and w.loop is not None:
         w.loop.call_soon_threadsafe(w.loop.stop)
         if w._loop_thread is not None:

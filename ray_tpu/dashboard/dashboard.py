@@ -1,0 +1,168 @@
+"""Dashboard: aiohttp server exposing cluster state as JSON + a minimal UI.
+
+Analog of the reference's dashboard/ (head.py:81 + modules): instead of a
+React SPA it serves one self-contained HTML page over the same JSON
+endpoints the state API uses — nodes, actors, jobs, tasks, serve apps.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Dict, Optional, Tuple
+
+INDEX_HTML = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<style>
+ body { font-family: system-ui, sans-serif; margin: 2rem; color: #222; }
+ h1 { font-size: 1.3rem; } h2 { font-size: 1.05rem; margin-top: 1.5rem; }
+ table { border-collapse: collapse; width: 100%; font-size: .85rem; }
+ th, td { border: 1px solid #ddd; padding: .3rem .5rem; text-align: left; }
+ th { background: #f5f5f5; } .mono { font-family: monospace; }
+</style></head>
+<body>
+<h1>ray_tpu dashboard</h1>
+<div id="root">loading…</div>
+<script>
+const fmt = (o) => typeof o === 'object' ? JSON.stringify(o) : o;
+function table(rows, cols) {
+  if (!rows || !rows.length) return '<i>none</i>';
+  cols = cols || Object.keys(rows[0]);
+  let h = '<table><tr>' + cols.map(c => `<th>${c}</th>`).join('') + '</tr>';
+  for (const r of rows)
+    h += '<tr>' + cols.map(c => `<td class=mono>${fmt(r[c] ?? '')}</td>`).join('') + '</tr>';
+  return h + '</table>';
+}
+async function refresh() {
+  const j = async (u) => (await fetch(u)).json();
+  const [nodes, actors, jobs, tasks] = await Promise.all([
+    j('/api/nodes'), j('/api/actors'), j('/api/jobs'), j('/api/tasks/summary')]);
+  document.getElementById('root').innerHTML =
+    '<h2>Nodes</h2>' + table(nodes.nodes) +
+    '<h2>Actors</h2>' + table(actors.actors,
+       ['actor_id','class_name','name','state','node_id','num_restarts']) +
+    '<h2>Jobs</h2>' + table(jobs.jobs) +
+    '<h2>Task summary</h2><pre>' + JSON.stringify(tasks, null, 2) + '</pre>';
+}
+refresh(); setInterval(refresh, 5000);
+</script></body></html>
+"""
+
+
+class Dashboard:
+    def __init__(self, gcs_addr: Tuple[str, int], host: str = "127.0.0.1", port: int = 8265):
+        self.gcs_addr = gcs_addr
+        self.host = host
+        self.port = port
+        self._conn = None
+        self._runner = None
+
+    async def _gcs(self, method: str, payload: Optional[dict] = None):
+        from ray_tpu._private import rpc
+
+        if self._conn is None or self._conn.closed:
+            self._conn = await rpc.connect(*self.gcs_addr)
+        return await self._conn.call(method, payload or {})
+
+    async def start(self) -> Tuple[str, int]:
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_get("/", self._index)
+        app.router.add_get("/api/cluster_status", self._cluster_status)
+        app.router.add_get("/api/nodes", self._nodes)
+        app.router.add_get("/api/actors", self._actors)
+        app.router.add_get("/api/jobs", self._jobs)
+        app.router.add_get("/api/placement_groups", self._pgs)
+        app.router.add_get("/api/tasks", self._tasks)
+        app.router.add_get("/api/tasks/summary", self._task_summary)
+        app.router.add_get("/-/healthz", self._healthz)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        if self.port == 0:
+            self.port = site._server.sockets[0].getsockname()[1]
+        return self.host, self.port
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+        if self._conn is not None:
+            await self._conn.close()
+
+    # -- handlers ------------------------------------------------------------
+
+    async def _index(self, request):
+        from aiohttp import web
+
+        return web.Response(text=INDEX_HTML, content_type="text/html")
+
+    async def _healthz(self, request):
+        from aiohttp import web
+
+        return web.Response(text="success")
+
+    async def _cluster_status(self, request):
+        from aiohttp import web
+
+        return web.json_response(await self._gcs("GetClusterStatus"))
+
+    async def _nodes(self, request):
+        from aiohttp import web
+
+        return web.json_response(await self._gcs("GetAllNodes"))
+
+    async def _actors(self, request):
+        from aiohttp import web
+
+        return web.json_response(await self._gcs("ListActors"))
+
+    async def _jobs(self, request):
+        from aiohttp import web
+        from ray_tpu.job.job_manager import JOB_INFO_NS
+
+        reply = await self._gcs("KVKeys", {"ns": JOB_INFO_NS, "prefix": ""})
+        jobs = []
+        for key in reply.get("keys", []):
+            blob = (await self._gcs("KVGet", {"ns": JOB_INFO_NS, "key": key})).get(
+                "value"
+            )
+            if blob:
+                jobs.append(json.loads(blob))
+        return web.json_response({"jobs": jobs})
+
+    async def _pgs(self, request):
+        from aiohttp import web
+
+        return web.json_response(await self._gcs("ListPlacementGroups"))
+
+    async def _tasks(self, request):
+        from aiohttp import web
+
+        reply = await self._gcs("ListTaskEvents", {"limit": 5000})
+        return web.json_response(reply)
+
+    async def _task_summary(self, request):
+        from aiohttp import web
+
+        reply = await self._gcs("ListTaskEvents", {"limit": 100000})
+        latest: Dict[str, dict] = {}
+        for e in reply["events"]:
+            cur = latest.get(e["task_id"])
+            if cur is None or e["time"] >= cur["time"]:
+                latest[e["task_id"]] = e
+        summary: Dict[str, Dict[str, int]] = {}
+        for e in latest.values():
+            name = e.get("name") or "?"
+            summary.setdefault(name, {})
+            summary[name][e["state"]] = summary[name].get(e["state"], 0) + 1
+        return web.json_response({"summary": summary, "total": len(latest)})
+
+
+async def run_dashboard(gcs_addr, host="127.0.0.1", port=8265):
+    dash = Dashboard(tuple(gcs_addr), host, port)
+    bound = await dash.start()
+    print(f"dashboard at http://{bound[0]}:{bound[1]}")
+    while True:
+        await asyncio.sleep(3600)

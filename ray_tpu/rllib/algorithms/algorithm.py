@@ -178,20 +178,24 @@ def gae_advantages(
     bootstrap_value: np.ndarray,
     gamma: float,
     lam: float,
+    boundary_values: Optional[np.ndarray] = None,
 ):
     """Generalized advantage estimation over time-major [T, N] arrays
     (reference: rllib/evaluation/postprocessing.py compute_gae_for_sample_batch,
-    vectorized). Truncation bootstraps with V(s_t+1); termination zeroes it."""
+    vectorized). Termination zeroes the bootstrap; truncation bootstraps with
+    V(final_obs) (`boundary_values`, computed by the env runner) — NOT with
+    the next row's value, which belongs to the next episode after autoreset."""
     T, N = rewards.shape
     adv = np.zeros((T, N), dtype=np.float32)
+    if boundary_values is None:
+        boundary_values = np.zeros((T, N), dtype=np.float32)
     next_value = bootstrap_value.astype(np.float32)
     gae = np.zeros(N, dtype=np.float32)
     for t in range(T - 1, -1, -1):
-        # Episode boundary handling: terminated -> no bootstrap; truncated ->
-        # bootstrap but reset the GAE accumulator.
         nonterminal = 1.0 - terminateds[t].astype(np.float32)
         boundary = np.logical_or(terminateds[t], truncateds[t])
-        delta = rewards[t] + gamma * next_value * nonterminal - values[t]
+        nv = np.where(truncateds[t], boundary_values[t], next_value)
+        delta = rewards[t] + gamma * nv * nonterminal - values[t]
         gae = delta + gamma * lam * nonterminal * np.where(boundary, 0.0, 1.0) * gae
         adv[t] = gae
         next_value = values[t]

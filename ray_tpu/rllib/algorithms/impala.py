@@ -41,7 +41,7 @@ def _vtrace(
     rewards,
     values,
     bootstrap_value,
-    terminateds,
+    dones,
     gamma,
     clip_rho,
     clip_c,
@@ -54,7 +54,9 @@ def _vtrace(
     rhos = jnp.exp(target_logp - behavior_logp)
     clipped_rhos = jnp.minimum(clip_rho, rhos)
     clipped_cs = jnp.minimum(clip_c, rhos)
-    discounts = gamma * (1.0 - terminateds)
+    # dones = terminated | truncated: truncation also cuts the recursion
+    # (the next row belongs to a different episode after autoreset).
+    discounts = gamma * (1.0 - dones)
     values_tp1 = jnp.concatenate([values[1:], bootstrap_value[None]], axis=0)
     deltas = clipped_rhos * (rewards + discounts * values_tp1 - values)
 
@@ -83,6 +85,11 @@ class IMPALALearner(Learner):
     def init_params(self, rng):
         return init_pi_vf(rng, self.spec)
 
+    def _policy_loss(self, target_logp, behavior_logp, pg_adv):
+        import jax.numpy as jnp
+
+        return -jnp.mean(target_logp * pg_adv)
+
     def loss_fn(self, params, batch):
         import jax
         import jax.numpy as jnp
@@ -98,18 +105,23 @@ class IMPALALearner(Learner):
             logp_all, batch["actions"][..., None], axis=-1
         )[..., 0]
 
+        dones = jnp.logical_or(
+            batch["terminateds"], batch["truncateds"]
+        ).astype(jnp.float32)
         vs, pg_adv = _vtrace(
             batch["behavior_logp"],
             target_logp,
             batch["rewards"],
             jax.lax.stop_gradient(values),
             batch["bootstrap_value"],
-            batch["terminateds"].astype(jnp.float32),
+            dones,
             c["gamma"],
             c["clip_rho"],
             c["clip_c"],
         )
-        policy_loss = -jnp.mean(target_logp * pg_adv)
+        policy_loss = self._policy_loss(
+            target_logp, batch["behavior_logp"], pg_adv
+        )
         vf_loss = 0.5 * jnp.mean(jnp.square(values - vs))
         probs = jax.nn.softmax(logits)
         entropy = -jnp.mean(jnp.sum(probs * logp_all, axis=-1))
@@ -149,19 +161,38 @@ class IMPALA(Algorithm):
         if config.num_env_runners < 1:
             raise ValueError("IMPALA requires num_env_runners >= 1")
         super().__init__(config)
-        self._inflight: Dict[Any, int] = {}  # ref -> actor_idx
+        self._inflight: Dict[Any, tuple] = {}  # ref -> (actor_idx, submit_t)
         self._updates_since_broadcast: Dict[int, int] = {}
 
     def _ensure_inflight(self) -> None:
+        """Heal dead/replaced runners, then keep one sample request in flight
+        per healthy runner."""
+        import time as _time
+
         cfg = self.config
-        have = set(self._inflight.values())
+        self.env_runner_group._heal()
         mgr = self.env_runner_group._manager
-        for i in mgr.healthy_actor_ids():
-            if i not in have:
+        healthy = set(mgr.healthy_actor_ids())
+        # Drop requests pinned to runners that are gone (their refs may never
+        # resolve) and requests that have outlived the sample timeout (hung
+        # runner: mark unhealthy so _heal replaces it next round).
+        now = _time.monotonic()
+        for ref, (idx, t0) in list(self._inflight.items()):
+            if idx not in healthy:
+                del self._inflight[ref]
+            elif now - t0 > cfg.sample_timeout_s:
+                self.env_runner_group.mark_unhealthy(idx)
+                del self._inflight[ref]
+        have = {idx for idx, _ in self._inflight.values()}
+        for i in healthy - have:
+            try:
                 ref = self.env_runner_group.submit_sample(
                     i, cfg.rollout_fragment_length
                 )
-                self._inflight[ref] = i
+            except Exception:
+                self.env_runner_group.mark_unhealthy(i)
+                continue
+            self._inflight[ref] = (i, now)
 
     def training_step(self) -> Dict[str, Any]:
         cfg = self.config
@@ -173,12 +204,14 @@ class IMPALA(Algorithm):
             if not self._inflight:
                 raise RuntimeError("no healthy env runners for IMPALA")
             ready, _ = ray_tpu.wait(
-                list(self._inflight), num_returns=1, timeout=cfg.sample_timeout_s
+                list(self._inflight),
+                num_returns=1,
+                timeout=min(5.0, cfg.sample_timeout_s),
             )
             if not ready:
                 continue
             ref = ready[0]
-            actor_idx = self._inflight.pop(ref)
+            actor_idx, _t0 = self._inflight.pop(ref)
             try:
                 batch = ray_tpu.get(ref)
             except Exception:
@@ -193,9 +226,12 @@ class IMPALA(Algorithm):
                 "behavior_logp": batch["logp"],
                 "rewards": batch["rewards"],
                 "terminateds": batch["terminateds"],
+                "truncateds": batch["truncateds"],
                 "bootstrap_value": batch["bootstrap_value"],
             }
-            metrics = self.learner_group.update_from_batch(train_batch)
+            metrics = self.learner_group.update_from_batch(
+                train_batch, time_major=True
+            )
             batches_done.append(batch)
 
             # Async weight push to this runner, then immediately resubmit its
@@ -209,10 +245,15 @@ class IMPALA(Algorithm):
                 self._updates_since_broadcast[actor_idx] = 0
             else:
                 self._updates_since_broadcast[actor_idx] = n
-            new_ref = self.env_runner_group.submit_sample(
-                actor_idx, cfg.rollout_fragment_length
-            )
-            self._inflight[new_ref] = actor_idx
+            import time as _time
+
+            try:
+                new_ref = self.env_runner_group.submit_sample(
+                    actor_idx, cfg.rollout_fragment_length
+                )
+                self._inflight[new_ref] = (actor_idx, _time.monotonic())
+            except Exception:
+                self.env_runner_group.mark_unhealthy(actor_idx)
         return {
             **self._episode_metrics(batches_done),
             **metrics,
@@ -227,45 +268,15 @@ class APPOConfig(IMPALAConfig):
 
 
 class APPOLearner(IMPALALearner):
-    def loss_fn(self, params, batch):
-        import jax
+    def _policy_loss(self, target_logp, behavior_logp, pg_adv):
+        # PPO clipped surrogate on V-trace advantages (reference APPO loss).
         import jax.numpy as jnp
 
         c = self.cfg
-        T, B = batch["rewards"].shape
-        obs = batch["obs"].reshape(T * B, -1)
-        logits, values = forward_pi_vf(params, obs)
-        logits = logits.reshape(T, B, -1)
-        values = values.reshape(T, B)
-        logp_all = jax.nn.log_softmax(logits)
-        target_logp = jnp.take_along_axis(
-            logp_all, batch["actions"][..., None], axis=-1
-        )[..., 0]
-        vs, pg_adv = _vtrace(
-            batch["behavior_logp"],
-            target_logp,
-            batch["rewards"],
-            jax.lax.stop_gradient(values),
-            batch["bootstrap_value"],
-            batch["terminateds"].astype(jnp.float32),
-            c["gamma"],
-            c["clip_rho"],
-            c["clip_c"],
-        )
-        # PPO clipped surrogate on V-trace advantages (reference APPO loss).
-        ratio = jnp.exp(target_logp - batch["behavior_logp"])
+        ratio = jnp.exp(target_logp - behavior_logp)
         surr1 = ratio * pg_adv
         surr2 = jnp.clip(ratio, 1 - c["clip_param"], 1 + c["clip_param"]) * pg_adv
-        policy_loss = -jnp.mean(jnp.minimum(surr1, surr2))
-        vf_loss = 0.5 * jnp.mean(jnp.square(values - vs))
-        probs = jax.nn.softmax(logits)
-        entropy = -jnp.mean(jnp.sum(probs * logp_all, axis=-1))
-        loss = policy_loss + c["vf_loss_coeff"] * vf_loss - c["entropy_coeff"] * entropy
-        return loss, {
-            "policy_loss": policy_loss,
-            "vf_loss": vf_loss,
-            "entropy": entropy,
-        }
+        return -jnp.mean(jnp.minimum(surr1, surr2))
 
 
 class APPO(IMPALA):

@@ -138,6 +138,7 @@ class PPO(Algorithm):
                 b["bootstrap_value"],
                 cfg.gamma,
                 cfg.lambda_,
+                boundary_values=b.get("boundary_values"),
             )
             flat["obs"].append(b["obs"].reshape(-1, self.obs_dim))
             flat["actions"].append(b["actions"].reshape(-1))

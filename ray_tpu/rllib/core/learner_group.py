@@ -93,15 +93,19 @@ class LearnerGroup:
     def is_local(self) -> bool:
         return self._local is not None
 
-    def update_from_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+    def update_from_batch(
+        self, batch: Dict[str, np.ndarray], *, time_major: bool = False
+    ) -> Dict[str, float]:
         """One synchronous update. Remote mode: shard batch across healthy
-        learners, average grads, apply everywhere (keeps learners in sync)."""
+        learners, average grads, apply everywhere (keeps learners in sync).
+        time_major=True shards [T, B, ...] arrays along the B axis (IMPALA
+        fragments must never be split along time — V-trace scans over T)."""
         if self._local is not None:
             return self._local.update_from_batch(batch)
         ids = self._manager.healthy_actor_ids()
         if not ids:
             raise RuntimeError("no healthy learner actors")
-        shards = _shard_batch(batch, len(ids))
+        shards = _shard_batch(batch, len(ids), time_major=time_major)
         refs = [
             (i, self._manager.actors[i].compute_gradients.remote(shard))
             for i, shard in zip(ids, shards)
@@ -161,9 +165,35 @@ class LearnerGroup:
                     pass
 
 
-def _shard_batch(batch: Dict[str, np.ndarray], n: int) -> List[Dict[str, np.ndarray]]:
+def _shard_batch(
+    batch: Dict[str, np.ndarray], n: int, *, time_major: bool = False
+) -> List[Dict[str, np.ndarray]]:
     if n == 1:
         return [batch]
-    size = len(next(iter(batch.values())))
-    idx = np.array_split(np.arange(size), n)
-    return [{k: v[ix] for k, v in batch.items()} for ix in idx]
+    if not time_major:
+        size = len(next(iter(batch.values())))
+        idx = np.array_split(np.arange(size), n)
+        return [{k: v[ix] for k, v in batch.items()} for ix in idx]
+    # Time-major [T, B, ...]: shard the batch axis (1); per-env vectors like
+    # bootstrap_value [B] shard axis 0.
+    ref = batch.get("rewards")
+    if ref is None:
+        ref = next(v for v in batch.values() if np.ndim(v) >= 2)
+    B = np.shape(ref)[1]
+    idx = np.array_split(np.arange(B), n)
+    shards = []
+    for ix in idx:
+        shard = {}
+        for k, v in batch.items():
+            v = np.asarray(v)
+            if v.ndim >= 2 and v.shape[1] == B:
+                shard[k] = v[:, ix]
+            elif v.ndim == 1 and v.shape[0] == B:
+                shard[k] = v[ix]
+            else:
+                raise ValueError(
+                    f"cannot shard key {k!r} with shape {v.shape} over batch "
+                    f"axis of size {B}"
+                )
+        shards.append(shard)
+    return shards

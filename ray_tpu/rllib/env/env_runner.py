@@ -33,17 +33,23 @@ class SingleAgentEnvRunner:
         import jax
 
         self._jax = jax
+        # SAME_STEP autoreset: step() at episode end returns the reset obs and
+        # puts the true terminal obs in infos["final_obs"] — we patch it back
+        # into next_obs so value targets see the real final state.
+        autoreset = gym.vector.AutoresetMode.SAME_STEP
         if isinstance(env_name_or_factory, str):
             name = env_name_or_factory
             cfg = env_config or {}
             self.envs = gym.vector.SyncVectorEnv(
-                [lambda: gym.make(name, **cfg) for _ in range(num_envs)]
+                [lambda: gym.make(name, **cfg) for _ in range(num_envs)],
+                autoreset_mode=autoreset,
             )
         else:
             factory = env_name_or_factory
             cfg = env_config or {}
             self.envs = gym.vector.SyncVectorEnv(
-                [lambda: factory(cfg) for _ in range(num_envs)]
+                [lambda: factory(cfg) for _ in range(num_envs)],
+                autoreset_mode=autoreset,
             )
         self.num_envs = num_envs
         self.policy_kind = policy_kind
@@ -141,12 +147,20 @@ class SingleAgentEnvRunner:
                     explore = np.random.rand(N) < epsilon
                     randoms = np.random.randint(0, self.num_actions, size=N)
                     actions = np.where(explore, randoms, greedy)
-            next_obs, rewards, terminated, truncated, _ = self.envs.step(actions)
+            next_obs, rewards, terminated, truncated, infos = self.envs.step(actions)
             act_buf[t] = actions
             rew_buf[t] = rewards
             term_buf[t] = terminated
             trunc_buf[t] = truncated
             next_obs_buf[t] = next_obs.reshape(N, -1).astype(np.float32)
+            # Patch true terminal observations over the autoreset obs.
+            final_obs = infos.get("final_obs", infos.get("final_observation"))
+            if final_obs is not None:
+                for i in np.nonzero(np.logical_or(terminated, truncated))[0]:
+                    if final_obs[i] is not None:
+                        next_obs_buf[t, i] = np.asarray(
+                            final_obs[i], dtype=np.float32
+                        ).reshape(-1)
 
             self._episode_returns += rewards
             self._episode_lens += 1
@@ -180,6 +194,17 @@ class SingleAgentEnvRunner:
                 self._obs.reshape(N, -1).astype(np.float32),
             )
             out["bootstrap_value"] = np.asarray(bootstrap)
+            # V(final_obs) at truncation boundaries, so GAE bootstraps the
+            # real terminal state instead of the autoreset obs. Sparse: one
+            # batched forward over just the truncated positions.
+            boundary_values = np.zeros((T, N), dtype=np.float32)
+            ts, is_ = np.nonzero(trunc_buf)
+            if len(ts):
+                _, _, v_fin = self._policy_step(
+                    self.params, self._next_rng(), next_obs_buf[ts, is_]
+                )
+                boundary_values[ts, is_] = np.asarray(v_fin)
+            out["boundary_values"] = boundary_values
         return out
 
     def get_spaces(self) -> Tuple[int, int]:

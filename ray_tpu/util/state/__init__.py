@@ -1,0 +1,30 @@
+"""ray_tpu.util.state: cluster state inspection API (reference:
+python/ray/util/state)."""
+
+from ray_tpu.util.state.api import (
+    list_actors,
+    list_jobs,
+    list_nodes,
+    list_objects,
+    list_placement_groups,
+    list_tasks,
+    list_workers,
+    summarize_actors,
+    summarize_objects,
+    summarize_tasks,
+    timeline,
+)
+
+__all__ = [
+    "list_actors",
+    "list_jobs",
+    "list_nodes",
+    "list_objects",
+    "list_placement_groups",
+    "list_tasks",
+    "list_workers",
+    "summarize_actors",
+    "summarize_objects",
+    "summarize_tasks",
+    "timeline",
+]

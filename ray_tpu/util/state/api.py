@@ -1,0 +1,187 @@
+"""State API: list/summarize live cluster state.
+
+Analog of python/ray/util/state/api.py (list_actors/tasks/objects/nodes/
+workers/placement_groups/jobs at :788-1112, summarize_* at :1382-1450), fed
+by the GCS (actors/nodes/PGs/jobs/task events) and per-raylet detail queries
+(workers/objects — the reference's GetTasksInfo/GetObjectsInfo path).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import worker as worker_mod
+
+
+def _call_gcs(method: str, payload: Optional[dict] = None) -> dict:
+    core = worker_mod._core()
+    return worker_mod.global_worker.run_async(core.gcs.call(method, payload or {}))
+
+
+def _filter(rows: List[dict], filters) -> List[dict]:
+    """filters: list of (key, op, value) with op in ("=", "!=")."""
+    for key, op, value in filters or []:
+        if op == "=":
+            rows = [r for r in rows if str(r.get(key)) == str(value)]
+        elif op == "!=":
+            rows = [r for r in rows if str(r.get(key)) != str(value)]
+        else:
+            raise ValueError(f"unsupported filter op {op!r}")
+    return rows
+
+
+def list_nodes(filters=None, limit: int = 10000) -> List[dict]:
+    rows = _call_gcs("GetAllNodes")["nodes"]
+    return _filter(rows, filters)[:limit]
+
+
+def list_actors(filters=None, limit: int = 10000) -> List[dict]:
+    rows = _call_gcs("ListActors")["actors"]
+    return _filter(rows, filters)[:limit]
+
+
+def list_placement_groups(filters=None, limit: int = 10000) -> List[dict]:
+    rows = _call_gcs("ListPlacementGroups")["pgs"]
+    return _filter(rows, filters)[:limit]
+
+
+def list_jobs(filters=None, limit: int = 10000) -> List[dict]:
+    rows = _call_gcs("ListJobs")["jobs"]
+    return _filter(rows, filters)[:limit]
+
+
+def list_tasks(filters=None, limit: int = 10000, job_id: Optional[str] = None) -> List[dict]:
+    """Latest state per task, derived from the task-event log."""
+    events = _call_gcs("ListTaskEvents", {"job_id": job_id, "limit": 100000})["events"]
+    latest: Dict[str, dict] = {}
+    first_ts: Dict[str, float] = {}
+    for e in events:
+        tid = e["task_id"]
+        first_ts.setdefault(tid, e["time"])
+        cur = latest.get(tid)
+        if cur is None or e["time"] >= cur["time"]:
+            latest[tid] = e
+    rows = [
+        {
+            "task_id": tid,
+            "name": e.get("name"),
+            "state": e.get("state"),
+            "job_id": e.get("job_id"),
+            "worker_id": e.get("worker_id"),
+            "node_id": e.get("node_id"),
+            "start_time": first_ts[tid],
+            "end_time": e["time"] if e.get("state") in ("FINISHED", "FAILED") else None,
+            "error": e.get("error"),
+        }
+        for tid, e in latest.items()
+    ]
+    rows.sort(key=lambda r: r["start_time"])
+    return _filter(rows, filters)[:limit]
+
+
+def _each_raylet(payload: dict) -> List[dict]:
+    core = worker_mod._core()
+
+    async def _collect():
+        out = []
+        for n in (await core.gcs.call("GetAllNodes"))["nodes"]:
+            if n["state"] != "ALIVE":
+                continue
+            try:
+                conn = await core.connect_to(tuple(n["addr"]))
+                out.append(await conn.call("GetNodeStats", payload))
+            except Exception:
+                pass
+        return out
+
+    return worker_mod.global_worker.run_async(_collect())
+
+
+def list_workers(filters=None, limit: int = 10000) -> List[dict]:
+    rows: List[dict] = []
+    for stats in _each_raylet({"include_workers": True}):
+        rows.extend(stats.get("workers", []))
+    return _filter(rows, filters)[:limit]
+
+
+def list_objects(filters=None, limit: int = 10000) -> List[dict]:
+    rows: List[dict] = []
+    for stats in _each_raylet({"include_objects": True}):
+        rows.extend(stats.get("objects", []))
+    return _filter(rows, filters)[:limit]
+
+
+# -- summaries ----------------------------------------------------------------
+
+
+def summarize_tasks(job_id: Optional[str] = None) -> Dict[str, Any]:
+    per_name: Dict[str, collections.Counter] = collections.defaultdict(
+        collections.Counter
+    )
+    for t in list_tasks(job_id=job_id):
+        per_name[t["name"] or "?"][t["state"]] += 1
+    return {
+        "summary": {
+            name: dict(states) for name, states in sorted(per_name.items())
+        },
+        "total_tasks": sum(sum(c.values()) for c in per_name.values()),
+    }
+
+
+def summarize_actors() -> Dict[str, Any]:
+    per_class: Dict[str, collections.Counter] = collections.defaultdict(
+        collections.Counter
+    )
+    for a in list_actors():
+        per_class[a.get("name") or a.get("class_name") or "?"][a["state"]] += 1
+    return {
+        "summary": {cls: dict(states) for cls, states in sorted(per_class.items())},
+        "total_actors": sum(sum(c.values()) for c in per_class.values()),
+    }
+
+
+def summarize_objects() -> Dict[str, Any]:
+    objs = list_objects()
+    total = sum(o["size"] for o in objs)
+    return {
+        "total_objects": len(objs),
+        "total_size_bytes": total,
+        "pinned": sum(1 for o in objs if o["pinned"]),
+        "sealed": sum(1 for o in objs if o["sealed"]),
+    }
+
+
+# -- timeline (reference: ray.timeline, _private/state.py:922) ----------------
+
+
+def timeline(filename: Optional[str] = None) -> List[dict]:
+    """Chrome-tracing events derived from the task-event log: one complete
+    ("X") event per RUNNING->FINISHED/FAILED task span."""
+    events = _call_gcs("ListTaskEvents", {"limit": 100000})["events"]
+    spans: Dict[str, dict] = {}
+    out: List[dict] = []
+    for e in sorted(events, key=lambda x: x["time"]):
+        tid = e["task_id"]
+        if e["state"] == "RUNNING":
+            spans[tid] = e
+        elif e["state"] in ("FINISHED", "FAILED") and tid in spans:
+            start = spans.pop(tid)
+            out.append(
+                {
+                    "name": e.get("name") or "task",
+                    "cat": "task",
+                    "ph": "X",
+                    "ts": start["time"] * 1e6,
+                    "dur": max(0.0, (e["time"] - start["time"]) * 1e6),
+                    "pid": e.get("node_id", "node"),
+                    "tid": e.get("worker_id", "worker"),
+                    "args": {"task_id": tid, "state": e["state"]},
+                }
+            )
+    if filename:
+        import json
+
+        with open(filename, "w") as f:
+            json.dump(out, f)
+    return out

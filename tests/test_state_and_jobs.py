@@ -1,0 +1,163 @@
+"""State API, job submission, dashboard, and CLI tests (analog of
+python/ray/tests/test_state_api*.py + dashboard/modules/job tests)."""
+
+import json
+import sys
+import time
+import urllib.request
+
+import pytest
+
+
+def test_state_api_lists(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.util import state as state_api
+
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.options(name="state_test_actor").remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+    refs = [f.remote(i) for i in range(5)]
+    assert ray_tpu.get(refs) == [1, 2, 3, 4, 5]
+    time.sleep(1.5)  # task-event flush interval
+
+    nodes = state_api.list_nodes()
+    assert len(nodes) == 1 and nodes[0]["state"] == "ALIVE"
+
+    actors = state_api.list_actors()
+    assert any(x["name"] == "state_test_actor" for x in actors)
+    alive = state_api.list_actors(filters=[("state", "=", "ALIVE")])
+    assert all(x["state"] == "ALIVE" for x in alive)
+
+    tasks = state_api.list_tasks()
+    f_tasks = [t for t in tasks if t["name"] == "f"]
+    assert len(f_tasks) == 5
+    assert all(t["state"] == "FINISHED" for t in f_tasks)
+
+    workers = state_api.list_workers()
+    assert len(workers) >= 1
+    assert all(w["pid"] for w in workers)
+
+    summary = state_api.summarize_tasks()
+    assert summary["summary"]["f"]["FINISHED"] == 5
+
+    a_sum = state_api.summarize_actors()
+    assert a_sum["total_actors"] >= 1
+
+
+def test_timeline(ray_start_regular, tmp_path):
+    import ray_tpu
+    from ray_tpu.util.state import timeline
+
+    @ray_tpu.remote
+    def work():
+        time.sleep(0.05)
+        return 1
+
+    ray_tpu.get([work.remote() for _ in range(3)])
+    time.sleep(1.5)
+    out = tmp_path / "timeline.json"
+    events = timeline(str(out))
+    spans = [e for e in events if e["name"] == "work"]
+    assert len(spans) == 3
+    assert all(e["ph"] == "X" and e["dur"] > 0 for e in spans)
+    assert json.loads(out.read_text())
+
+
+def test_job_submission(ray_start_regular):
+    from ray_tpu.job import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('hello from job')\""
+    )
+    status = client.wait_until_finish(sid, timeout_s=60)
+    assert status == JobStatus.SUCCEEDED
+    assert "hello from job" in client.get_job_logs(sid)
+    jobs = client.list_jobs()
+    assert any(j.submission_id == sid for j in jobs)
+
+
+def test_job_failure_and_env(ray_start_regular):
+    from ray_tpu.job import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"import os;print(os.environ['MY_VAR']);raise SystemExit(3)\"",
+        runtime_env={"env_vars": {"MY_VAR": "xyz123"}},
+    )
+    status = client.wait_until_finish(sid, timeout_s=60)
+    assert status == JobStatus.FAILED
+    info = client.get_job_info(sid)
+    assert "exit code 3" in info.message
+    assert "xyz123" in client.get_job_logs(sid)
+
+
+def test_job_runs_cluster_workload(ray_start_regular):
+    """A submitted job connects back to the same cluster via RAY_TPU_ADDRESS."""
+    from ray_tpu.job import JobStatus, JobSubmissionClient
+
+    script = (
+        "import ray_tpu; ray_tpu.init(address='auto'); "
+        "f = ray_tpu.remote(lambda x: x * 3); "
+        "print('job-result', ray_tpu.get(f.remote(14))); ray_tpu.shutdown()"
+    )
+    client = JobSubmissionClient()
+    sid = client.submit_job(entrypoint=f"{sys.executable} -c \"{script}\"")
+    status = client.wait_until_finish(sid, timeout_s=120)
+    logs = client.get_job_logs(sid)
+    assert status == JobStatus.SUCCEEDED, logs
+    assert "job-result 42" in logs
+
+
+def test_dashboard_endpoints(ray_start_regular):
+    import ray_tpu
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu.dashboard.dashboard import Dashboard
+
+    @ray_tpu.remote
+    def g():
+        return 1
+
+    ray_tpu.get(g.remote())
+    time.sleep(1.5)
+
+    gcs_addr = worker_mod.global_worker.node.gcs_addr
+    dash = Dashboard(gcs_addr, port=0)
+    host, port = worker_mod.global_worker.run_async(dash.start())
+    base = f"http://{host}:{port}"
+    try:
+        assert urllib.request.urlopen(f"{base}/-/healthz").read() == b"success"
+        index = urllib.request.urlopen(base).read().decode()
+        assert "ray_tpu dashboard" in index
+        nodes = json.loads(urllib.request.urlopen(f"{base}/api/nodes").read())
+        assert len(nodes["nodes"]) == 1
+        summary = json.loads(
+            urllib.request.urlopen(f"{base}/api/tasks/summary").read()
+        )
+        assert summary["summary"].get("g", {}).get("FINISHED") == 1
+        status = json.loads(
+            urllib.request.urlopen(f"{base}/api/cluster_status").read()
+        )
+        assert "nodes" in status
+    finally:
+        worker_mod.global_worker.run_async(dash.stop())
+
+
+def test_cli_parser():
+    from ray_tpu.scripts.cli import build_parser
+
+    p = build_parser()
+    args = p.parse_args(["job", "submit", "--wait", "echo", "hi"])
+    assert args.job_cmd == "submit" and args.wait
+    args = p.parse_args(["list", "actors", "--limit", "5"])
+    assert args.kind == "actors" and args.limit == 5
+    args = p.parse_args(["start", "--head", "--num-cpus", "4"])
+    assert args.head and args.num_cpus == 4.0

@@ -38,6 +38,9 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     "actor_default_max_restarts": 0,
     # Object transfer chunk size between nodes.
     "object_chunk_size": 8 * 1024 * 1024,
+    # Arena eviction: unpinned objects accessed within this window are never
+    # evicted (their arena bytes could still be mid-read by a client).
+    "object_store_eviction_grace_s": 10.0,
     # Scheduling: hybrid policy spills beyond this utilization (reference
     # scheduler_spread_threshold).
     "scheduler_spread_threshold": 0.5,

@@ -163,6 +163,11 @@ class ReferenceTable:
             del self.entries[oid]
             if e.owned:
                 core.schedule_free(oid)
+            # Drop this process's plasma hold: with no local refs left, user
+            # code keeping a zero-copy view alive past this point is outside
+            # the supported contract (same as the reference's buffer release).
+            if oid in core.plasma.held:
+                core.schedule_release(oid)
 
 
 class Lease:
@@ -428,6 +433,7 @@ class CoreWorker:
         self._func_ids_exported: set = set()
         self._task_events: List[dict] = []
         self._free_queue: List[str] = []
+        self._release_queue: List[str] = []
         self.closed = False
         self._bg_tasks: List[asyncio.Task] = []
 
@@ -442,7 +448,14 @@ class CoreWorker:
         while not self.closed:
             await asyncio.sleep(1.0)
             await self._flush_free_queue()
+            await self._flush_release_queue()
             await self._flush_task_events()
+
+    async def _flush_release_queue(self) -> None:
+        if not self._release_queue:
+            return
+        oids, self._release_queue = self._release_queue, []
+        await self.plasma.release_many(oids)
 
     async def _flush_free_queue(self) -> None:
         if not self._free_queue:
@@ -495,6 +508,9 @@ class CoreWorker:
 
     def schedule_free(self, oid: str) -> None:
         self._free_queue.append(oid)
+
+    def schedule_release(self, oid: str) -> None:
+        self._release_queue.append(oid)
 
     async def connect_to(self, addr: Tuple[str, int]) -> rpc.Connection:
         addr = tuple(addr)
@@ -721,6 +737,10 @@ class CoreWorker:
         scheduling_strategy: Optional[dict] = None,
         runtime_env: Optional[dict] = None,
     ) -> List[ObjectRef]:
+        if runtime_env:
+            from ray_tpu.runtime_env.context import prepare
+
+            runtime_env = await prepare(self, runtime_env)
         func_id = await self.export_function(pickled_fn)
         task_id = TaskID.from_random().hex()
         return_ids = [
@@ -873,6 +893,10 @@ class CoreWorker:
         scheduling_strategy: Optional[dict] = None,
         runtime_env: Optional[dict] = None,
     ) -> str:
+        if runtime_env:
+            from ray_tpu.runtime_env.context import prepare
+
+            runtime_env = await prepare(self, runtime_env)
         func_id = await self.export_function(pickled_cls)
         actor_id = ActorID.from_random().hex()
         task_id = TaskID.from_random().hex()

@@ -790,6 +790,9 @@ class GcsClient:
             "deleted"
         ]
 
+    async def kv_exists(self, key: str, ns: str = "") -> bool:
+        return (await self.call("KVExists", {"key": key, "ns": ns}))["exists"]
+
     async def kv_keys(self, prefix: str = "", ns: str = "") -> List[str]:
         return (await self.conn.call("KVKeys", {"ns": ns, "prefix": prefix}))["keys"]
 

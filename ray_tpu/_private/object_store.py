@@ -77,27 +77,39 @@ class MemoryStore:
 
 
 class PlasmaClient:
-    """Client of the local raylet's shm object directory.
+    """Client of the local raylet's shm arena store.
 
-    Mapped segments are held (pinned client-side) until `release`; reads are
-    zero-copy memoryviews into the segment.
+    The node's whole object store is one shm arena; the client maps it once
+    (read-write — puts write directly at raylet-assigned offsets) and every
+    read is a zero-copy memoryview slice at an offset. Mirrors the reference
+    plasma client's single-mmap attach (plasma/client.h).
     """
 
     def __init__(self, raylet_conn: rpc.Connection):
         self.conn = raylet_conn
-        self._segments: Dict[str, shm.Segment] = {}
-        self._deferred_close: List[shm.Segment] = []
+        self._arenas: Dict[str, shm.Segment] = {}
+        # Objects this client holds (the raylet counts a hold per ObjGet and
+        # will not recycle their bytes until released / disconnect).
+        self.held: Dict[str, int] = {}
+
+    def _arena_view(self, name: str) -> memoryview:
+        seg = self._arenas.get(name)
+        if seg is None:
+            seg = shm.open_rw(name)
+            self._arenas[name] = seg
+        return seg.view
+
+    def _slice(self, meta: dict) -> memoryview:
+        view = self._arena_view(meta["arena"])
+        off, size = meta["offset"], meta["size"]
+        return view[off : off + size]
 
     async def put_serialized(self, oid: str, serialized) -> None:
         size = max(1, serialized.total_size)
         reply = await self.conn.call("ObjCreate", {"oid": oid, "size": size, "pin": True})
         if reply.get("exists"):
             return  # already stored (e.g. deterministic re-execution)
-        seg = shm.create(reply["name"], size)
-        try:
-            serialized.write_to(seg.view)
-        finally:
-            seg.close()
+        serialized.write_to(self._slice(reply))
         await self.conn.call("ObjSeal", {"oid": oid})
 
     async def put_bytes(self, oid: str, payload: bytes) -> None:
@@ -106,11 +118,7 @@ class PlasmaClient:
         )
         if reply.get("exists"):
             return
-        seg = shm.create(reply["name"], max(1, len(payload)))
-        try:
-            seg.view[: len(payload)] = payload
-        finally:
-            seg.close()
+        self._slice(reply)[: len(payload)] = payload
         await self.conn.call("ObjSeal", {"oid": oid})
 
     async def get(
@@ -123,11 +131,8 @@ class PlasmaClient:
         )
         found: Dict[str, memoryview] = {}
         for oid, meta in reply["found"].items():
-            seg = self._segments.get(oid)
-            if seg is None:
-                seg = shm.open_ro(meta["name"])
-                self._segments[oid] = seg
-            found[oid] = seg.view
+            self.held[oid] = self.held.get(oid, 0) + 1
+            found[oid] = self._slice(meta)
         return found, reply["missing"]
 
     async def contains(self, oids: List[str]) -> Dict[str, bool]:
@@ -136,39 +141,50 @@ class PlasmaClient:
 
     async def pull(self, oid: str, from_addr: Tuple[str, int]) -> memoryview:
         """Ask the local raylet to fetch a remote object, then map it."""
-        await self.conn.call(
+        meta = await self.conn.call(
             "PullObject", {"oid": oid, "from_addr": list(from_addr)}, timeout=300
         )
+        if meta.get("offset") is not None:
+            self.held[oid] = self.held.get(oid, 0) + 1
+            return self._slice(meta)
         found, missing = await self.get([oid], timeout=30)
         if oid in found:
             return found[oid]
         raise ObjectLostError(f"pull of {oid[:12]} failed: {missing}")
 
-    def release(self, oid: str) -> None:
-        seg = self._segments.pop(oid, None)
-        if seg is not None:
-            self._close_or_defer(seg)
-        # Opportunistically retry deferred closes.
-        still = []
-        for s in self._deferred_close:
-            try:
-                s.close()
-            except Exception:
-                still.append(s)
-        self._deferred_close = still
-
-    def _close_or_defer(self, seg: shm.Segment) -> None:
+    async def release_many(self, oids: List[str]) -> None:
+        """Drop this client's holds (the raylet may then evict/reclaim)."""
+        to_send = []
+        for oid in oids:
+            n = self.held.pop(oid, 0)
+            to_send.extend([oid] * n)
+        if not to_send:
+            return
         try:
-            seg.close()
-        except Exception:
-            # memoryviews into the segment are still alive; retry later.
-            self._deferred_close.append(seg)
+            await self.conn.call("ObjRelease", {"oids": to_send})
+        except rpc.RpcError:
+            pass
+
+    def release(self, oid: str) -> None:
+        """Fire-and-forget single release (LRU touch + hold drop)."""
+        import asyncio
+
+        try:
+            task = asyncio.ensure_future(self.release_many([oid]))
+        except RuntimeError:  # no running loop (sync teardown path)
+            return
+        # Retrieve any exception so a closed connection doesn't log noise.
+        task.add_done_callback(
+            lambda t: t.exception() if not t.cancelled() else None
+        )
 
     async def delete(self, oids: List[str]) -> None:
-        for oid in oids:
-            self.release(oid)
         await self.conn.call("ObjDelete", {"oids": oids})
 
     def close(self) -> None:
-        for oid in list(self._segments):
-            self.release(oid)
+        for seg in self._arenas.values():
+            try:
+                seg.close()
+            except Exception:
+                pass  # live views into the arena keep the mapping alive
+        self._arenas = {}

@@ -3,9 +3,10 @@
 TPU-native analog of the reference's raylet (src/ray/raylet/node_manager.cc):
 worker-pool management, lease-based task scheduling with spillback, placement
 group bundle 2PC resource accounting, and the node's shared-memory object
-directory (the plasma-store role: src/ray/object_manager/plasma/store.h — data
-lives in per-object shm segments created by clients, the raylet owns naming,
-pinning, LRU eviction and cross-node transfer).
+store (the plasma-store role: src/ray/object_manager/plasma/store.h — data
+lives in one shm arena per node; a native StoreCore manages offsets, sealing,
+pinning and LRU eviction; clients map the arena once and read/write at
+offsets, zero-copy).
 
 Accelerator detection: reports a ``TPU`` resource per local chip plus the
 pod-slice gang resource ``TPU-{pod_type}-head`` on worker 0 of a slice,
@@ -25,6 +26,7 @@ from typing import Dict, List, Optional, Set, Tuple
 from ray_tpu._private import rpc, shm
 from ray_tpu._private.common import ResourceSet, config
 from ray_tpu._private.gcs import GcsClient
+from ray_tpu._private.store_core import make_store_core
 
 logger = logging.getLogger(__name__)
 
@@ -66,29 +68,6 @@ class WorkerHandle:
         self.actor_id: Optional[str] = None
         self.demand: Optional[ResourceSet] = None
         self.idle_since = time.monotonic()
-
-
-class ObjectEntry:
-    __slots__ = (
-        "oid",
-        "size",
-        "segment",
-        "sealed",
-        "pinned",
-        "last_access",
-        "waiters",
-        "creating_since",
-    )
-
-    def __init__(self, oid: str, size: int, segment: str):
-        self.oid = oid
-        self.size = size
-        self.segment = segment
-        self.sealed = False
-        self.pinned = False
-        self.last_access = time.monotonic()
-        self.waiters: List[asyncio.Future] = []
-        self.creating_since = time.monotonic()
 
 
 class LeaseRequest:
@@ -142,8 +121,23 @@ class Raylet:
                 int(mem * config.object_store_memory_fraction),
             )
         self.store_capacity = object_store_memory
-        self.store_used = 0
-        self.objects: Dict[str, ObjectEntry] = {}
+        # Arena store: one shm segment per node, offsets managed by the
+        # (native) StoreCore — plasma's dlmalloc-over-mmap design. Created in
+        # start(); obj_waiters holds futures blocking on unsealed objects,
+        # obj_last_access drives the time-grace eviction filter.
+        self.store = make_store_core(object_store_memory)
+        self.arena_name = f"rt_{self.session_name[:10]}_{self.node_id[:10]}"
+        self.arena: Optional[shm.Segment] = None
+        self.obj_waiters: Dict[str, List[asyncio.Future]] = {}
+        self.obj_last_access: Dict[str, float] = {}
+        # Deleted objects are quarantined (not freed) for the grace window:
+        # clients may still hold zero-copy views into their arena bytes.
+        self.condemned: Dict[str, float] = {}
+        # Client holds (plasma's per-client buffer refcounts,
+        # plasma/client.h): ObjGet increments for the calling connection,
+        # ObjRelease decrements, disconnect clears. Held objects are never
+        # freed/evicted, whatever their age.
+        self.obj_holds: Dict[str, Dict[int, int]] = {}
 
         # Workers.
         self.workers: Dict[str, WorkerHandle] = {}
@@ -160,9 +154,14 @@ class Raylet:
         self._tasks: List[asyncio.Task] = []
         self._register_handlers()
 
+    @property
+    def store_used(self) -> int:
+        return self.store.used
+
     # -- lifecycle ----------------------------------------------------------
 
     async def start(self) -> Tuple[str, int]:
+        self.arena = shm.create(self.arena_name, self.store_capacity)
         addr = await self.server.start()
         self.server.on_disconnect(self._on_disconnect)
         # Duplex: the GCS calls back over this link (LeaseWorkerForActor,
@@ -179,6 +178,7 @@ class Raylet:
             },
         )
         self._tasks.append(asyncio.create_task(self._resource_report_loop()))
+        self._tasks.append(asyncio.create_task(self._condemned_sweep_loop()))
         logger.info(
             "raylet %s on %s:%s resources=%s",
             self.node_id[:8],
@@ -193,8 +193,12 @@ class Raylet:
             t.cancel()
         for w in list(self.workers.values()):
             self._kill_worker_proc(w)
-        for entry in list(self.objects.values()):
-            shm.unlink(entry.segment)
+        if self.arena is not None:
+            self.arena.close()
+            try:
+                shm.unlink(self.arena_name)
+            except Exception:
+                pass
         await self.server.stop()
         if self.gcs is not None:
             await self.gcs.conn.close()
@@ -335,6 +339,11 @@ class Raylet:
         }
 
     def _on_disconnect(self, conn: rpc.Connection) -> None:
+        cid = id(conn)
+        for oid, holds in list(self.obj_holds.items()):
+            holds.pop(cid, None)
+            if not holds:
+                del self.obj_holds[oid]
         worker_id = conn.context.get("worker_id")
         if worker_id and worker_id in self.workers:
             handle = self.workers[worker_id]
@@ -500,53 +509,122 @@ class Raylet:
         return {"ok": True}
 
     # -- object store --------------------------------------------------------
+    # One shm arena per node; the StoreCore (C++ when built) owns offsets,
+    # seal/pin state and LRU order — reference: plasma store
+    # (object_lifecycle_manager.cc / plasma_allocator.cc / eviction_policy.cc).
 
-    def _segment_name(self, oid: str) -> str:
-        return f"rt_{self.session_name[:12]}_{oid[:24]}"
+    def _obj_meta(self, oid: str, info) -> dict:
+        return {
+            "arena": self.arena_name,
+            "offset": info[0],
+            "size": info[1],
+        }
 
-    def _evict_for(self, size: int) -> bool:
-        if self.store_used + size <= self.store_capacity:
-            return True
-        victims = sorted(
-            (e for e in self.objects.values() if e.sealed and not e.pinned),
-            key=lambda e: e.last_access,
-        )
-        for v in victims:
-            self._delete_entry(v)
-            if self.store_used + size <= self.store_capacity:
-                return True
-        return self.store_used + size <= self.store_capacity
+    async def _condemned_sweep_loop(self) -> None:
+        while True:
+            await asyncio.sleep(1.0)
+            self._sweep_condemned()
 
-    def _delete_entry(self, entry: ObjectEntry) -> None:
-        self.objects.pop(entry.oid, None)
-        self.store_used -= entry.size
-        shm.unlink(entry.segment)
+    def _sweep_condemned(self, force: bool = False) -> None:
+        """Return quarantined spans to the allocator once the grace window has
+        passed (no client should still be holding a view)."""
+        now = time.monotonic()
+        grace = config.object_store_eviction_grace_s
+        for oid, t in list(self.condemned.items()):
+            if oid in self.obj_holds:
+                continue  # a client still maps it; reclaim after release
+            if force or now - t >= grace:
+                self.store.free(oid)
+                del self.condemned[oid]
+
+    def _delete_object(self, oid: str) -> None:
+        """Logical delete: the object disappears from the directory now, its
+        bytes are reclaimed after the grace window (clients may hold views)."""
+        if oid in self.condemned or self.store.lookup(oid) is None:
+            return
+        self.condemned[oid] = time.monotonic()
+        self.obj_last_access.pop(oid, None)
+        for fut in self.obj_waiters.pop(oid, []):
+            if not fut.done():
+                fut.set_result(False)
+
+    def _try_alloc(self, oid: str, size: int, pin: bool) -> int:
+        """Alloc with eviction retries. Victims: condemned objects past grace
+        first, then LRU sealed+unpinned objects past grace. Retrying alloc
+        after every free makes the loop robust to rounding/fragmentation
+        (byte accounting alone cannot prove a span fits)."""
+        offset = self.store.alloc(oid, size, pin)
+        if offset >= 0:
+            return offset
+        self._sweep_condemned()
+        offset = self.store.alloc(oid, size, pin)
+        if offset >= 0:
+            return offset
+        now = time.monotonic()
+        grace = config.object_store_eviction_grace_s
+        candidates = []
+        for vic, last in self.obj_last_access.items():
+            if now - last < grace or vic in self.obj_holds:
+                continue
+            info = self.store.lookup(vic)
+            if info is not None and info[2] and not info[3]:
+                candidates.append((last, vic))
+        candidates.sort()
+        for _, vic in candidates:
+            self.store.free(vic)
+            self.obj_last_access.pop(vic, None)
+            offset = self.store.alloc(oid, size, pin)
+            if offset >= 0:
+                return offset
+        return -1
 
     async def _obj_create(self, conn, p):
         oid, size = p["oid"], p["size"]
-        if oid in self.objects:
-            entry = self.objects[oid]
-            return {"name": entry.segment, "exists": True, "sealed": entry.sealed}
-        if not self._evict_for(size):
+        if oid in self.condemned:
+            if oid in self.obj_holds:
+                # A client still maps the old (deterministically identical)
+                # bytes: resurrect the quarantined object instead of freeing
+                # a span someone is reading.
+                del self.condemned[oid]
+                self.obj_last_access[oid] = time.monotonic()
+            else:
+                # Recreate of a just-deleted id: reclaim that one span now.
+                self.store.free(oid)
+                del self.condemned[oid]
+        info = self.store.lookup(oid)
+        if info is not None:
+            self.obj_last_access[oid] = time.monotonic()
+            meta = self._obj_meta(oid, info)
+            meta.update({"exists": True, "sealed": info[2]})
+            return meta
+        pin = bool(p.get("pin", True))
+        offset = self._try_alloc(oid, size, pin)
+        if offset < 0:
             raise rpc.RpcError(
-                f"object store full: need {size}, capacity {self.store_capacity}"
+                f"object store full: need {size}, used {self.store.used} of "
+                f"{self.store_capacity} (fragmentation "
+                f"{self.store.fragmentation()[0]:.2f}; objects within the "
+                f"{config.object_store_eviction_grace_s:.0f}s eviction grace "
+                "window cannot be evicted — raise object_store_memory or "
+                "RAY_TPU_OBJECT_STORE_EVICTION_GRACE_S)"
             )
-        entry = ObjectEntry(oid, size, self._segment_name(oid))
-        entry.pinned = bool(p.get("pin", True))
-        self.objects[oid] = entry
-        self.store_used += size
-        return {"name": entry.segment, "exists": False}
+        self.obj_last_access[oid] = time.monotonic()
+        return {
+            "arena": self.arena_name,
+            "offset": offset,
+            "size": size,
+            "exists": False,
+        }
 
     async def _obj_seal(self, conn, p):
-        entry = self.objects.get(p["oid"])
-        if entry is None:
-            raise rpc.RpcError(f"seal of unknown object {p['oid'][:12]}")
-        entry.sealed = True
-        entry.last_access = time.monotonic()
-        for fut in entry.waiters:
+        oid = p["oid"]
+        if self.store.lookup(oid) is None:
+            raise rpc.RpcError(f"seal of unknown object {oid[:12]}")
+        self.store.seal(oid)
+        self.obj_last_access[oid] = time.monotonic()
+        for fut in self.obj_waiters.pop(oid, []):
             if not fut.done():
                 fut.set_result(True)
-        entry.waiters.clear()
         return {"ok": True}
 
     async def _obj_get(self, conn, p):
@@ -555,19 +633,23 @@ class Raylet:
         found, missing = {}, []
         deadline = time.monotonic() + timeout if timeout else None
         for oid in p["oids"]:
-            entry = self.objects.get(oid)
-            if entry is not None and not entry.sealed and p.get("block", True):
+            info = None if oid in self.condemned else self.store.lookup(oid)
+            if info is not None and not info[2] and p.get("block", True):
                 fut = asyncio.get_running_loop().create_future()
-                entry.waiters.append(fut)
-                remaining = None if deadline is None else max(0, deadline - time.monotonic())
+                self.obj_waiters.setdefault(oid, []).append(fut)
+                remaining = (
+                    None if deadline is None else max(0, deadline - time.monotonic())
+                )
                 try:
                     await asyncio.wait_for(fut, remaining)
                 except asyncio.TimeoutError:
                     pass
-                entry = self.objects.get(oid)
-            if entry is not None and entry.sealed:
-                entry.last_access = time.monotonic()
-                found[oid] = {"name": entry.segment, "size": entry.size}
+                info = None if oid in self.condemned else self.store.lookup(oid)
+            if info is not None and info[2]:
+                self.store.touch(oid)
+                self.obj_last_access[oid] = time.monotonic()
+                self._add_hold(conn, oid)
+                found[oid] = self._obj_meta(oid, info)
             else:
                 missing.append(oid)
         return {"found": found, "missing": missing}
@@ -575,82 +657,94 @@ class Raylet:
     async def _obj_contains(self, conn, p):
         return {
             "contains": {
-                oid: (oid in self.objects and self.objects[oid].sealed)
+                oid: oid not in self.condemned and self.store.contains(oid)
                 for oid in p["oids"]
             }
         }
 
     async def _obj_release(self, conn, p):
-        entry = self.objects.get(p["oid"])
-        if entry is not None:
-            entry.last_access = time.monotonic()
+        for oid in p.get("oids") or [p["oid"]]:
+            holds = self.obj_holds.get(oid)
+            if holds is not None:
+                n = holds.get(id(conn), 0) - 1
+                if n <= 0:
+                    holds.pop(id(conn), None)
+                else:
+                    holds[id(conn)] = n
+                if not holds:
+                    del self.obj_holds[oid]
+            if self.store.lookup(oid) is not None:
+                self.store.touch(oid)
+                self.obj_last_access[oid] = time.monotonic()
         return {"ok": True}
 
     async def _obj_pin(self, conn, p):
         for oid in p["oids"]:
-            entry = self.objects.get(oid)
-            if entry is not None:
-                entry.pinned = True
+            self.store.pin(oid)
         return {"ok": True}
 
     async def _obj_delete(self, conn, p):
         for oid in p["oids"]:
-            entry = self.objects.get(oid)
-            if entry is not None:
-                self._delete_entry(entry)
+            self._delete_object(oid)
         return {"ok": True}
 
     # -- cross-node transfer (reference: object_manager pull/push) -----------
 
+    def _add_hold(self, conn, oid: str) -> None:
+        holds = self.obj_holds.setdefault(oid, {})
+        holds[id(conn)] = holds.get(id(conn), 0) + 1
+
     async def _pull_object(self, conn, p):
         """Fetch an object from a remote raylet into the local store."""
         oid = p["oid"]
-        entry = self.objects.get(oid)
-        if entry is not None and entry.sealed:
-            return {"name": entry.segment, "size": entry.size}
+        info = self.store.lookup(oid)
+        if info is not None and info[2]:
+            self._add_hold(conn, oid)
+            return self._obj_meta(oid, info)
         remote = await rpc.connect(*p["from_addr"], retry=3)
         try:
-            info = await remote.call("ObjGet", {"oids": [oid], "block": False})
-            meta = info["found"].get(oid)
+            reply = await remote.call("ObjGet", {"oids": [oid], "block": False})
+            meta = reply["found"].get(oid)
             if meta is None:
                 raise rpc.RpcError(f"object {oid[:12]} not on remote node")
             size = meta["size"]
             create = await self._obj_create(conn, {"oid": oid, "size": size, "pin": False})
             if create.get("sealed"):
-                return {"name": create["name"], "size": size}
+                return create
             if create.get("exists"):
-                # Another pull is filling it; wait for the seal.
+                # Another pull is filling it; wait for the seal and verify.
                 await self._obj_get(conn, {"oids": [oid], "block": True, "timeout": 60})
-                return {"name": create["name"], "size": size}
-            seg = shm.create(create["name"], size)
-            try:
-                chunk = config.object_chunk_size
-                offset = 0
-                view = seg.view
-                while offset < size:
-                    data = await remote.call(
-                        "FetchChunk",
-                        {"oid": oid, "offset": offset, "size": min(chunk, size - offset)},
-                        timeout=60,
+                info = self.store.lookup(oid)
+                if info is None or not info[2] or oid in self.condemned:
+                    raise rpc.RpcError(
+                        f"concurrent pull of {oid[:12]} did not complete"
                     )
-                    view[offset : offset + len(data)] = data
-                    offset += len(data)
-            finally:
-                seg.close()
+                self._add_hold(conn, oid)
+                return create
+            offset = create["offset"]
+            view = self.arena.view
+            chunk = config.object_chunk_size
+            done = 0
+            while done < size:
+                data = await remote.call(
+                    "FetchChunk",
+                    {"oid": oid, "offset": done, "size": min(chunk, size - done)},
+                    timeout=60,
+                )
+                view[offset + done : offset + done + len(data)] = data
+                done += len(data)
             await self._obj_seal(conn, {"oid": oid})
-            return {"name": self.objects[oid].segment, "size": size}
+            self._add_hold(conn, oid)
+            return create
         finally:
             await remote.close()
 
     async def _fetch_chunk(self, conn, p):
-        entry = self.objects.get(p["oid"])
-        if entry is None or not entry.sealed:
+        info = self.store.lookup(p["oid"])
+        if info is None or not info[2]:
             raise rpc.RpcError(f"object {p['oid'][:12]} not local")
-        seg = shm.open_ro(entry.segment)
-        try:
-            return bytes(seg.view[p["offset"] : p["offset"] + p["size"]])
-        finally:
-            seg.close()
+        base = info[0] + p["offset"]
+        return bytes(self.arena.view[base : base + p["size"]])
 
     # -- placement group bundles ---------------------------------------------
 
@@ -717,7 +811,7 @@ class Raylet:
             "num_leases": len(self.leases),
             "store_used": self.store_used,
             "store_capacity": self.store_capacity,
-            "num_objects": len(self.objects),
+            "num_objects": self.store.num_objects,
             "pending_leases": len(self.pending_leases),
         }
         # Detail payloads for the state API (reference: raylet
@@ -736,16 +830,21 @@ class Raylet:
                 for w in self.workers.values()
             ]
         if p.get("include_objects"):
-            out["objects"] = [
-                {
-                    "object_id": o.oid,
-                    "size": o.size,
-                    "sealed": o.sealed,
-                    "pinned": o.pinned,
-                    "node_id": self.node_id,
-                }
-                for o in self.objects.values()
-            ]
+            objs = []
+            for oid in list(self.obj_last_access):
+                info = self.store.lookup(oid)
+                if info is None:
+                    continue
+                objs.append(
+                    {
+                        "object_id": oid,
+                        "size": info[1],
+                        "sealed": info[2],
+                        "pinned": info[3],
+                        "node_id": self.node_id,
+                    }
+                )
+            out["objects"] = objs
         return out
 
 

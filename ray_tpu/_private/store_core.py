@@ -1,0 +1,173 @@
+"""Object-store bookkeeping core: native C++ engine with pure-Python fallback.
+
+The native path (ray_tpu._native._store, src/store_core.cc) implements the
+plasma-style arena allocator + object lifecycle + LRU eviction in C++; this
+module provides an API-identical Python implementation for pure-python
+installs and selects between them.
+
+API (both implementations):
+    alloc(oid, size, pin=True) -> offset | -1
+    seal/touch/pin/unpin(oid), free(oid) -> size
+    evict(nbytes, grace_ticks=0) -> [oid]
+    lookup(oid) -> (offset, size, sealed, pinned) | None
+    contains(oid) -> bool (sealed)
+    used / capacity / num_objects, fragmentation() -> (ratio, largest, spans)
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Dict, List, Optional, Tuple
+
+try:
+    from ray_tpu._native._store import StoreCore as NativeStoreCore
+
+    NATIVE = True
+except ImportError:  # pragma: no cover - pure-python installs
+    NativeStoreCore = None
+    NATIVE = False
+
+
+def _round(size: int) -> int:
+    return (max(1, size) + 63) & ~63
+
+
+class PyStoreCore:
+    """Pure-Python mirror of the C++ StoreCore."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self.used = 0
+        self._tick = 0
+        # free spans: offset -> size, sorted offset list (coalescing lookups),
+        # and (size, offset) sorted list (best-fit)
+        self._by_offset: Dict[int, int] = {0: capacity}
+        self._offsets: List[int] = [0]
+        self._by_size: List[Tuple[int, int]] = [(capacity, 0)]
+        # oid -> [offset, size, sealed, pinned, tick]
+        self._objects: Dict[str, list] = {}
+        self._lru: Dict[int, str] = {}
+
+    @property
+    def num_objects(self) -> int:
+        return len(self._objects)
+
+    def _touch(self, oid: str, entry: list) -> None:
+        self._lru.pop(entry[4], None)
+        self._tick += 1
+        entry[4] = self._tick
+        self._lru[self._tick] = oid
+
+    def alloc(self, oid: str, size: int, pin: bool = True) -> int:
+        if oid in self._objects:
+            raise KeyError(f"object {oid} already allocated")
+        rsize = _round(size)
+        i = bisect.bisect_left(self._by_size, (rsize, 0))
+        if i >= len(self._by_size):
+            return -1
+        span_size, span_off = self._by_size.pop(i)
+        del self._by_offset[span_off]
+        self._offsets.pop(bisect.bisect_left(self._offsets, span_off))
+        if span_size > rsize:
+            rest = (span_off + rsize, span_size - rsize)
+            self._by_offset[rest[0]] = rest[1]
+            bisect.insort(self._offsets, rest[0])
+            bisect.insort(self._by_size, (rest[1], rest[0]))
+        entry = [span_off, size, False, bool(pin), 0]
+        self._objects[oid] = entry
+        self._touch(oid, entry)
+        self.used += size
+        return span_off
+
+    def _drop_span(self, off: int, size: int) -> None:
+        del self._by_offset[off]
+        self._offsets.pop(bisect.bisect_left(self._offsets, off))
+        self._by_size.pop(bisect.bisect_left(self._by_size, (size, off)))
+
+    def _free_span(self, offset: int, size: int) -> None:
+        size = _round(size)
+        # Coalesce with successor span, found by exact offset.
+        nxt = self._by_offset.get(offset + size)
+        if nxt is not None:
+            self._drop_span(offset + size, nxt)
+            size += nxt
+        # Coalesce with predecessor, found via the sorted offset index.
+        i = bisect.bisect_left(self._offsets, offset)
+        if i > 0:
+            prev_off = self._offsets[i - 1]
+            prev_size = self._by_offset[prev_off]
+            if prev_off + prev_size == offset:
+                self._drop_span(prev_off, prev_size)
+                offset, size = prev_off, prev_size + size
+        self._by_offset[offset] = size
+        bisect.insort(self._offsets, offset)
+        bisect.insort(self._by_size, (size, offset))
+
+    def seal(self, oid: str) -> None:
+        e = self._objects[oid]
+        e[2] = True
+        self._touch(oid, e)
+
+    def touch(self, oid: str) -> None:
+        e = self._objects.get(oid)
+        if e is not None:
+            self._touch(oid, e)
+
+    def pin(self, oid: str) -> None:
+        e = self._objects.get(oid)
+        if e is not None:
+            e[3] = True
+
+    def unpin(self, oid: str) -> None:
+        e = self._objects.get(oid)
+        if e is not None:
+            e[3] = False
+
+    def free(self, oid: str) -> int:
+        e = self._objects.pop(oid, None)
+        if e is None:
+            return 0
+        self._free_span(e[0], e[1])
+        self._lru.pop(e[4], None)
+        self.used -= e[1]
+        return e[1]
+
+    def evict(self, nbytes: int, grace_ticks: int = 0) -> List[str]:
+        out: List[str] = []
+        freed = 0
+        limit = self._tick - grace_ticks if grace_ticks else None
+        for tick in sorted(self._lru):
+            if freed >= nbytes:
+                break
+            if limit is not None and tick > limit:
+                break
+            oid = self._lru[tick]
+            e = self._objects.get(oid)
+            if e is None or not e[2] or e[3]:
+                continue
+            freed += e[1]
+            self.free(oid)
+            out.append(oid)
+        return out
+
+    def lookup(self, oid: str) -> Optional[Tuple[int, int, bool, bool]]:
+        e = self._objects.get(oid)
+        if e is None:
+            return None
+        return (e[0], e[1], e[2], e[3])
+
+    def contains(self, oid: str) -> bool:
+        e = self._objects.get(oid)
+        return e is not None and e[2]
+
+    def fragmentation(self) -> Tuple[float, int, int]:
+        free_total = self.capacity - self.used
+        largest = self._by_size[-1][0] if self._by_size else 0
+        frag = 0.0 if free_total == 0 else 1.0 - largest / free_total
+        return (frag, largest, len(self._by_offset))
+
+
+def make_store_core(capacity: int):
+    if NativeStoreCore is not None:
+        return NativeStoreCore(capacity)
+    return PyStoreCore(capacity)

@@ -181,16 +181,19 @@ def init(
         return {"address": f"{gcs_addr[0]}:{gcs_addr[1]}", "session": core.session_name}
 
 
+def cluster_state_file() -> str:
+    """State file written by `ray-tpu start` (single source of the path)."""
+    import os
+
+    return os.path.join(os.environ.get("TMPDIR", "/tmp"), "ray_tpu_cluster.json")
+
+
 def _read_cluster_address() -> Optional[str]:
     """Address of a cluster started via `ray-tpu start` on this machine."""
     import json
-    import os
 
-    path = os.path.join(
-        os.environ.get("TMPDIR", "/tmp"), "ray_tpu_cluster.json"
-    )
     try:
-        with open(path) as f:
+        with open(cluster_state_file()) as f:
             return json.load(f)["address"]
     except Exception:
         return None

@@ -123,13 +123,29 @@ class Executor:
     async def handle_push_task(self, conn, p):
         wire = p["spec"]
         try:
+            renv = wire.get("runtime_env") or {}
+            if renv.get("working_dir") or renv.get("py_modules"):
+                # Shared worker process: packages go on sys.path (idempotent)
+                # but the cwd is left alone; env vars are call-scoped below.
+                from ray_tpu.runtime_env.context import apply_runtime_env
+
+                await apply_runtime_env(
+                    self.core,
+                    {k: renv[k] for k in ("working_dir", "py_modules") if k in renv},
+                    chdir=False,
+                )
             fn = await self.get_function(wire["func_id"])
             args, kwargs = await self.load_args(wire)
-            if asyncio.iscoroutinefunction(fn):
-                result = await fn(*args, **kwargs)
-            else:
-                loop = asyncio.get_running_loop()
-                result = await loop.run_in_executor(self.pool, lambda: fn(*args, **kwargs))
+            from ray_tpu.runtime_env.context import scoped_env_vars
+
+            with scoped_env_vars(renv.get("env_vars")):
+                if asyncio.iscoroutinefunction(fn):
+                    result = await fn(*args, **kwargs)
+                else:
+                    loop = asyncio.get_running_loop()
+                    result = await loop.run_in_executor(
+                        self.pool, lambda: fn(*args, **kwargs)
+                    )
             returns = await self.store_returns(wire, result)
             return {"returns": returns}
         except BaseException as e:  # noqa: BLE001 - must serialize any failure
@@ -145,6 +161,12 @@ class Executor:
         if max_c > 1:
             self.pool = concurrent.futures.ThreadPoolExecutor(max_workers=max_c)
         try:
+            if wire.get("runtime_env"):
+                # Actors own their process: permanent application (env vars,
+                # working_dir chdir + sys.path, py_modules).
+                from ray_tpu.runtime_env.context import apply_runtime_env
+
+                await apply_runtime_env(self.core, wire["runtime_env"])
             cls = await self.get_function(wire["func_id"])
             args, kwargs = await self.load_args(wire)
             loop = asyncio.get_running_loop()
@@ -206,6 +228,18 @@ class Executor:
         try:
             if self.actor_instance is None:
                 raise RuntimeError("actor not initialized")
+            if wire["actor_method"] == "__rt_dag_loop__":
+                # Compiled-DAG resident loop (ray_tpu.dag): runs until the
+                # driver writes the STOP sentinel into the input channels.
+                from ray_tpu.dag.exec_loop import dag_exec_loop
+
+                args, kwargs = await self.load_args(wire)
+                loop = asyncio.get_running_loop()
+                result = await loop.run_in_executor(
+                    None, lambda: dag_exec_loop(self.actor_instance, *args)
+                )
+                returns = await self.store_returns(wire, result)
+                return {"returns": returns}
             method = getattr(self.actor_instance, wire["actor_method"])
             args, kwargs = await self.load_args(wire)
             if asyncio.iscoroutinefunction(method):

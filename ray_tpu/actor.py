@@ -42,6 +42,12 @@ class ActorMethod:
             return refs[0]
         return refs
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG construction for compiled graphs (ray_tpu.dag)."""
+        from ray_tpu.dag.nodes import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._name, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"actor method {self._name!r} cannot be called directly; use .remote()"

@@ -1,0 +1,147 @@
+"""The autoscaling control loop.
+
+Analog of autoscaler/v2/autoscaler.py + _private/autoscaler.py
+(StandardAutoscaler) + resource_demand_scheduler.py: demand = pending
+worker leases reported by raylets; supply = alive nodes' resources. Scale
+up when demand goes unmet past the upscale delay (bin-packing demand onto
+the cheapest satisfying node type), scale down nodes idle past the idle
+timeout, clamped to per-type min/max workers.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class AutoscalerConfig:
+    upscale_delay_s: float = 1.0
+    idle_timeout_s: float = 30.0
+    poll_interval_s: float = 1.0
+    max_launches_per_round: int = 4
+
+
+@dataclass
+class _NodeTracker:
+    provider_node_id: str
+    node_type: str
+    launched_at: float = field(default_factory=time.monotonic)
+    idle_since: Optional[float] = None
+
+
+class Autoscaler:
+    """Drive with repeated update() calls (or run() in a thread). Reads
+    cluster state through the connected driver's state APIs."""
+
+    def __init__(self, provider, config: Optional[AutoscalerConfig] = None):
+        self.provider = provider
+        self.config = config or AutoscalerConfig()
+        self._tracked: Dict[str, _NodeTracker] = {}
+        self._demand_since: Optional[float] = None
+
+    # -- state collection ----------------------------------------------------
+
+    def _cluster_state(self) -> Tuple[int, List[dict]]:
+        """-> (total pending leases, per-node stats)."""
+        from ray_tpu._private import worker as worker_mod
+        from ray_tpu.util.state.api import _each_raylet
+
+        stats = _each_raylet({})
+        pending = sum(s.get("pending_leases", 0) for s in stats)
+        return pending, stats
+
+    # -- scaling decisions ---------------------------------------------------
+
+    def update(self) -> Dict[str, int]:
+        """One reconcile round; returns {"launched": n, "terminated": m}."""
+        pending, stats = self._cluster_state()
+        now = time.monotonic()
+        launched = terminated = 0
+
+        # Ensure per-type minimums.
+        counts: Dict[str, int] = {}
+        for t in self._tracked.values():
+            counts[t.node_type] = counts.get(t.node_type, 0) + 1
+        for node_type, spec in self.provider.node_types.items():
+            while counts.get(node_type, 0) < spec.get("min_workers", 0):
+                self._launch(node_type)
+                counts[node_type] = counts.get(node_type, 0) + 1
+                launched += 1
+
+        # Upscale on sustained unmet demand.
+        if pending > 0:
+            if self._demand_since is None:
+                self._demand_since = now
+            elif now - self._demand_since >= self.config.upscale_delay_s:
+                for _ in range(
+                    min(self.config.max_launches_per_round, pending)
+                ):
+                    node_type = self._pick_type()
+                    if node_type is None:
+                        break
+                    self._launch(node_type)
+                    launched += 1
+                self._demand_since = None
+        else:
+            self._demand_since = None
+
+        # Downscale idle tracked nodes.
+        busy_ids = {
+            s["node_id"]
+            for s in stats
+            if s.get("num_workers", 0) - s.get("num_idle", 0) > 0
+            or s.get("pending_leases", 0) > 0
+        }
+        for pid, t in list(self._tracked.items()):
+            raylet_id = getattr(self.provider, "raylet_node_id", lambda _p: None)(pid)
+            is_busy = raylet_id in busy_ids if raylet_id else False
+            if is_busy:
+                t.idle_since = None
+                continue
+            if t.idle_since is None:
+                t.idle_since = now
+                continue
+            spec = self.provider.node_types.get(t.node_type, {})
+            if (
+                now - t.idle_since >= self.config.idle_timeout_s
+                and self._count(t.node_type) > spec.get("min_workers", 0)
+            ):
+                self.provider.terminate_node(pid)
+                del self._tracked[pid]
+                terminated += 1
+        return {"launched": launched, "terminated": terminated}
+
+    def _count(self, node_type: str) -> int:
+        return sum(1 for t in self._tracked.values() if t.node_type == node_type)
+
+    def _pick_type(self) -> Optional[str]:
+        """Smallest type with headroom (reference bin-packs demand shapes;
+        single-resource-type clusters reduce to this)."""
+        best = None
+        for node_type, spec in sorted(
+            self.provider.node_types.items(),
+            key=lambda kv: sum(kv[1].get("resources", {}).values()),
+        ):
+            if self._count(node_type) < spec.get("max_workers", 0):
+                best = node_type
+                break
+        return best
+
+    def _launch(self, node_type: str) -> None:
+        pid = self.provider.create_node(node_type)
+        self._tracked[pid] = _NodeTracker(pid, node_type)
+
+    # -- loop ----------------------------------------------------------------
+
+    def run(self, stop_event=None) -> None:
+        while stop_event is None or not stop_event.is_set():
+            try:
+                self.update()
+            except Exception:
+                logger.exception("autoscaler round failed")
+            time.sleep(self.config.poll_interval_s)

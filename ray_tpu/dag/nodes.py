@@ -1,0 +1,62 @@
+"""DAG node types: lazy graph construction via .bind().
+
+Analog of python/ray/dag/{dag_node.py,class_node.py,input_node.py,
+output_node.py}: `actor.method.bind(upstream)` builds a ClassMethodNode;
+`with InputNode() as inp:` marks the graph entry; MultiOutputNode fans
+several leaves out to the caller.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class DAGNode:
+    def experimental_compile(self, *, max_buf_size: int = 10 * 1024 * 1024):
+        from ray_tpu.dag.compiled import CompiledDAG
+
+        return CompiledDAG(self, max_buf_size=max_buf_size)
+
+    def _upstream(self) -> List["DAGNode"]:
+        return []
+
+
+class InputNode(DAGNode):
+    """Graph entry placeholder (reference: input_node.py)."""
+
+    def __enter__(self) -> "InputNode":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+
+class ClassMethodNode(DAGNode):
+    def __init__(self, actor_handle, method_name: str, args: Tuple, kwargs: Dict):
+        self.actor = actor_handle
+        self.method_name = method_name
+        self.args = args
+        self.kwargs = kwargs
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, InputNode):
+                continue
+        ups = [a for a in list(args) + list(kwargs.values()) if isinstance(a, DAGNode)]
+        self._ups = ups
+
+    def _upstream(self) -> List[DAGNode]:
+        return self._ups
+
+
+class MultiOutputNode(DAGNode):
+    def __init__(self, outputs: List[DAGNode]):
+        self.outputs = list(outputs)
+
+    def _upstream(self) -> List[DAGNode]:
+        return self.outputs
+
+
+def bind(actor_method, *args, **kwargs) -> ClassMethodNode:
+    """actor.method.bind(...) — attached to ActorMethod by ray_tpu.actor."""
+    return ClassMethodNode(
+        actor_method._handle, actor_method._name, args, kwargs
+    )

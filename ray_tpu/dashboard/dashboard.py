@@ -76,6 +76,7 @@ class Dashboard:
         app.router.add_get("/api/placement_groups", self._pgs)
         app.router.add_get("/api/tasks", self._tasks)
         app.router.add_get("/api/tasks/summary", self._task_summary)
+        app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/-/healthz", self._healthz)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
@@ -142,6 +143,28 @@ class Dashboard:
 
         reply = await self._gcs("ListTaskEvents", {"limit": 5000})
         return web.json_response(reply)
+
+    async def _metrics(self, request):
+        """Prometheus text exposition merged across all workers (the
+        reference MetricsAgent role)."""
+        from aiohttp import web
+
+        from ray_tpu.util.metrics import METRICS_NS, render_prometheus
+
+        keys = (await self._gcs("KVKeys", {"ns": METRICS_NS, "prefix": ""})).get(
+            "keys", []
+        )
+        per_worker = {}
+        for key in keys:
+            blob = (await self._gcs("KVGet", {"ns": METRICS_NS, "key": key})).get(
+                "value"
+            )
+            if blob:
+                per_worker[key] = json.loads(blob)
+        return web.Response(
+            text=render_prometheus(per_worker),
+            content_type="text/plain",
+        )
 
     async def _task_summary(self, request):
         from aiohttp import web

@@ -87,6 +87,7 @@ class JobSupervisor:
 
         await self._set_status(JobStatus.RUNNING)
         log_buf = bytearray()
+        last_flush = 0.0
         try:
             self.proc = await asyncio.create_subprocess_shell(
                 self.entrypoint,
@@ -103,7 +104,14 @@ class JobSupervisor:
                 log_buf.extend(line)
                 if len(log_buf) > MAX_LOG_BYTES:
                     del log_buf[: len(log_buf) - MAX_LOG_BYTES]
-                await self._kv_put(JOB_LOGS_NS, self.submission_id, bytes(log_buf))
+                # Throttled flush: pushing the whole buffer per line would be
+                # O(lines x buffer) KV traffic for chatty jobs.
+                now = time.monotonic()
+                if now - last_flush >= 1.0:
+                    last_flush = now
+                    await self._kv_put(
+                        JOB_LOGS_NS, self.submission_id, bytes(log_buf)
+                    )
             code = await self.proc.wait()
             if self._stopped:
                 await self._set_status(JobStatus.STOPPED, "stopped by user")

@@ -71,6 +71,12 @@ class RemoteFunction:
     def remote(self, *args, **kwargs):
         return self._remote(args, kwargs)
 
+    def bind(self, *args, **kwargs):
+        """Lazy task node for workflows (ray_tpu.workflow.run(fn.bind(...)))."""
+        from ray_tpu.workflow.api import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def _remote(self, args, kwargs):
         opts = self._options
         core = worker_mod._core()

@@ -1,0 +1,19 @@
+"""ray_tpu.runtime_env: per-task/actor execution environments.
+
+Analog of python/ray/runtime_env + python/ray/_private/runtime_env plugins:
+  - env_vars: exported into the executing worker
+  - working_dir: local directory zipped, shipped via GCS KV, extracted on
+    the executing node, chdir'd + sys.path'd (reference: working_dir.py)
+  - py_modules: list of module dirs shipped the same way (py_modules.py)
+  - pip / conda: accepted and validated for API parity; installation is a
+    no-op in air-gapped deployments (logged) — the reference shells out to
+    pip/conda from its runtime-env agent.
+
+Preparation (upload) runs in the submitting process; application runs in the
+worker before user code executes — permanently for actors (dedicated
+process), scoped for tasks.
+"""
+
+from ray_tpu.runtime_env.context import RuntimeEnv, apply_runtime_env, prepare
+
+__all__ = ["RuntimeEnv", "apply_runtime_env", "prepare"]

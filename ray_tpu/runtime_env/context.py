@@ -1,0 +1,150 @@
+"""Runtime-env preparation (driver) and application (worker)."""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import io
+import logging
+import os
+import sys
+import zipfile
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+KV_NS = "_runtime_env"
+EXTRACT_ROOT = "/tmp/ray_tpu_runtime_env"
+MAX_PACKAGE_BYTES = 100 * 1024 * 1024
+_EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+
+
+class RuntimeEnv(dict):
+    """Dict subclass for API parity with ray.runtime_env.RuntimeEnv."""
+
+    KNOWN = {"env_vars", "working_dir", "py_modules", "pip", "conda", "config"}
+
+    def __init__(self, **kwargs):
+        unknown = set(kwargs) - self.KNOWN
+        if unknown:
+            raise ValueError(f"unknown runtime_env fields: {unknown}")
+        super().__init__(**{k: v for k, v in kwargs.items() if v is not None})
+
+
+def _zip_dir(path: str) -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for root, dirs, files in os.walk(path):
+            dirs[:] = [d for d in dirs if d not in _EXCLUDE_DIRS]
+            for fname in files:
+                full = os.path.join(root, fname)
+                zf.write(full, os.path.relpath(full, path))
+    data = buf.getvalue()
+    if len(data) > MAX_PACKAGE_BYTES:
+        raise ValueError(
+            f"runtime_env package {path!r} is {len(data)} bytes "
+            f"(limit {MAX_PACKAGE_BYTES})"
+        )
+    return data
+
+
+async def _upload_dir(core, path: str) -> str:
+    """Zip + dedupe-upload a directory; returns the KV key."""
+    path = os.path.abspath(os.path.expanduser(path))
+    if not os.path.isdir(path):
+        raise ValueError(f"runtime_env directory {path!r} does not exist")
+    data = _zip_dir(path)
+    key = f"pkg_{hashlib.sha1(data).hexdigest()[:20]}"
+    if not await core.gcs.kv_exists(key, ns=KV_NS):
+        await core.gcs.kv_put(key, data, ns=KV_NS)
+    return key
+
+
+async def prepare(core, runtime_env: Optional[Dict[str, Any]]) -> Optional[Dict]:
+    """Driver-side: replace local paths with uploaded package keys
+    (reference: runtime-env URIs pinned in the GCS)."""
+    if not runtime_env:
+        return runtime_env
+    env = dict(runtime_env)
+    wd = env.get("working_dir")
+    if wd and not str(wd).startswith("pkg_"):
+        env["working_dir"] = await _upload_dir(core, wd)
+    mods = env.get("py_modules")
+    if mods:
+        uploaded = []
+        for m in mods:
+            uploaded.append(
+                m if str(m).startswith("pkg_") else await _upload_dir(core, m)
+            )
+        env["py_modules"] = uploaded
+    if env.get("pip") or env.get("conda"):
+        logger.warning(
+            "runtime_env pip/conda requested but package installation is "
+            "disabled in this deployment; dependencies must be baked into "
+            "the image"
+        )
+    return env
+
+
+async def _fetch_package(core, key: str) -> str:
+    """Worker-side: download + extract a package once; returns its path."""
+    dest = os.path.join(EXTRACT_ROOT, key)
+    if os.path.isdir(dest):
+        return dest
+    blob = await core.gcs.kv_get(key, ns=KV_NS)
+    if blob is None:
+        raise RuntimeError(f"runtime_env package {key} missing from GCS")
+    tmp = dest + ".tmp"
+    os.makedirs(tmp, exist_ok=True)
+    with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+        zf.extractall(tmp)
+    try:
+        os.rename(tmp, dest)
+    except OSError:  # concurrent extraction won the race
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    return dest
+
+
+async def apply_runtime_env(
+    core, runtime_env: Optional[Dict[str, Any]], *, chdir: bool = True
+) -> None:
+    """Worker-side application. Actors (dedicated process) use chdir=True;
+    tasks in shared workers pass chdir=False (sys.path only)."""
+    if not runtime_env:
+        return
+    for k, v in (runtime_env.get("env_vars") or {}).items():
+        os.environ[k] = str(v)
+    wd = runtime_env.get("working_dir")
+    if wd:
+        path = await _fetch_package(core, wd)
+        if chdir:
+            os.chdir(path)
+        if path not in sys.path:
+            sys.path.insert(0, path)
+    for key in runtime_env.get("py_modules") or []:
+        path = await _fetch_package(core, key)
+        if path not in sys.path:
+            sys.path.insert(0, path)
+
+
+@contextlib.contextmanager
+def scoped_env_vars(env_vars: Optional[Dict[str, str]]):
+    """Task-scoped env vars: set for the call, restored after (tasks share
+    their worker process, unlike actors)."""
+    if not env_vars:
+        yield
+        return
+    saved: Dict[str, Optional[str]] = {}
+    for k, v in env_vars.items():
+        saved[k] = os.environ.get(k)
+        os.environ[k] = str(v)
+    try:
+        yield
+    finally:
+        for k, old in saved.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old

@@ -16,7 +16,9 @@ import sys
 import time
 from typing import Optional
 
-STATE_FILE = os.path.join(os.environ.get("TMPDIR", "/tmp"), "ray_tpu_cluster.json")
+from ray_tpu._private.worker import cluster_state_file
+
+STATE_FILE = cluster_state_file()
 
 
 def _write_state(address: str, dashboard: Optional[str] = None) -> None:

@@ -1,0 +1,236 @@
+"""Workflow execution + storage.
+
+Steps are the reference's task nodes (python/ray/workflow/task_executor.py);
+storage layout mirrors workflow_storage.py: one directory per workflow id,
+one pickle per finished step, a JSON status/metadata file.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu._private.common import RayTpuError
+
+DEFAULT_STORAGE = os.path.expanduser("~/ray_tpu_workflows")
+
+
+class WorkflowStatus:
+    RUNNING = "RUNNING"
+    SUCCESSFUL = "SUCCESSFUL"
+    FAILED = "FAILED"
+    RESUMABLE = "RESUMABLE"
+
+
+class FunctionNode:
+    """Lazy task node: fn.bind(*args) (reference: dag/function_node.py).
+    Args may contain other FunctionNodes."""
+
+    def __init__(self, remote_fn, args: Tuple, kwargs: Dict):
+        self.remote_fn = remote_fn
+        self.args = args
+        self.kwargs = kwargs
+
+    def _upstream(self) -> List["FunctionNode"]:
+        return [
+            a
+            for a in list(self.args) + list(self.kwargs.values())
+            if isinstance(a, FunctionNode)
+        ]
+
+
+class _Storage:
+    def __init__(self, workflow_id: str, base: Optional[str] = None):
+        self.dir = os.path.join(base or DEFAULT_STORAGE, workflow_id)
+        os.makedirs(os.path.join(self.dir, "steps"), exist_ok=True)
+
+    def step_path(self, step_id: str) -> str:
+        return os.path.join(self.dir, "steps", f"{step_id}.pkl")
+
+    def has_step(self, step_id: str) -> bool:
+        return os.path.exists(self.step_path(step_id))
+
+    def load_step(self, step_id: str) -> Any:
+        with open(self.step_path(step_id), "rb") as f:
+            return pickle.load(f)
+
+    def save_step(self, step_id: str, value: Any) -> None:
+        tmp = self.step_path(step_id) + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(value, f)
+        os.replace(tmp, self.step_path(step_id))  # atomic checkpoint commit
+
+    def write_meta(self, **kw) -> None:
+        meta = self.read_meta()
+        meta.update(kw)
+        tmp = os.path.join(self.dir, "meta.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, os.path.join(self.dir, "meta.json"))
+
+    def read_meta(self) -> Dict[str, Any]:
+        try:
+            with open(os.path.join(self.dir, "meta.json")) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return {}
+
+
+def _graph_blob(node: FunctionNode) -> bytes:
+    import cloudpickle
+
+    return cloudpickle.dumps(node)
+
+
+def _step_ids(node: FunctionNode) -> Dict[int, str]:
+    """Deterministic step ids: topo index + function name + arg structure
+    hash, so resume matches steps across processes."""
+    order: List[FunctionNode] = []
+    seen = set()
+
+    def visit(n: FunctionNode):
+        if id(n) in seen:
+            return
+        seen.add(id(n))
+        for up in n._upstream():
+            visit(up)
+        order.append(n)
+
+    visit(node)
+    ids: Dict[int, str] = {}
+    for i, n in enumerate(order):
+        name = getattr(n.remote_fn, "__name__", "step")
+        sig = hashlib.sha1(
+            f"{i}:{name}:{len(n.args)}:{sorted(n.kwargs)}".encode()
+        ).hexdigest()[:8]
+        ids[id(n)] = f"{i:04d}_{name}_{sig}"
+    return ids
+
+
+def _execute(node: FunctionNode, storage: _Storage) -> Any:
+    ids = _step_ids(node)
+    cache: Dict[int, Any] = {}
+    order: List[FunctionNode] = []
+    seen = set()
+
+    def visit(n: FunctionNode):
+        if id(n) in seen:
+            return
+        seen.add(id(n))
+        for up in n._upstream():
+            visit(up)
+        order.append(n)
+
+    visit(node)
+
+    for n in order:
+        step_id = ids[id(n)]
+        if storage.has_step(step_id):
+            cache[id(n)] = storage.load_step(step_id)
+            continue
+
+        def resolve(v):
+            return cache[id(v)] if isinstance(v, FunctionNode) else v
+
+        args = [resolve(a) for a in n.args]
+        kwargs = {k: resolve(v) for k, v in n.kwargs.items()}
+        result = ray_tpu.get(n.remote_fn.remote(*args, **kwargs))
+        storage.save_step(step_id, result)
+        cache[id(n)] = result
+    return cache[id(node)]
+
+
+def run(
+    node: FunctionNode,
+    *,
+    workflow_id: Optional[str] = None,
+    storage: Optional[str] = None,
+) -> Any:
+    """Execute the workflow to completion, checkpointing each step."""
+    if not isinstance(node, FunctionNode):
+        raise RayTpuError("workflow.run expects fn.bind(...) (a FunctionNode)")
+    workflow_id = workflow_id or f"wf-{uuid.uuid4().hex[:10]}"
+    st = _Storage(workflow_id, storage)
+    st.write_meta(
+        workflow_id=workflow_id,
+        status=WorkflowStatus.RUNNING,
+        start_time=time.time(),
+    )
+    with open(os.path.join(st.dir, "graph.pkl"), "wb") as f:
+        f.write(_graph_blob(node))
+    try:
+        result = _execute(node, st)
+    except Exception as e:
+        st.write_meta(status=WorkflowStatus.FAILED, error=repr(e))
+        raise
+    st.save_step("__output__", result)
+    st.write_meta(status=WorkflowStatus.SUCCESSFUL, end_time=time.time())
+    return result
+
+
+def run_async(node: FunctionNode, **kw):
+    """Run in a background task; returns an ObjectRef-like future via a
+    driver thread (workflows are driver-side orchestrations)."""
+    import concurrent.futures
+
+    pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+    return pool.submit(run, node, **kw)
+
+
+def resume(workflow_id: str, *, storage: Optional[str] = None) -> Any:
+    """Re-run a workflow, skipping checkpointed steps."""
+    st = _Storage(workflow_id, storage)
+    if st.has_step("__output__"):
+        return st.load_step("__output__")
+    graph_path = os.path.join(st.dir, "graph.pkl")
+    if not os.path.exists(graph_path):
+        raise RayTpuError(f"no stored graph for workflow {workflow_id!r}")
+    with open(graph_path, "rb") as f:
+        node = pickle.load(f)
+    st.write_meta(status=WorkflowStatus.RUNNING)
+    try:
+        result = _execute(node, st)
+    except Exception as e:
+        st.write_meta(status=WorkflowStatus.FAILED, error=repr(e))
+        raise
+    st.save_step("__output__", result)
+    st.write_meta(status=WorkflowStatus.SUCCESSFUL, end_time=time.time())
+    return result
+
+
+def get_output(workflow_id: str, *, storage: Optional[str] = None) -> Any:
+    st = _Storage(workflow_id, storage)
+    if not st.has_step("__output__"):
+        raise RayTpuError(f"workflow {workflow_id!r} has no output yet")
+    return st.load_step("__output__")
+
+
+def get_metadata(workflow_id: str, *, storage: Optional[str] = None) -> Dict:
+    return _Storage(workflow_id, storage).read_meta()
+
+
+def list_all(storage: Optional[str] = None) -> List[Tuple[str, str]]:
+    base = storage or DEFAULT_STORAGE
+    out = []
+    if not os.path.isdir(base):
+        return out
+    for wid in sorted(os.listdir(base)):
+        if not os.path.isdir(os.path.join(base, wid)):
+            continue
+        meta = _Storage(wid, base).read_meta()
+        if meta:
+            out.append((wid, meta.get("status", "UNKNOWN")))
+    return out
+
+
+def delete(workflow_id: str, *, storage: Optional[str] = None) -> None:
+    import shutil
+
+    st = _Storage(workflow_id, storage)
+    shutil.rmtree(st.dir, ignore_errors=True)

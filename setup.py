@@ -18,5 +18,10 @@ setup(
             extra_compile_args=["-O2", "-std=c++17"],
             libraries=["rt"],
         ),
+        Extension(
+            "ray_tpu._native._store",
+            sources=["src/store_core.cc"],
+            extra_compile_args=["-O2", "-std=c++17"],
+        ),
     ],
 )

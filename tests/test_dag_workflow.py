@@ -1,0 +1,265 @@
+"""Compiled DAG + workflow + runtime_env + metrics + autoscaler tests
+(analog of python/ray/dag/tests, workflow/tests, runtime_env tests)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+
+# -- compiled DAG -------------------------------------------------------------
+
+
+def test_channel_roundtrip():
+    from ray_tpu.dag.channel import Channel
+
+    ch = Channel("rtdag_test_ch1", 1 << 20, create=True)
+    try:
+        reader = Channel("rtdag_test_ch1", 1 << 20)
+        ch.write({"x": 1})
+        assert reader.read(timeout=5) == {"x": 1}
+        ch.write([1, 2, 3])
+        assert reader.read(timeout=5) == [1, 2, 3]
+        with pytest.raises(TimeoutError):
+            reader.read(timeout=0.2)  # nothing new
+    finally:
+        ch.close(unlink=True)
+
+
+def test_compiled_dag_linear(ray_start_regular):
+    import ray_tpu
+    from ray_tpu import dag
+
+    @ray_tpu.remote
+    class Adder:
+        def add(self, x):
+            return x + 1
+
+    @ray_tpu.remote
+    class Doubler:
+        def double(self, x):
+            return x * 2
+
+    a, b = Adder.remote(), Doubler.remote()
+    with dag.InputNode() as inp:
+        graph = b.double.bind(a.add.bind(inp))
+    compiled = graph.experimental_compile()
+    try:
+        for i in range(10):
+            assert compiled.execute(i).get() == (i + 1) * 2
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_dag_multi_output(ray_start_regular):
+    import ray_tpu
+    from ray_tpu import dag
+
+    @ray_tpu.remote
+    class Plus:
+        def __init__(self, k):
+            self.k = k
+
+        def go(self, x):
+            return x + self.k
+
+    p1, p2 = Plus.remote(1), Plus.remote(2)
+    with dag.InputNode() as inp:
+        graph = dag.MultiOutputNode([p1.go.bind(inp), p2.go.bind(inp)])
+    compiled = graph.experimental_compile()
+    try:
+        assert compiled.execute(10).get() == [11, 12]
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_dag_error_propagation(ray_start_regular):
+    import ray_tpu
+    from ray_tpu import dag
+
+    @ray_tpu.remote
+    class Boom:
+        def go(self, x):
+            if x == 3:
+                raise ValueError("boom at 3")
+            return x
+
+    b = Boom.remote()
+    with dag.InputNode() as inp:
+        graph = b.go.bind(inp)
+    compiled = graph.experimental_compile()
+    try:
+        assert compiled.execute(1).get() == 1
+        with pytest.raises(ValueError, match="boom at 3"):
+            compiled.execute(3).get()
+        # The loop survives the error.
+        assert compiled.execute(4).get() == 4
+    finally:
+        compiled.teardown()
+
+
+# -- workflow -----------------------------------------------------------------
+
+
+def test_workflow_run_and_resume(ray_start_regular, tmp_path):
+    import ray_tpu
+    from ray_tpu import workflow
+
+    calls_file = tmp_path / "calls.txt"
+
+    @ray_tpu.remote
+    def add(a, b):
+        with open(calls_file, "a") as f:
+            f.write("x")
+        return a + b
+
+    node = add.bind(add.bind(1, 2), add.bind(3, 4))
+    out = workflow.run(node, workflow_id="wf-test", storage=str(tmp_path))
+    assert out == 10
+    assert len(calls_file.read_text()) == 3
+    assert workflow.get_output("wf-test", storage=str(tmp_path)) == 10
+    meta = workflow.get_metadata("wf-test", storage=str(tmp_path))
+    assert meta["status"] == "SUCCESSFUL"
+
+    # Resume: everything checkpointed -> no re-execution.
+    assert workflow.resume("wf-test", storage=str(tmp_path)) == 10
+    assert len(calls_file.read_text()) == 3
+    assert ("wf-test", "SUCCESSFUL") in workflow.list_all(str(tmp_path))
+
+
+def test_workflow_failure_and_partial_resume(ray_start_regular, tmp_path):
+    import ray_tpu
+    from ray_tpu import workflow
+
+    flag = tmp_path / "fail_once"
+    flag.write_text("1")
+    count_file = tmp_path / "count"
+    count_file.write_text("")
+
+    @ray_tpu.remote
+    def step_a():
+        with open(count_file, "a") as f:
+            f.write("a")
+        return 5
+
+    @ray_tpu.remote
+    def step_b(x, fail_path):
+        if os.path.exists(fail_path):
+            os.unlink(fail_path)
+            raise RuntimeError("transient")
+        return x * 2
+
+    node = step_b.bind(step_a.bind(), str(flag))
+    with pytest.raises(Exception):
+        workflow.run(node, workflow_id="wf-fail", storage=str(tmp_path))
+    assert workflow.get_metadata("wf-fail", storage=str(tmp_path))["status"] == "FAILED"
+    # Resume skips step_a (checkpointed) and completes.
+    assert workflow.resume("wf-fail", storage=str(tmp_path)) == 10
+    assert count_file.read_text() == "a"
+
+
+# -- runtime_env --------------------------------------------------------------
+
+
+def test_runtime_env_env_vars(ray_start_regular):
+    import ray_tpu
+
+    @ray_tpu.remote
+    class EnvReader:
+        def read(self, key):
+            return os.environ.get(key)
+
+    a = EnvReader.options(
+        runtime_env={"env_vars": {"MY_RT_ENV": "hello42"}}
+    ).remote()
+    assert ray_tpu.get(a.read.remote("MY_RT_ENV")) == "hello42"
+
+
+def test_runtime_env_working_dir(ray_start_regular, tmp_path):
+    import ray_tpu
+
+    pkg = tmp_path / "mypkg"
+    pkg.mkdir()
+    (pkg / "my_rt_module.py").write_text("VALUE = 1234\n")
+
+    @ray_tpu.remote
+    class Importer:
+        def go(self):
+            import my_rt_module
+
+            return my_rt_module.VALUE
+
+    a = Importer.options(runtime_env={"working_dir": str(pkg)}).remote()
+    assert ray_tpu.get(a.go.remote()) == 1234
+
+
+# -- metrics ------------------------------------------------------------------
+
+
+def test_metrics_render():
+    from ray_tpu.util import metrics as M
+
+    c = M.Counter("test_requests", "reqs", tag_keys=("route",))
+    c.inc(2, tags={"route": "/a"})
+    c.inc(1, tags={"route": "/b"})
+    g = M.Gauge("test_temp", "", tag_keys=())
+    g.set(42.5)
+    h = M.Histogram("test_lat", "", boundaries=[1, 10], tag_keys=())
+    h.observe(0.5)
+    h.observe(5)
+    h.observe(50)
+
+    snap = M._collect_local()
+    text = M.render_prometheus({"w1": snap})
+    assert 'test_requests{route="/a"} 2.0' in text
+    assert "test_temp 42.5" in text
+    assert 'test_lat_bucket{le="1"} 1' in text
+    assert 'test_lat_bucket{le="+Inf"} 3' in text
+    assert "test_lat_count 3" in text
+
+
+# -- autoscaler ---------------------------------------------------------------
+
+
+def test_autoscaler_up_down(shutdown_only):
+    import ray_tpu
+    from ray_tpu.autoscaler import Autoscaler, AutoscalerConfig, FakeNodeProvider
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    ray_tpu.init(address=cluster.address)
+
+    provider = FakeNodeProvider(
+        cluster,
+        node_types={
+            "worker": {"resources": {"CPU": 2.0}, "min_workers": 0, "max_workers": 2}
+        },
+    )
+    scaler = Autoscaler(
+        provider,
+        AutoscalerConfig(upscale_delay_s=0.2, idle_timeout_s=2.0),
+    )
+
+    # Saturate the 1-CPU head so leases queue up.
+    @ray_tpu.remote
+    def slow():
+        time.sleep(4)
+        return 1
+
+    refs = [slow.options(num_cpus=1).remote() for _ in range(4)]
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and not provider.non_terminated_nodes():
+        scaler.update()
+        time.sleep(0.3)
+    assert provider.non_terminated_nodes(), "autoscaler never launched a node"
+
+    assert ray_tpu.get(refs, timeout=60) == [1] * 4
+
+    # After the work drains, idle nodes are reclaimed.
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and provider.non_terminated_nodes():
+        scaler.update()
+        time.sleep(0.5)
+    assert not provider.non_terminated_nodes(), "idle node was not terminated"
+    cluster.shutdown()

@@ -1,0 +1,133 @@
+"""Object-store core tests: allocator, lifecycle, LRU eviction — run against
+BOTH the native C++ engine and the pure-Python fallback (analog of the
+reference's plasma allocator/eviction C++ unit tests)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu._private import store_core as sc
+
+ENGINES = [pytest.param(sc.PyStoreCore, id="python")]
+if sc.NATIVE:
+    ENGINES.append(pytest.param(sc.NativeStoreCore, id="native"))
+
+
+@pytest.fixture(params=ENGINES)
+def Store(request):
+    return request.param
+
+
+def test_alloc_free_roundtrip(Store):
+    s = Store(1 << 20)
+    off_a = s.alloc("a", 1000, True)
+    assert off_a >= 0
+    off_b = s.alloc("b", 2000, False)
+    assert off_b >= off_a + 1000
+    assert s.used == 3000
+    assert s.num_objects == 2
+    s.seal("a")
+    assert s.lookup("a") == (off_a, 1000, True, True)
+    assert s.contains("a") and not s.contains("b")  # b unsealed
+    assert s.free("a") == 1000
+    assert s.lookup("a") is None
+    assert s.used == 2000
+
+
+def test_duplicate_alloc_raises(Store):
+    s = Store(1 << 16)
+    s.alloc("x", 10, True)
+    with pytest.raises(KeyError):
+        s.alloc("x", 10, True)
+
+
+def test_capacity_exhaustion_and_reuse(Store):
+    s = Store(64 * 10)  # ten 64B-rounded slots
+    offs = [s.alloc(f"o{i}", 64, False) for i in range(10)]
+    assert all(o >= 0 for o in offs)
+    assert s.alloc("overflow", 64, False) == -1
+    s.free("o5")
+    off = s.alloc("overflow", 64, False)
+    assert off == offs[5]  # best-fit reuses the freed slot
+
+
+def test_coalescing(Store):
+    s = Store(64 * 8)
+    for i in range(8):
+        s.alloc(f"o{i}", 64, False)
+    # Free three adjacent slots -> one coalesced span fits a 3-slot object.
+    for i in (2, 3, 4):
+        s.free(f"o{i}")
+    off = s.alloc("big", 64 * 3, False)
+    assert off >= 0
+    frag, largest, spans = s.fragmentation()
+    assert largest == 0 and s.used == s.capacity
+
+
+def test_lru_eviction_order_and_pinning(Store):
+    s = Store(1 << 20)
+    for i in range(5):
+        s.alloc(f"o{i}", 100, False)
+        s.seal(f"o{i}")
+    s.pin("o0")
+    s.touch("o1")  # o1 becomes most-recent
+    victims = s.evict(250, 0)
+    # o0 pinned, o1 freshly touched -> oldest unpinned are o2, o3, o4...
+    assert victims[:2] == ["o2", "o3"]
+    assert "o0" not in victims and "o1" not in victims
+
+
+def test_evict_skips_unsealed(Store):
+    s = Store(1 << 16)
+    s.alloc("unsealed", 100, False)
+    s.alloc("sealed", 100, False)
+    s.seal("sealed")
+    victims = s.evict(10_000, 0)
+    assert victims == ["sealed"]
+    assert s.lookup("unsealed") is not None
+
+
+def test_arena_store_end_to_end(ray_start_regular):
+    """Large objects round-trip through the node arena zero-copy."""
+    import ray_tpu
+    from ray_tpu._private import worker as worker_mod
+
+    arr = np.random.rand(512, 512)  # 2 MB -> plasma path
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(arr, out)
+
+    # The raylet's store core accounts for it.
+    raylet = worker_mod.global_worker.node.raylet
+    assert raylet.store.num_objects >= 1
+    assert raylet.store_used >= arr.nbytes
+
+    # Worker-side round trip too (task returns large value).
+    @ray_tpu.remote
+    def make():
+        return np.ones((256, 256))
+
+    np.testing.assert_array_equal(ray_tpu.get(make.remote()), np.ones((256, 256)))
+
+
+def test_delete_quarantine(ray_start_regular):
+    """Deleted objects vanish from the directory immediately but their arena
+    bytes are not recycled within the grace window (zero-copy view safety)."""
+    import ray_tpu
+    from ray_tpu._private import worker as worker_mod
+
+    arr = np.arange(300_000, dtype=np.float64)  # 2.4MB -> arena
+    ref = ray_tpu.put(arr)
+    view = ray_tpu.get(ref)  # zero-copy view into the arena
+    raylet = worker_mod.global_worker.node.raylet
+
+    # Drop the ref -> owner ref count hits zero -> delete path.
+    del ref
+    import gc, time as _t
+
+    gc.collect()
+    deadline = _t.monotonic() + 10
+    while _t.monotonic() < deadline and not raylet.condemned:
+        _t.sleep(0.2)
+    assert raylet.condemned, "deleted object was not quarantined"
+    # The view must still read the original bytes (span not recycled).
+    np.testing.assert_array_equal(view[:100], np.arange(100, dtype=np.float64))

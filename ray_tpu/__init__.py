@@ -29,9 +29,10 @@ from ray_tpu._private.common import (
     TaskError,
     WorkerCrashedError,
 )
-from ray_tpu._private.core_worker import ObjectRef
+from ray_tpu._private.core_worker import ObjectRef, ObjectRefGenerator
 from ray_tpu._private.worker import (
     available_resources,
+    cancel,
     cluster_resources,
     get,
     get_actor,
@@ -84,7 +85,9 @@ __all__ = [
     "get",
     "put",
     "wait",
+    "cancel",
     "kill",
+    "ObjectRefGenerator",
     "get_actor",
     "nodes",
     "cluster_resources",

@@ -41,6 +41,26 @@ from ray_tpu._private.object_store import IN_PLASMA, INLINE, MemoryStore, Plasma
 logger = logging.getLogger(__name__)
 
 
+class ObjectRefGenerator:
+    """Value of a num_returns="dynamic" task: an iterable of ObjectRefs
+    (reference: ray._raylet.ObjectRefGenerator / DynamicObjectRefGenerator)."""
+
+    def __init__(self, refs):
+        self._refs = list(refs)
+
+    def __iter__(self):
+        return iter(self._refs)
+
+    def __len__(self):
+        return len(self._refs)
+
+    def __getitem__(self, i):
+        return self._refs[i]
+
+    def __reduce__(self):
+        return (ObjectRefGenerator, (self._refs,))
+
+
 class ObjectRef:
     """A reference to a (possibly not-yet-computed) object.
 
@@ -434,6 +454,9 @@ class CoreWorker:
         self._task_events: List[dict] = []
         self._free_queue: List[str] = []
         self._release_queue: List[str] = []
+        # task_id -> {"cancelled": bool, "conn": live worker conn or None}
+        self._inflight_tasks: Dict[str, dict] = {}
+        self._oid_to_task: Dict[str, str] = {}
         self.closed = False
         self._bg_tasks: List[asyncio.Task] = []
 
@@ -741,11 +764,13 @@ class CoreWorker:
             from ray_tpu.runtime_env.context import prepare
 
             runtime_env = await prepare(self, runtime_env)
+        if num_returns == "dynamic":
+            num_returns = -1
         func_id = await self.export_function(pickled_fn)
         task_id = TaskID.from_random().hex()
         return_ids = [
             deterministic_object_id(TaskID.from_hex(task_id), i).hex()
-            for i in range(num_returns)
+            for i in range(1 if num_returns == -1 else num_returns)
         ]
         serialized, ref_pos, kw_refs, deps = self._prepare_args(args, kwargs)
         args_blob, args_object = None, None
@@ -791,8 +816,32 @@ class CoreWorker:
         for dep_oid, _ in deps:
             self.reference_table.add_submitted(dep_oid)
         self.record_task_event(task_id, fn_name, "PENDING")
+        self._inflight_tasks[task_id] = {"cancelled": False, "conn": None}
+        for oid in return_ids:
+            self._oid_to_task[oid] = task_id
         asyncio.create_task(self._run_task(wire, spec))
         return refs
+
+    async def cancel(self, ref: "ObjectRef", force: bool = False) -> bool:
+        """Best-effort task cancellation (reference: ray.cancel ->
+        CoreWorker::CancelTask). Queued tasks are dropped; running tasks get
+        a TaskCancelledError raised in their executing thread/coroutine."""
+        task_id = self._oid_to_task.get(ref.hex())
+        if task_id is None:
+            return False
+        entry = self._inflight_tasks.get(task_id)
+        if entry is None:
+            return False  # already finished
+        entry["cancelled"] = True
+        conn = entry.get("conn")
+        if conn is not None and not conn.closed:
+            try:
+                await conn.call(
+                    "CancelTask", {"task_id": task_id, "force": force}, timeout=10
+                )
+            except rpc.RpcError:
+                pass
+        return True
 
     async def _run_task(self, wire: dict, spec: TaskSpec) -> None:
         try:
@@ -800,6 +849,13 @@ class CoreWorker:
             attempts = spec.max_retries + 1
             last_err: Optional[Exception] = None
             for attempt in range(attempts):
+                entry = self._inflight_tasks.get(spec.task_id)
+                if entry is not None and entry["cancelled"]:
+                    self._store_task_error(
+                        spec, TaskCancelledError(f"task {spec.name} was cancelled")
+                    )
+                    self.record_task_event(spec.task_id, spec.name, "CANCELLED")
+                    return
                 try:
                     reply = await self._lease_and_push(wire, spec)
                     self._store_task_results(spec, reply)
@@ -807,6 +863,13 @@ class CoreWorker:
                     return
                 except (rpc.ConnectionLost, WorkerCrashedError) as e:
                     last_err = e
+                    entry = self._inflight_tasks.get(spec.task_id)
+                    if entry is not None and entry["cancelled"]:
+                        self._store_task_error(
+                            spec,
+                            TaskCancelledError(f"task {spec.name} was cancelled"),
+                        )
+                        return
                     self.record_task_event(
                         spec.task_id, spec.name, "RETRY", attempt=attempt
                     )
@@ -824,6 +887,9 @@ class CoreWorker:
             logger.exception("task %s submission failed", spec.name)
             self._store_task_error(spec, e)
         finally:
+            self._inflight_tasks.pop(spec.task_id, None)
+            for oid in spec.return_ids:
+                self._oid_to_task.pop(oid, None)
             for dep_oid, _ in spec.dependencies:
                 self.reference_table.remove_submitted(dep_oid, self)
 
@@ -840,6 +906,15 @@ class CoreWorker:
             spec.resources, spec.pg_id, spec.bundle_index
         )
         dirty = False
+        entry = self._inflight_tasks.get(spec.task_id)
+        if entry is not None:
+            if entry["cancelled"]:
+                # Cancellation landed while we were queued for a lease.
+                await self.lease_pool.release(
+                    lease, spec.resources, spec.pg_id, spec.bundle_index
+                )
+                raise TaskCancelledError(f"task {spec.name} was cancelled")
+            entry["conn"] = lease.conn
         try:
             self.record_task_event(spec.task_id, spec.name, "RUNNING")
             return await lease.conn.call("PushTask", {"spec": wire}, timeout=None)
@@ -847,6 +922,8 @@ class CoreWorker:
             dirty = True
             raise
         finally:
+            if entry is not None:
+                entry["conn"] = None
             await self.lease_pool.release(
                 lease, spec.resources, spec.pg_id, spec.bundle_index, dirty=dirty
             )
@@ -857,6 +934,26 @@ class CoreWorker:
             for oid in spec.return_ids:
                 self.memory_store.put_inline(oid, payload)
             self.record_task_event(spec.task_id, spec.name, "FAILED")
+            return
+        if reply.get("dynamic") is not None:
+            # Streaming-generator task: store each yielded item under its
+            # deterministic id and make the main return value an
+            # ObjectRefGenerator over them.
+            refs = []
+            for i, ret in enumerate(reply["dynamic"]):
+                oid = deterministic_object_id(
+                    TaskID.from_hex(spec.task_id), i + 1
+                ).hex()
+                if "inline" in ret:
+                    self.memory_store.put_inline(oid, ret["inline"])
+                else:
+                    self.memory_store.put_plasma_marker(oid, tuple(ret["plasma"]))
+                self.reference_table.mark_owned(oid)
+                refs.append(ObjectRef(oid, self.addr, self))
+            gen = ObjectRefGenerator(refs)
+            self.memory_store.put_inline(
+                spec.return_ids[0], serialization.serialize(gen).to_bytes()
+            )
             return
         returns = reply["returns"]
         for oid, ret in zip(spec.return_ids, returns):
@@ -1010,6 +1107,9 @@ class CoreWorker:
         except Exception as e:
             self._store_task_error(spec, e)
         finally:
+            self._inflight_tasks.pop(spec.task_id, None)
+            for oid in spec.return_ids:
+                self._oid_to_task.pop(oid, None)
             for dep_oid, _ in spec.dependencies:
                 self.reference_table.remove_submitted(dep_oid, self)
 

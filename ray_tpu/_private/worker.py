@@ -308,6 +308,14 @@ def wait(
     )
 
 
+def cancel(ref: ObjectRef, *, force: bool = False) -> None:
+    """Best-effort cancellation of the task producing `ref` (reference:
+    ray.cancel at worker.py:2932)."""
+    if not isinstance(ref, ObjectRef):
+        raise TypeError("ray_tpu.cancel expects an ObjectRef")
+    global_worker.run_async(_core().cancel(ref, force))
+
+
 def kill(actor, *, no_restart: bool = True) -> None:
     from ray_tpu.actor import ActorHandle
 

@@ -11,9 +11,12 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import ctypes
+import inspect
 import logging
 import os
 import sys
+import threading
 import traceback
 from typing import Any, Dict, Optional
 
@@ -40,9 +43,12 @@ class Executor:
         self.expected_seq: Dict[str, int] = {}
         self.pending_seq: Dict[str, Dict[int, asyncio.Future]] = {}
         self.exec_lock = asyncio.Lock()
+        # task_id -> {"thread_id": int|None, "async_task": Task|None}
+        self.running_tasks: Dict[str, dict] = {}
         core.server.register("PushTask", self.handle_push_task)
         core.server.register("PushActorTask", self.handle_push_actor_task)
         core.server.register("CreateActor", self.handle_create_actor)
+        core.server.register("CancelTask", self.handle_cancel_task)
         core.server.register("Exit", self.handle_exit)
 
     # -- function table ------------------------------------------------------
@@ -122,6 +128,8 @@ class Executor:
 
     async def handle_push_task(self, conn, p):
         wire = p["spec"]
+        task_id = wire.get("task_id", "")
+        track = self.running_tasks[task_id] = {"thread_id": None, "async_task": None}
         try:
             renv = wire.get("runtime_env") or {}
             if renv.get("working_dir") or renv.get("py_modules"):
@@ -140,17 +148,72 @@ class Executor:
 
             with scoped_env_vars(renv.get("env_vars")):
                 if asyncio.iscoroutinefunction(fn):
-                    result = await fn(*args, **kwargs)
+                    coro_task = asyncio.ensure_future(fn(*args, **kwargs))
+                    track["async_task"] = coro_task
+                    result = await coro_task
                 else:
                     loop = asyncio.get_running_loop()
-                    result = await loop.run_in_executor(
-                        self.pool, lambda: fn(*args, **kwargs)
+
+                    def run_tracked():
+                        track["thread_id"] = threading.get_ident()
+                        try:
+                            return fn(*args, **kwargs)
+                        finally:
+                            track["thread_id"] = None
+
+                    result = await loop.run_in_executor(self.pool, run_tracked)
+            if wire["num_returns"] == -1 and inspect.isgenerator(result):
+                # Streaming generator: store every yielded item as its own
+                # return (reference: ReportGeneratorItemReturns path).
+                dynamic = []
+                for item in result:
+                    dynamic.extend(
+                        await self.store_returns(
+                            {"num_returns": 1, "return_ids": [self._dyn_oid(wire, len(dynamic))]},
+                            item,
+                        )
                     )
+                return {"dynamic": dynamic}
             returns = await self.store_returns(wire, result)
             return {"returns": returns}
+        except asyncio.CancelledError:
+            from ray_tpu._private.common import TaskCancelledError
+
+            return {"error": self._error_payload(TaskCancelledError("task cancelled"))}
         except BaseException as e:  # noqa: BLE001 - must serialize any failure
             logger.info("task %s raised: %r", wire.get("name"), e)
             return {"error": self._error_payload(e)}
+        finally:
+            self.running_tasks.pop(task_id, None)
+
+    @staticmethod
+    def _dyn_oid(wire: dict, index: int) -> str:
+        from ray_tpu._private.ids import TaskID, deterministic_object_id
+
+        return deterministic_object_id(
+            TaskID.from_hex(wire["task_id"]), index + 1
+        ).hex()
+
+    async def handle_cancel_task(self, conn, p):
+        """Cancel a running task: async tasks via asyncio cancellation, sync
+        tasks via an exception raised in the executing thread (the reference
+        raises KeyboardInterrupt in the worker; same best-effort semantics —
+        blocking C calls are not interrupted until they return)."""
+        from ray_tpu._private.common import TaskCancelledError
+
+        track = self.running_tasks.get(p["task_id"])
+        if track is None:
+            return {"found": False}
+        if track.get("async_task") is not None:
+            track["async_task"].cancel()
+            return {"found": True}
+        tid = track.get("thread_id")
+        if tid is not None:
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(tid), ctypes.py_object(TaskCancelledError)
+            )
+            return {"found": True}
+        return {"found": False}
 
     # -- actors --------------------------------------------------------------
 

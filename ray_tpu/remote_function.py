@@ -98,7 +98,7 @@ class RemoteFunction:
             )
         )
         num_returns = opts.get("num_returns", 1)
-        if num_returns == 1:
+        if num_returns == 1 or num_returns == "dynamic":
             return refs[0]
         return refs
 

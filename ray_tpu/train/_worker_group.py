@@ -64,6 +64,12 @@ class TrainWorker:
         self.session = s
         _session._set_session(s)
 
+    def apply(self, fn_blob: bytes, *args):
+        """Run an arbitrary setup function on this worker (backend hooks —
+        reference: worker_group.py execute of setup callables)."""
+        fn = cloudpickle.loads(fn_blob)
+        return fn(*args)
+
     def init_collective(
         self, world_size: int, rank: int, backend: str, group_name: str
     ) -> None:

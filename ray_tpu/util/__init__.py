@@ -1,0 +1,30 @@
+"""ray_tpu.util: user-facing utilities (reference: python/ray/util)."""
+
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.placement_group import (
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+
+
+def list_named_actors(namespace=None, all_namespaces: bool = False):
+    """Live named actors (reference: ray.util.list_named_actors)."""
+    from ray_tpu._private import worker as worker_mod
+
+    payload = {} if all_namespaces else {
+        "namespace": namespace or worker_mod.global_worker.namespace
+    }
+    reply = worker_mod.global_worker.run_async(
+        worker_mod._core().gcs.call("ListNamedActors", payload)
+    )
+    return reply["names"]
+
+
+__all__ = [
+    "ActorPool",
+    "list_named_actors",
+    "placement_group",
+    "placement_group_table",
+    "remove_placement_group",
+]

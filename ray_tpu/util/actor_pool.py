@@ -1,0 +1,86 @@
+"""ActorPool: load-balance tasks over a fixed set of actors.
+
+Analog of python/ray/util/actor_pool.py: submit/get_next[_unordered],
+map/map_unordered over a pool of actor handles.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: Iterable[Any]):
+        self._idle: List[Any] = list(actors)
+        if not self._idle:
+            raise ValueError("ActorPool needs at least one actor")
+        self._future_to_actor = {}
+        self._index_to_future = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+
+    def has_free(self) -> bool:
+        return len(self._idle) > 0
+
+    def has_next(self) -> bool:
+        return len(self._future_to_actor) > 0
+
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
+        """fn: (actor, value) -> ObjectRef, e.g. lambda a, v: a.work.remote(v)."""
+        if not self._idle:
+            raise RuntimeError("no idle actors; call get_next() first")
+        actor = self._idle.pop()
+        ref = fn(actor, value)
+        self._future_to_actor[ref] = actor
+        self._index_to_future[self._next_task_index] = ref
+        self._next_task_index += 1
+
+    def get_next(self, timeout: float = None) -> Any:
+        """Next result in submission order."""
+        if self._next_return_index >= self._next_task_index:
+            raise StopIteration("no pending results")
+        ref = self._index_to_future.pop(self._next_return_index)
+        self._next_return_index += 1
+        actor = self._future_to_actor.pop(ref)
+        try:
+            return ray_tpu.get(ref, timeout=timeout)
+        finally:
+            self._idle.append(actor)
+
+    def get_next_unordered(self, timeout: float = None) -> Any:
+        """Next completed result, any order."""
+        if not self._future_to_actor:
+            raise StopIteration("no pending results")
+        ready, _ = ray_tpu.wait(
+            list(self._future_to_actor), num_returns=1, timeout=timeout
+        )
+        if not ready:
+            raise TimeoutError("no result within timeout")
+        ref = ready[0]
+        actor = self._future_to_actor.pop(ref)
+        for idx, fut in list(self._index_to_future.items()):
+            if fut == ref:
+                del self._index_to_future[idx]
+                break
+        try:
+            return ray_tpu.get(ref)
+        finally:
+            self._idle.append(actor)
+
+    def map(self, fn: Callable, values: Iterable[Any]):
+        for v in values:
+            if not self.has_free():
+                yield self.get_next()
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable[Any]):
+        for v in values:
+            if not self.has_free():
+                yield self.get_next_unordered()
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()

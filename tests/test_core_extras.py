@@ -1,0 +1,162 @@
+"""Core API extras: cancel, dynamic generators, ActorPool, Queue,
+TorchTrainer (analog of python/ray/tests/test_cancel.py, test_generators.py,
+test_actor_pool.py, test_queue.py; train/tests/test_torch_trainer.py)."""
+
+import time
+
+import numpy as np
+import pytest
+
+
+def test_cancel_running_task(ray_start_regular):
+    import ray_tpu
+
+    @ray_tpu.remote
+    def spin(seconds):
+        # Pure-Python loop: interruptible by PyThreadState_SetAsyncExc.
+        deadline = time.monotonic() + seconds
+        x = 0
+        while time.monotonic() < deadline:
+            x += 1
+        return x
+
+    ref = spin.remote(60)
+    time.sleep(2)  # let it start
+    ray_tpu.cancel(ref)
+    with pytest.raises(ray_tpu.TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+
+
+def test_cancel_queued_task(ray_start_regular):
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=4)
+    def hog():
+        time.sleep(8)
+        return "hog"
+
+    @ray_tpu.remote(num_cpus=4)
+    def queued():
+        return "ran"
+
+    h = hog.remote()
+    time.sleep(0.5)
+    q = queued.remote()  # cannot start: hog holds all CPUs
+    ray_tpu.cancel(q)
+    with pytest.raises(ray_tpu.TaskCancelledError):
+        ray_tpu.get(q, timeout=30)
+    assert ray_tpu.get(h, timeout=30) == "hog"
+
+
+def test_dynamic_generators(ray_start_regular):
+    import ray_tpu
+
+    @ray_tpu.remote(num_returns="dynamic")
+    def gen(n):
+        for i in range(n):
+            yield i * i
+
+    ref = gen.remote(5)
+    dyn = ray_tpu.get(ref)
+    assert isinstance(dyn, ray_tpu.ObjectRefGenerator)
+    assert len(dyn) == 5
+    assert [ray_tpu.get(r) for r in dyn] == [0, 1, 4, 9, 16]
+
+
+def test_dynamic_generator_large_items(ray_start_regular):
+    import ray_tpu
+
+    @ray_tpu.remote(num_returns="dynamic")
+    def gen():
+        for i in range(3):
+            yield np.full((256, 256), i)  # 0.5MB each -> plasma path
+
+    refs = list(ray_tpu.get(gen.remote()))
+    for i, r in enumerate(refs):
+        np.testing.assert_array_equal(ray_tpu.get(r), np.full((256, 256), i))
+
+
+def test_actor_pool(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.util import ActorPool
+
+    @ray_tpu.remote
+    class Worker:
+        def double(self, x):
+            return x * 2
+
+    pool = ActorPool([Worker.remote() for _ in range(2)])
+    results = list(pool.map(lambda a, v: a.double.remote(v), range(8)))
+    assert results == [0, 2, 4, 6, 8, 10, 12, 14]
+    unordered = sorted(
+        pool.map_unordered(lambda a, v: a.double.remote(v), range(8))
+    )
+    assert unordered == [0, 2, 4, 6, 8, 10, 12, 14]
+
+
+def test_queue(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.util.queue import Empty, Full, Queue
+
+    q = Queue(maxsize=2)
+    q.put("a")
+    q.put("b")
+    with pytest.raises(Full):
+        q.put("c", block=False)
+    assert q.qsize() == 2 and q.full()
+    assert q.get() == "a"
+    assert q.get() == "b"
+    with pytest.raises(Empty):
+        q.get(block=False)
+
+    # Cross-process: a task puts, driver gets.
+    @ray_tpu.remote
+    def producer(queue):
+        for i in range(3):
+            queue.put(i)
+        return True
+
+    assert ray_tpu.get(producer.remote(q))
+    assert [q.get(timeout=10) for _ in range(3)] == [0, 1, 2]
+    q.shutdown()
+
+
+def test_torch_trainer_ddp(ray_start_regular):
+    from ray_tpu.air import ScalingConfig
+    from ray_tpu.train.torch import TorchTrainer
+
+    def train_fn(config):
+        import torch
+        import torch.distributed as dist
+        from torch import nn
+
+        import ray_tpu.train as train
+        from ray_tpu.train.torch import prepare_model
+
+        assert dist.is_initialized() and dist.get_world_size() == 2
+        rank = dist.get_rank()
+
+        model = prepare_model(nn.Linear(4, 1))
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        torch.manual_seed(0)
+        X = torch.randn(64, 4)
+        y = X.sum(dim=1, keepdim=True)
+        for _ in range(config["epochs"]):
+            opt.zero_grad()
+            loss = ((model(X) - y) ** 2).mean()
+            loss.backward()  # DDP allreduces grads here
+            opt.step()
+        # Gradient sync means identical weights on every rank.
+        w = model.module.weight.detach().clone()
+        gathered = [torch.zeros_like(w) for _ in range(2)]
+        dist.all_gather(gathered, w)
+        assert torch.allclose(gathered[0], gathered[1])
+        train.report({"loss": float(loss), "rank": rank})
+
+    trainer = TorchTrainer(
+        train_fn,
+        train_loop_config={"epochs": 20},
+        scaling_config=ScalingConfig(num_workers=2),
+    )
+    result = trainer.fit()
+    assert result.metrics["loss"] < 1.0

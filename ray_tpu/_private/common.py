@@ -36,6 +36,10 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     # Task defaults.
     "default_max_task_retries": 3,
     "actor_default_max_restarts": 0,
+    # Lineage reconstruction: how many times a lost task-return object may be
+    # recomputed by re-running its producing task (reference:
+    # object_recovery_manager.h + task_manager.cc lineage bookkeeping).
+    "max_lineage_reconstruction": 3,
     # Object transfer chunk size between nodes.
     "object_chunk_size": 8 * 1024 * 1024,
     # Arena eviction: unpinned objects accessed within this window are never

@@ -249,7 +249,7 @@ class LeasePool:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self.waiters.setdefault(key, []).append(fut)
         pool.inflight += 1
-        asyncio.create_task(self._request_lease(key, resources, pg_id, bundle_index))
+        rpc.spawn(self._request_lease(key, resources, pg_id, bundle_index))
         return await fut
 
     async def _request_lease(self, key, resources, pg_id, bundle_index) -> None:
@@ -315,7 +315,7 @@ class LeasePool:
         if len(pool.idle) < self.MAX_IDLE:
             pool.idle.append(lease)
         else:
-            asyncio.create_task(self._return_worker(lease, dirty=False))
+            rpc.spawn(self._return_worker(lease, dirty=False))
 
     async def release(self, lease: Lease, resources, pg_id=None, bundle_index=None, dirty=False):
         key = self.shape_key(resources, pg_id, bundle_index)
@@ -457,15 +457,23 @@ class CoreWorker:
         # task_id -> {"cancelled": bool, "conn": live worker conn or None}
         self._inflight_tasks: Dict[str, dict] = {}
         self._oid_to_task: Dict[str, str] = {}
+        # Lineage: oid -> {"wire": producing TaskSpec wire, "attempts": int}.
+        # Lost plasma-resident task returns are recomputed by re-running the
+        # producing task (reference: object_recovery_manager.h:41 +
+        # task_manager.cc; deterministic return ids from ids.py make the
+        # recomputed object land under the same id).
+        self.lineage: Dict[str, dict] = {}
+        self._recovering: Dict[str, asyncio.Future] = {}
         self.closed = False
         self._bg_tasks: List[asyncio.Task] = []
 
         server.register("GetObject", self._handle_get_object)
         server.register("WaitObject", self._handle_wait_object)
+        server.register("RecoverObject", self._handle_recover_object)
         server.register("Ping", self._handle_ping)
 
     def start_background(self) -> None:
-        self._bg_tasks.append(asyncio.create_task(self._flush_loop()))
+        self._bg_tasks.append(rpc.spawn(self._flush_loop()))
 
     async def _flush_loop(self) -> None:
         while not self.closed:
@@ -492,7 +500,7 @@ class CoreWorker:
                 if entry.plasma_addr == self.raylet_addr:
                     to_delete_local.append(oid)
                 else:
-                    asyncio.create_task(self._delete_remote(oid, entry.plasma_addr))
+                    rpc.spawn(self._delete_remote(oid, entry.plasma_addr))
         if to_delete_local:
             try:
                 await self.plasma.delete(to_delete_local)
@@ -531,6 +539,7 @@ class CoreWorker:
 
     def schedule_free(self, oid: str) -> None:
         self._free_queue.append(oid)
+        self.lineage.pop(oid, None)
 
     def schedule_release(self, oid: str) -> None:
         self._release_queue.append(oid)
@@ -603,7 +612,25 @@ class CoreWorker:
         if entry is not None:
             if entry.kind == INLINE:
                 return entry.payload
-            return await self._fetch_plasma(oid, entry.plasma_addr, deadline)
+            recoveries = 0
+            while True:
+                try:
+                    return await self._fetch_plasma(oid, entry.plasma_addr, deadline)
+                except (ObjectLostError, rpc.RpcError):
+                    # Primary copy gone (node death, eviction). If we own it
+                    # and have lineage, recompute; else propagate.
+                    if not owned or recoveries >= config.max_lineage_reconstruction:
+                        raise
+                    recoveries += 1
+                    await self.recover_object(oid)
+                    entry = self.memory_store.get(oid)
+                    if entry is None:
+                        raise ObjectLostError(
+                            f"object {oid[:12]} lost and reconstruction "
+                            "produced no value"
+                        )
+                    if entry.kind == INLINE:
+                        return entry.payload
         # Borrowed ref: try local plasma first (common when the primary copy
         # is on our node), else ask the owner.
         found, _ = await self.plasma.get([oid], block=False)
@@ -625,20 +652,54 @@ class CoreWorker:
         if tuple(ref.owner_addr) == self.addr:
             # We are the owner but have no entry: freed or never created.
             raise ObjectLostError(f"object {ref.hex()[:12]} no longer exists on owner")
-        conn = await self.connect_to(ref.owner_addr)
-        reply = await conn.call(
-            "GetObject",
-            {"oid": ref.hex(), "timeout": self._remaining(deadline)},
-            timeout=None,
+        try:
+            conn = await self.connect_to(ref.owner_addr)
+            reply = await conn.call(
+                "GetObject",
+                {"oid": ref.hex(), "timeout": self._remaining(deadline)},
+                timeout=None,
+            )
+        except rpc.ConnectionLost as e:
+            # The owner process is gone; with it goes the object's directory
+            # entry and any lineage (reference: OwnerDiedError).
+            raise ObjectLostError(
+                f"owner of {ref.hex()[:12]} at {tuple(ref.owner_addr)} is "
+                f"unreachable ({e}); object cannot be recovered"
+            ) from e
+        for _ in range(config.max_lineage_reconstruction + 1):
+            status = reply.get("status")
+            if status == "inline":
+                return reply["payload"]
+            if status == "plasma":
+                try:
+                    return await self._fetch_plasma(
+                        ref.hex(), tuple(reply["addr"]), deadline
+                    )
+                except (ObjectLostError, rpc.RpcError):
+                    # Primary copy unreachable; ask the owner to recover it
+                    # (lineage re-execution on the owner side) and retry with
+                    # the fresh location.
+                    try:
+                        reply = await conn.call(
+                            "RecoverObject",
+                            {"oid": ref.hex(), "timeout": self._remaining(deadline)},
+                            timeout=None,
+                        )
+                    except rpc.ConnectionLost as e:
+                        raise ObjectLostError(
+                            f"owner of {ref.hex()[:12]} died during recovery "
+                            f"({e}); object cannot be recovered"
+                        ) from e
+                    continue
+            if status == "timeout":
+                raise GetTimeoutError(f"owner timed out resolving {ref.hex()[:12]}")
+            raise ObjectLostError(
+                f"owner reports {ref.hex()[:12]}: {status}"
+                + (f" ({reply['error']})" if reply.get("error") else "")
+            )
+        raise ObjectLostError(
+            f"object {ref.hex()[:12]} unrecoverable after repeated owner recovery"
         )
-        status = reply.get("status")
-        if status == "inline":
-            return reply["payload"]
-        if status == "plasma":
-            return await self._fetch_plasma(ref.hex(), tuple(reply["addr"]), deadline)
-        if status == "timeout":
-            raise GetTimeoutError(f"owner timed out resolving {ref.hex()[:12]}")
-        raise ObjectLostError(f"owner reports {ref.hex()[:12]}: {status}")
 
     # -- owner-side object server -------------------------------------------
 
@@ -654,6 +715,107 @@ class CoreWorker:
     async def _handle_wait_object(self, conn, p):
         entry = await self.memory_store.wait_for(p["oid"], p.get("timeout"))
         return {"ready": entry is not None}
+
+    async def _handle_recover_object(self, conn, p):
+        """Borrower reports our object's primary copy lost; reconstruct via
+        lineage and reply with the fresh location."""
+        oid = p["oid"]
+        try:
+            await self.recover_object(oid)
+        except ObjectLostError as e:
+            return {"status": "lost", "error": str(e)}
+        entry = await self.memory_store.wait_for(oid, p.get("timeout") or 300)
+        if entry is None:
+            return {"status": "timeout"}
+        if entry.kind == INLINE:
+            return {"status": "inline", "payload": entry.payload}
+        return {"status": "plasma", "addr": list(entry.plasma_addr)}
+
+    # ------------------------------------------------- lineage reconstruction
+
+    def _register_lineage(self, spec: TaskSpec, reply: dict) -> None:
+        """Remember the producing spec for every plasma-resident return so a
+        lost copy can be recomputed (inline returns live in this process and
+        die with the owner, at which point all refs die too)."""
+        plasma_oids = []
+        for oid, ret in zip(spec.return_ids, reply.get("returns") or []):
+            if "plasma" in ret:
+                plasma_oids.append(oid)
+        if reply.get("dynamic") is not None:
+            for i, ret in enumerate(reply["dynamic"]):
+                if "plasma" in ret:
+                    plasma_oids.append(
+                        deterministic_object_id(
+                            TaskID.from_hex(spec.task_id), i + 1
+                        ).hex()
+                    )
+        if not plasma_oids:
+            return
+        wire = spec.to_wire()
+        for oid in plasma_oids:
+            prev = self.lineage.get(oid)
+            self.lineage[oid] = {
+                "wire": wire,
+                # A reconstruction-driven re-run must not refill the attempt
+                # budget, or a flaky node makes the cap unreachable.
+                "attempts": (
+                    prev["attempts"]
+                    if prev is not None
+                    else config.max_lineage_reconstruction
+                ),
+            }
+
+    async def recover_object(self, oid: str) -> None:
+        """Re-execute the producing task of a lost object (owner side).
+
+        Deduplicates concurrent recoveries per producing task (one re-execution
+        regenerates every return of that task); recursive losses resolve
+        naturally because the re-executed task's worker pulls its args through
+        this same get path (recursing borrower->owner).
+        Reference: src/ray/core_worker/object_recovery_manager.h:41.
+        """
+        entry = self.lineage.get(oid)
+        if entry is None:
+            raise ObjectLostError(
+                f"object {oid[:12]} lost and has no lineage "
+                "(ray.put objects and actor-task returns are not reconstructable)"
+            )
+        task_id = entry["wire"]["task_id"]
+        fut = self._recovering.get(task_id)
+        if fut is not None:
+            await fut
+            return
+        if entry["attempts"] <= 0:
+            raise ObjectLostError(
+                f"object {oid[:12]} lost; lineage reconstruction attempts exhausted"
+            )
+        entry["attempts"] -= 1
+        fut = asyncio.get_running_loop().create_future()
+        self._recovering[task_id] = fut
+        spec = TaskSpec.from_wire(dict(entry["wire"]))
+        logger.info(
+            "reconstructing object %s by re-running task %r",
+            oid[:12],
+            spec.name,
+        )
+        self.record_task_event(spec.task_id, spec.name, "RECONSTRUCTING")
+        # Re-install the submission bookkeeping that _run_task's finally
+        # clause tears down.
+        self._inflight_tasks[spec.task_id] = {"cancelled": False, "conn": None}
+        for rid in spec.return_ids:
+            self._oid_to_task[rid] = spec.task_id
+        for dep_oid, _ in spec.dependencies:
+            self.reference_table.add_submitted(dep_oid)
+        try:
+            await self._run_task(spec.to_wire(), spec)
+            fut.set_result(None)
+        except BaseException as e:
+            fut.set_exception(e)
+            # Consume it if nobody else awaits the future.
+            fut.exception()
+            raise
+        finally:
+            self._recovering.pop(task_id, None)
 
     async def _handle_ping(self, conn, p):
         return {"pong": True, "worker_id": self.worker_id}
@@ -672,7 +834,7 @@ class CoreWorker:
             except asyncio.CancelledError:
                 pass
 
-        tasks = [asyncio.create_task(probe(i, r)) for i, r in enumerate(refs)]
+        tasks = [rpc.spawn(probe(i, r)) for i, r in enumerate(refs)]
         deadline = time.monotonic() + timeout if timeout is not None else None
         try:
             while len(ready_flags) < num_returns:
@@ -819,7 +981,7 @@ class CoreWorker:
         self._inflight_tasks[task_id] = {"cancelled": False, "conn": None}
         for oid in return_ids:
             self._oid_to_task[oid] = task_id
-        asyncio.create_task(self._run_task(wire, spec))
+        rpc.spawn(self._run_task(wire, spec))
         return refs
 
     async def cancel(self, ref: "ObjectRef", force: bool = False) -> bool:
@@ -859,6 +1021,8 @@ class CoreWorker:
                 try:
                     reply = await self._lease_and_push(wire, spec)
                     self._store_task_results(spec, reply)
+                    if reply.get("error") is None and spec.actor_id is None:
+                        self._register_lineage(spec, reply)
                     self.record_task_event(spec.task_id, spec.name, "FINISHED")
                     return
                 except (rpc.ConnectionLost, WorkerCrashedError) as e:
@@ -1095,7 +1259,7 @@ class CoreWorker:
             refs.append(ObjectRef(oid, self.addr, self))
         for dep_oid, _ in deps:
             self.reference_table.add_submitted(dep_oid)
-        asyncio.create_task(self._run_actor_task(spec))
+        rpc.spawn(self._run_actor_task(spec))
         return refs
 
     async def _run_actor_task(self, spec: TaskSpec) -> None:

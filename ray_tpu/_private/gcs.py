@@ -118,7 +118,7 @@ class GcsServer:
         self._register_handlers()
 
     def _spawn(self, coro) -> asyncio.Task:
-        task = asyncio.create_task(coro)
+        task = rpc.spawn(coro)
         self._bg_tasks.append(task)
         self._bg_tasks = [t for t in self._bg_tasks if not t.done()]
         return task
@@ -128,7 +128,7 @@ class GcsServer:
     async def start(self) -> Tuple[str, int]:
         addr = await self.server.start()
         self.server.on_disconnect(self._on_disconnect)
-        self._scheduler_task = asyncio.create_task(self._actor_scheduler_loop())
+        self._scheduler_task = rpc.spawn(self._actor_scheduler_loop())
         logger.info("gcs listening on %s:%s", *addr)
         return addr
 
@@ -200,7 +200,7 @@ class GcsServer:
         if node_id and node_id in self.nodes:
             try:
                 asyncio.get_running_loop()
-                asyncio.create_task(self._handle_node_death(node_id))
+                rpc.spawn(self._handle_node_death(node_id))
             except RuntimeError:
                 pass  # loop already stopped (interpreter shutdown)
         for subs in self.subscribers.values():

@@ -170,7 +170,7 @@ class PlasmaClient:
         import asyncio
 
         try:
-            task = asyncio.ensure_future(self.release_many([oid]))
+            task = rpc.spawn(self.release_many([oid]))
         except RuntimeError:  # no running loop (sync teardown path)
             return
         # Retrieve any exception so a closed connection doesn't log noise.

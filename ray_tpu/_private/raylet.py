@@ -177,8 +177,8 @@ class Raylet:
                 "labels": self.labels,
             },
         )
-        self._tasks.append(asyncio.create_task(self._resource_report_loop()))
-        self._tasks.append(asyncio.create_task(self._condemned_sweep_loop()))
+        self._tasks.append(rpc.spawn(self._resource_report_loop()))
+        self._tasks.append(rpc.spawn(self._condemned_sweep_loop()))
         logger.info(
             "raylet %s on %s:%s resources=%s",
             self.node_id[:8],
@@ -291,7 +291,7 @@ class Raylet:
         )
         handle = WorkerHandle(worker_id, proc)
         self.workers[worker_id] = handle
-        asyncio.create_task(self._reap_worker(handle))
+        rpc.spawn(self._reap_worker(handle))
         return handle
 
     async def _reap_worker(self, handle: WorkerHandle) -> None:
@@ -310,7 +310,7 @@ class Raylet:
         if not handle.registered.done():
             handle.registered.set_exception(rpc.RpcError(f"worker died: {cause}"))
         if handle.actor_id:
-            asyncio.create_task(
+            rpc.spawn(
                 self._report_worker_death(handle.worker_id, [handle.actor_id], cause)
             )
 
@@ -412,7 +412,7 @@ class Raylet:
                 self.pending_leases.pop(0)
                 self.available = self.available - req.demand
                 self._mark_dirty()
-                asyncio.create_task(self._grant(req))
+                rpc.spawn(self._grant(req))
                 granted_any = True
 
     async def _grant(self, req: LeaseRequest) -> None:

@@ -25,6 +25,21 @@ import msgpack
 
 logger = logging.getLogger(__name__)
 
+# The event loop holds only weak references to tasks: a fire-and-forget
+# asyncio.create_task() whose result is dropped can be garbage-collected
+# mid-flight (observed as lease requests silently vanishing under GC
+# pressure). Every background task in the runtime goes through spawn(),
+# which parks a strong reference until the task completes.
+_BG_TASKS: set = set()
+
+
+def spawn(coro) -> asyncio.Task:
+    task = asyncio.get_running_loop().create_task(coro)
+    _BG_TASKS.add(task)
+    task.add_done_callback(_BG_TASKS.discard)
+    return task
+
+
 _KIND_REQ = 0
 _KIND_REP = 1
 _KIND_ERR = 2
@@ -114,9 +129,9 @@ class Connection:
                 msg = await _read_frame(self._reader)
                 msgid, kind, method, payload = msg
                 if kind == _KIND_REQ:
-                    asyncio.create_task(self._dispatch(msgid, method, payload))
+                    spawn(self._dispatch(msgid, method, payload))
                 elif kind == _KIND_PUSH:
-                    asyncio.create_task(self._dispatch(None, method, payload))
+                    spawn(self._dispatch(None, method, payload))
                 elif kind in (_KIND_REP, _KIND_ERR):
                     fut = self._pending.get(msgid)
                     if fut is not None and not fut.done():
@@ -137,19 +152,24 @@ class Connection:
             if handler is None:
                 raise RpcError(f"no handler for method {method!r}")
             result = await handler(self, payload)
-            if msgid is not None:
-                await self._send([msgid, _KIND_REP, method, result])
-        except ConnectionLost:
-            pass
         except Exception as e:
+            # Any handler failure — including ConnectionLost from a dial the
+            # handler made to a third party — must produce an error reply, or
+            # the caller waits out its full timeout.
             if msgid is not None:
                 err = f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
                 try:
                     await self._send([msgid, _KIND_ERR, method, err])
                 except ConnectionLost:
-                    pass
+                    pass  # our own link died; caller learns via teardown
             else:
                 logger.exception("push handler %s failed", method)
+            return
+        if msgid is not None:
+            try:
+                await self._send([msgid, _KIND_REP, method, result])
+            except ConnectionLost:
+                pass
 
     def _teardown(self) -> None:
         if self._closed:

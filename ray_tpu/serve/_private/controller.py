@@ -10,6 +10,8 @@ and proxies via a long-poll host. The data plane never touches the controller.
 from __future__ import annotations
 
 import asyncio
+
+from ray_tpu._private.rpc import spawn as _spawn
 import logging
 import time
 import traceback
@@ -208,7 +210,7 @@ class ServeController:
                     for rec in existing.replicas.values():
                         rec.max_ongoing = new_cfg.max_ongoing_requests
                     if new_cfg.user_config != old_cfg.user_config:
-                        asyncio.ensure_future(
+                        _spawn(
                             self._reconfigure_replicas(existing, new_cfg.user_config)
                         )
                     self._broadcast_replicas(key)
@@ -526,7 +528,7 @@ class ServeController:
             return
         now = time.monotonic()
         # Sample metrics (fire-and-forget gather; cheap at control-loop rate).
-        asyncio.ensure_future(self._sample_metrics(state, now, ac))
+        _spawn(self._sample_metrics(state, now, ac))
         window = [(t, v) for (t, v) in state.metrics_window if now - t <= ac.look_back_period_s]
         state.metrics_window = window
         if not window:

@@ -9,6 +9,8 @@ sets, route tables) propagate without polling on the data path.
 from __future__ import annotations
 
 import asyncio
+
+from ray_tpu._private.rpc import spawn as _spawn
 from typing import Any, Callable, Dict, Optional, Tuple
 
 LISTEN_TIMEOUT_S = 30.0
@@ -30,7 +32,7 @@ class LongPollHost:
             async with self._changed:
                 self._changed.notify_all()
 
-        asyncio.ensure_future(_wake())
+        _spawn(_wake())
 
     async def listen_for_change(
         self, keys_to_snapshot_ids: Dict[str, int]

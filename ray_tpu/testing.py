@@ -30,8 +30,12 @@ def force_cpu_mesh(num_devices: int = 8) -> None:
     """
     flags = os.environ.get("XLA_FLAGS", "")
     want = f"--xla_force_host_platform_device_count={num_devices}"
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (flags + " " + want).strip()
+    kept = [
+        f
+        for f in flags.split()
+        if not f.startswith("--xla_force_host_platform_device_count")
+    ]
+    os.environ["XLA_FLAGS"] = " ".join(kept + [want])
     import jax
 
     jax.config.update("jax_platforms", "cpu")

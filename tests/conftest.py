@@ -13,7 +13,50 @@ os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 ).strip()
 
+import signal
+import threading
+
 import pytest
+
+
+def pytest_addoption(parser):
+    parser.addini(
+        "timeout",
+        "per-test timeout in seconds, enforced by the built-in SIGALRM "
+        "watchdog below (pytest-timeout is not available in this image)",
+        default="180",
+    )
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "timeout(seconds): override the per-test watchdog timeout"
+    )
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    """Watchdog so one wedged test cannot hang the whole suite."""
+    timeout = float(item.config.getini("timeout"))
+    marker = item.get_closest_marker("timeout")
+    if marker and marker.args:
+        timeout = float(marker.args[0])
+    if timeout <= 0 or threading.current_thread() is not threading.main_thread():
+        yield
+        return
+
+    def _on_alarm(signum, frame):
+        raise TimeoutError(
+            f"test exceeded watchdog timeout of {timeout:.0f}s"
+        )
+
+    old = signal.signal(signal.SIGALRM, _on_alarm)
+    signal.setitimer(signal.ITIMER_REAL, timeout)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, old)
 
 
 @pytest.fixture

@@ -116,8 +116,11 @@ def test_queue(ray_start_regular):
             queue.put(i)
         return True
 
-    assert ray_tpu.get(producer.remote(q))
+    # Drain while the producer runs: the third put blocks until the driver
+    # frees a slot, so waiting on the task before draining would deadlock.
+    ref = producer.remote(q)
     assert [q.get(timeout=10) for _ in range(3)] == [0, 1, 2]
+    assert ray_tpu.get(ref)
     q.shutdown()
 
 

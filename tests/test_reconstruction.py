@@ -1,0 +1,126 @@
+"""Lineage reconstruction: lost task-return objects are recomputed by
+re-running the producing task (reference analog:
+python/ray/tests/test_reconstruction.py; owner-side recovery per
+src/ray/core_worker/object_recovery_manager.h)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+# Above max_direct_call_object_size so returns land in plasma on the
+# producing node (inline returns live in the owner and cannot be lost).
+SIZE = (600, 600)  # ~2.9 MB float64
+
+
+@pytest.fixture
+def cluster_with_victim():
+    cluster = Cluster(head_node_args={"num_cpus": 1, "num_tpus": 0})
+    cluster.add_node(num_cpus=2, resources={"victim": 2})
+    cluster.connect()
+    yield cluster
+    cluster.shutdown()
+
+
+def _victim_node_id():
+    for n in ray_tpu.nodes():
+        if n["state"] == "ALIVE" and "victim" in {
+            k.split(":")[0] for k in n["total"]
+        }:
+            return n["node_id"]
+    raise AssertionError("victim node not found")
+
+
+def test_reconstruct_lost_object(cluster_with_victim):
+    """Kill the node holding a task's plasma return; get() still succeeds."""
+    cluster = cluster_with_victim
+
+    @ray_tpu.remote(num_cpus=1, resources={"victim": 1}, max_retries=3)
+    def produce():
+        return np.ones(SIZE)
+
+    ref = produce.remote()
+    # Materialize once so the object exists on the victim node.
+    assert float(ray_tpu.get(ref, timeout=60).sum()) == 360000.0
+
+    cluster.remove_node(cluster.raylets[_victim_node_id()])
+    # Replacement node so the re-executed task has somewhere to run.
+    cluster.add_node(num_cpus=2, resources={"victim": 2})
+    time.sleep(0.5)
+
+    value = ray_tpu.get(ref, timeout=120)
+    assert float(value.sum()) == 360000.0
+
+
+def test_reconstruct_chain(cluster_with_victim):
+    """Loss of an intermediate object recovers recursively through its deps."""
+    cluster = cluster_with_victim
+
+    @ray_tpu.remote(num_cpus=1, resources={"victim": 1}, max_retries=3)
+    def base():
+        return np.ones(SIZE)
+
+    @ray_tpu.remote(num_cpus=1, resources={"victim": 1}, max_retries=3)
+    def double(x):
+        return x * 2
+
+    b = base.remote()
+    d = double.remote(b)
+    assert float(ray_tpu.get(d, timeout=60).sum()) == 720000.0
+
+    cluster.remove_node(cluster.raylets[_victim_node_id()])
+    cluster.add_node(num_cpus=2, resources={"victim": 2})
+    time.sleep(0.5)
+
+    # Both b's and d's primary copies died; getting d must re-run base then
+    # double (the worker resolving double's arg triggers owner-side recovery
+    # of b).
+    value = ray_tpu.get(d, timeout=120)
+    assert float(value.sum()) == 720000.0
+
+
+def test_borrower_triggers_owner_recovery(cluster_with_victim):
+    """A consumer task on another node hits the lost copy and asks the owner
+    to reconstruct (RecoverObject RPC path)."""
+    cluster = cluster_with_victim
+
+    @ray_tpu.remote(num_cpus=1, resources={"victim": 1}, max_retries=3)
+    def produce():
+        return np.ones(SIZE)
+
+    ref = produce.remote()
+    assert float(ray_tpu.get(ref, timeout=60).sum()) == 360000.0
+
+    cluster.remove_node(cluster.raylets[_victim_node_id()])
+    cluster.add_node(num_cpus=2, resources={"victim": 2})
+    time.sleep(0.5)
+
+    @ray_tpu.remote(num_cpus=1, resources={"victim": 1})
+    def consume(x):
+        return float(x.sum())
+
+    assert ray_tpu.get(consume.remote(ref), timeout=120) == 360000.0
+
+
+def test_put_objects_are_not_reconstructable(cluster_with_victim):
+    """ray.put objects have no lineage; loss is a terminal ObjectLostError
+    (reference semantics)."""
+    cluster = cluster_with_victim
+
+    # Put via a task running ON the victim node so the primary copy is there
+    # but ownership stays with that worker... simpler: put from the driver
+    # always lands on the head node which we cannot kill. Instead assert the
+    # error path directly: lost + no lineage raises.
+    @ray_tpu.remote(num_cpus=1, resources={"victim": 1})
+    def put_and_return_ref():
+        return ray_tpu.put(np.ones(SIZE))
+
+    inner_ref = ray_tpu.get(put_and_return_ref.remote(), timeout=60)
+    cluster.remove_node(cluster.raylets[_victim_node_id()])
+    time.sleep(0.5)
+    with pytest.raises(ray_tpu.RayTpuError):
+        ray_tpu.get(inner_ref, timeout=30)

@@ -49,6 +49,18 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     # scheduler_spread_threshold).
     "scheduler_spread_threshold": 0.5,
     "scheduler_top_k_fraction": 0.2,
+    # Object spilling (reference: local_object_manager.cc +
+    # external_storage.py): sealed objects are written to disk when the shm
+    # arena fills and restored on access. Empty dir -> default under /tmp.
+    "object_spilling_dir": "",
+    # Create-request backpressure: how long ObjCreate waits for spill/eviction
+    # to make room before failing (plasma create_request_queue.cc analog).
+    "object_store_create_timeout_s": 30.0,
+    # Memory monitor (reference: memory_monitor.h:52 + worker_killing_policy):
+    # kill the newest leased worker when system memory use crosses the
+    # threshold. interval 0 disables.
+    "memory_monitor_interval_s": 1.0,
+    "memory_usage_threshold": 0.95,
 }
 
 

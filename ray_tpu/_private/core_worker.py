@@ -454,6 +454,9 @@ class CoreWorker:
         self._task_events: List[dict] = []
         self._free_queue: List[str] = []
         self._release_queue: List[str] = []
+        # Single-hold releases from value finalizers; appended from whatever
+        # thread runs GC (list.append is atomic), drained by the flush loop.
+        self._release_one_queue: List[str] = []
         # task_id -> {"cancelled": bool, "conn": live worker conn or None}
         self._inflight_tasks: Dict[str, dict] = {}
         self._oid_to_task: Dict[str, str] = {}
@@ -480,6 +483,7 @@ class CoreWorker:
             await asyncio.sleep(1.0)
             await self._flush_free_queue()
             await self._flush_release_queue()
+            await self._flush_release_one_queue()
             await self._flush_task_events()
 
     async def _flush_release_queue(self) -> None:
@@ -487,6 +491,15 @@ class CoreWorker:
             return
         oids, self._release_queue = self._release_queue, []
         await self.plasma.release_many(oids)
+
+    async def _flush_release_one_queue(self) -> None:
+        if not self._release_one_queue:
+            return
+        oids, self._release_one_queue = self._release_one_queue, []
+        counts: Dict[str, int] = {}
+        for oid in oids:
+            counts[oid] = counts.get(oid, 0) + 1
+        await self.plasma.release_counts(counts)
 
     async def _flush_free_queue(self) -> None:
         if not self._free_queue:
@@ -583,12 +596,35 @@ class CoreWorker:
         with serialization.DeserializationContext(
             ref_deserializer=self._deserialize_ref
         ):
-            for payload in payloads:
+            for ref, payload in zip(refs, payloads):
                 value, is_exc = serialization.deserialize(payload)
                 if is_exc:
                     raise value
+                if isinstance(payload, memoryview):
+                    # Plasma-backed zero-copy value: transfer one hold to the
+                    # value's lifetime so the arena bytes stay mapped while
+                    # the value is alive but can be spilled/evicted once it's
+                    # garbage collected, even if the ObjectRef lives on
+                    # (reference: plasma client buffer refcounts).
+                    self._attach_value_hold(ref.hex(), value)
                 values.append(value)
         return values[0] if single else values
+
+    def _queue_release_one(self, oid: str) -> None:
+        # Bound method (not list.append) so finalizers always reach the
+        # *current* queue — the flush loop swaps the list object out.
+        self._release_one_queue.append(oid)
+
+    def _attach_value_hold(self, oid: str, value: Any) -> None:
+        import weakref
+
+        try:
+            weakref.finalize(value, self._queue_release_one, oid)
+        except TypeError:
+            # Not weakref-able (plain containers/scalars): the hold stays
+            # tied to the ObjectRef lifetime (conservative; no corruption,
+            # but such objects cannot be spilled while referenced).
+            pass
 
     def _deserialize_ref(self, hex_id, owner_addr):
         return ObjectRef(hex_id, owner_addr, self)

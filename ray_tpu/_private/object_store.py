@@ -153,17 +153,8 @@ class PlasmaClient:
         raise ObjectLostError(f"pull of {oid[:12]} failed: {missing}")
 
     async def release_many(self, oids: List[str]) -> None:
-        """Drop this client's holds (the raylet may then evict/reclaim)."""
-        to_send = []
-        for oid in oids:
-            n = self.held.pop(oid, 0)
-            to_send.extend([oid] * n)
-        if not to_send:
-            return
-        try:
-            await self.conn.call("ObjRelease", {"oids": to_send})
-        except rpc.RpcError:
-            pass
+        """Drop ALL of this client's holds on the given objects."""
+        await self.release_counts({oid: self.held.get(oid, 0) for oid in oids})
 
     def release(self, oid: str) -> None:
         """Fire-and-forget single release (LRU touch + hold drop)."""
@@ -177,6 +168,28 @@ class PlasmaClient:
         task.add_done_callback(
             lambda t: t.exception() if not t.cancelled() else None
         )
+
+    async def release_counts(self, counts: Dict[str, int]) -> None:
+        """Drop up to ``counts[oid]`` holds per object (value-lifetime holds:
+        each deserialized value carries one hold, released when the value is
+        garbage collected — reference: plasma client buffer refcounts)."""
+        to_send = []
+        for oid, n in counts.items():
+            have = self.held.get(oid, 0)
+            take = min(have, n)
+            if take <= 0:
+                continue
+            if have - take <= 0:
+                self.held.pop(oid, None)
+            else:
+                self.held[oid] = have - take
+            to_send.extend([oid] * take)
+        if not to_send:
+            return
+        try:
+            await self.conn.call("ObjRelease", {"oids": to_send})
+        except rpc.RpcError:
+            pass
 
     async def delete(self, oids: List[str]) -> None:
         await self.conn.call("ObjDelete", {"oids": oids})

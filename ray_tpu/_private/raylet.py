@@ -133,6 +133,18 @@ class Raylet:
         # Deleted objects are quarantined (not freed) for the grace window:
         # clients may still hold zero-copy views into their arena bytes.
         self.condemned: Dict[str, float] = {}
+        # Spilled objects: oid -> (path, size, pinned). Sealed objects are
+        # written out when the arena fills and restored on access (reference:
+        # raylet LocalObjectManager spill orchestration +
+        # python/ray/_private/external_storage.py file layout).
+        self.spilled: Dict[str, Tuple[str, int, bool]] = {}
+        self.spilled_bytes = 0
+        base = config.object_spilling_dir or os.path.join(
+            "/tmp", "ray_tpu_spill"
+        )
+        self.spill_dir = os.path.join(
+            base, f"{self.session_name[:16]}_{self.node_id[:8]}"
+        )
         # Client holds (plasma's per-client buffer refcounts,
         # plasma/client.h): ObjGet increments for the calling connection,
         # ObjRelease decrements, disconnect clears. Held objects are never
@@ -179,6 +191,8 @@ class Raylet:
         )
         self._tasks.append(rpc.spawn(self._resource_report_loop()))
         self._tasks.append(rpc.spawn(self._condemned_sweep_loop()))
+        if config.memory_monitor_interval_s > 0:
+            self._tasks.append(rpc.spawn(self._memory_monitor_loop()))
         logger.info(
             "raylet %s on %s:%s resources=%s",
             self.node_id[:8],
@@ -199,6 +213,11 @@ class Raylet:
                 shm.unlink(self.arena_name)
             except Exception:
                 pass
+        if self.spilled:
+            import shutil
+
+            self.spilled.clear()
+            shutil.rmtree(self.spill_dir, ignore_errors=True)
         await self.server.stop()
         if self.gcs is not None:
             await self.gcs.conn.close()
@@ -426,6 +445,7 @@ class Raylet:
             return
         handle.lease_id = req.lease_id
         handle.demand = req.demand  # type: ignore[attr-defined]
+        handle.leased_since = time.monotonic()  # type: ignore[attr-defined]
         self.leases[req.lease_id] = handle
         if not req.fut.done():
             req.fut.set_result(
@@ -540,6 +560,7 @@ class Raylet:
     def _delete_object(self, oid: str) -> None:
         """Logical delete: the object disappears from the directory now, its
         bytes are reclaimed after the grace window (clients may hold views)."""
+        self._drop_spilled(oid)
         if oid in self.condemned or self.store.lookup(oid) is None:
             return
         self.condemned[oid] = time.monotonic()
@@ -550,8 +571,9 @@ class Raylet:
 
     def _try_alloc(self, oid: str, size: int, pin: bool) -> int:
         """Alloc with eviction retries. Victims: condemned objects past grace
-        first, then LRU sealed+unpinned objects past grace. Retrying alloc
-        after every free makes the loop robust to rounding/fragmentation
+        first, then LRU sealed+unpinned objects past grace, then SPILL of
+        sealed objects (pinned primary copies included) to disk. Retrying
+        alloc after every free makes the loop robust to rounding/fragmentation
         (byte accounting alone cannot prove a span fits)."""
         offset = self.store.alloc(oid, size, pin)
         if offset >= 0:
@@ -576,45 +598,188 @@ class Raylet:
             offset = self.store.alloc(oid, size, pin)
             if offset >= 0:
                 return offset
+        # Still no room: spill sealed, unheld objects (LRU-first) to disk.
+        # Reference: LocalObjectManager::SpillObjectsOfSize.
+        spill_candidates = []
+        for vic, last in self.obj_last_access.items():
+            if vic in self.obj_holds or vic in self.condemned:
+                continue
+            info = self.store.lookup(vic)
+            if info is not None and info[2]:
+                spill_candidates.append((last, vic))
+        spill_candidates.sort()
+        for _, vic in spill_candidates:
+            self._spill_object(vic)
+            offset = self.store.alloc(oid, size, pin)
+            if offset >= 0:
+                return offset
         return -1
 
-    async def _obj_create(self, conn, p):
-        oid, size = p["oid"], p["size"]
-        if oid in self.condemned:
-            if oid in self.obj_holds:
-                # A client still maps the old (deterministically identical)
-                # bytes: resurrect the quarantined object instead of freeing
-                # a span someone is reading.
-                del self.condemned[oid]
-                self.obj_last_access[oid] = time.monotonic()
-            else:
-                # Recreate of a just-deleted id: reclaim that one span now.
-                self.store.free(oid)
-                del self.condemned[oid]
+    # -- spilling (reference: local_object_manager.cc, external_storage.py) --
+
+    def _spill_object(self, oid: str) -> None:
         info = self.store.lookup(oid)
-        if info is not None:
-            self.obj_last_access[oid] = time.monotonic()
-            meta = self._obj_meta(oid, info)
-            meta.update({"exists": True, "sealed": info[2]})
-            return meta
-        pin = bool(p.get("pin", True))
-        offset = self._try_alloc(oid, size, pin)
+        if info is None or not info[2]:
+            return
+        off, size, _, pinned = info
+        os.makedirs(self.spill_dir, exist_ok=True)
+        path = os.path.join(self.spill_dir, oid)
+        with open(path, "wb") as f:
+            f.write(self.arena.view[off : off + size])
+        self.spilled[oid] = (path, size, pinned)
+        self.spilled_bytes += size
+        self.store.free(oid)
+        self.obj_last_access.pop(oid, None)
+        logger.info(
+            "spilled %s (%d bytes) to disk; store %d/%d",
+            oid[:12],
+            size,
+            self.store.used,
+            self.store_capacity,
+        )
+
+    def _restore_object(self, oid: str) -> Optional[int]:
+        """Bring a spilled object back into the arena; returns offset or
+        None. Restoring may itself spill colder objects."""
+        entry = self.spilled.get(oid)
+        if entry is None:
+            return None
+        path, size, pinned = entry
+        offset = self._try_alloc(oid, size, pinned)
         if offset < 0:
-            raise rpc.RpcError(
-                f"object store full: need {size}, used {self.store.used} of "
-                f"{self.store_capacity} (fragmentation "
-                f"{self.store.fragmentation()[0]:.2f}; objects within the "
-                f"{config.object_store_eviction_grace_s:.0f}s eviction grace "
-                "window cannot be evicted — raise object_store_memory or "
-                "RAY_TPU_OBJECT_STORE_EVICTION_GRACE_S)"
-            )
+            return None
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+            self.arena.view[offset : offset + len(data)] = data
+        except OSError:
+            self.store.free(oid)
+            if self.spilled.pop(oid, None) is not None:
+                self.spilled_bytes -= size
+            return None
+        self.store.seal(oid)
         self.obj_last_access[oid] = time.monotonic()
-        return {
-            "arena": self.arena_name,
-            "offset": offset,
-            "size": size,
-            "exists": False,
-        }
+        del self.spilled[oid]
+        self.spilled_bytes -= size
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return offset
+
+    def _drop_spilled(self, oid: str) -> None:
+        entry = self.spilled.pop(oid, None)
+        if entry is None:
+            return
+        self.spilled_bytes -= entry[1]
+        try:
+            os.unlink(entry[0])
+        except OSError:
+            pass
+
+    # -- memory monitor (reference: memory_monitor.h + worker_killing_policy)
+
+    def _system_memory_fraction(self) -> float:
+        try:
+            with open("/proc/meminfo") as f:
+                info = {}
+                for line in f:
+                    parts = line.split()
+                    info[parts[0].rstrip(":")] = int(parts[1])
+            total = info.get("MemTotal", 0)
+            avail = info.get("MemAvailable", 0)
+            if total <= 0:
+                return 0.0
+            return 1.0 - avail / total
+        except OSError:
+            return 0.0
+
+    async def _memory_monitor_loop(self) -> None:
+        while True:
+            await asyncio.sleep(config.memory_monitor_interval_s)
+            frac = self._system_memory_fraction()
+            if frac < config.memory_usage_threshold:
+                continue
+            victim = self._pick_memory_victim()
+            if victim is None:
+                continue
+            logger.warning(
+                "memory usage %.1f%% over threshold %.1f%%: killing worker "
+                "%s (newest lease; owner will retry per max_retries)",
+                frac * 100,
+                config.memory_usage_threshold * 100,
+                victim.worker_id[:8],
+            )
+            self._kill_worker_proc(victim)
+
+    def _pick_memory_victim(self) -> Optional["WorkerHandle"]:
+        """Newest-leased task worker first (reference retriable-FIFO policy:
+        kill the most recently started retriable work so older work can
+        finish); never kill actor workers before task workers."""
+        task_workers = [
+            h for h in self.leases.values() if h.actor_id is None
+        ]
+        if task_workers:
+            return max(
+                task_workers,
+                key=lambda h: getattr(h, "leased_since", h.idle_since),
+            )
+        return None
+
+    async def _obj_create(self, conn, p):
+        """Create (or resolve an existing/spilled copy of) an object span.
+
+        Runs as a retry loop with backpressure (plasma
+        create_request_queue.cc analog): when the arena is transiently full
+        of client-held objects, room appears as holds release, the eviction
+        grace expires, or spill victims free up — so re-evaluate the full
+        exists/spilled/alloc state each round rather than failing, since a
+        concurrent deterministic recreate may land the object meanwhile."""
+        oid, size = p["oid"], p["size"]
+        pin = bool(p.get("pin", True))
+        deadline = time.monotonic() + config.object_store_create_timeout_s
+        while True:
+            if oid in self.condemned:
+                if oid in self.obj_holds:
+                    # A client still maps the old (deterministically
+                    # identical) bytes: resurrect the quarantined object
+                    # instead of freeing a span someone is reading.
+                    del self.condemned[oid]
+                    self.obj_last_access[oid] = time.monotonic()
+                else:
+                    # Recreate of a just-deleted id: reclaim that span now.
+                    self.store.free(oid)
+                    del self.condemned[oid]
+            if oid in self.spilled:
+                # Deterministic recreate of a spilled object: restore it (may
+                # fail transiently while the arena is full of held objects).
+                self._restore_object(oid)
+            info = self.store.lookup(oid)
+            if info is not None:
+                self.obj_last_access[oid] = time.monotonic()
+                meta = self._obj_meta(oid, info)
+                meta.update({"exists": True, "sealed": info[2]})
+                return meta
+            if oid not in self.spilled:
+                offset = self._try_alloc(oid, size, pin)
+                if offset >= 0:
+                    self.obj_last_access[oid] = time.monotonic()
+                    return {
+                        "arena": self.arena_name,
+                        "offset": offset,
+                        "size": size,
+                        "exists": False,
+                    }
+            if size > self.store_capacity or time.monotonic() >= deadline:
+                raise rpc.RpcError(
+                    f"object store full: need {size}, used {self.store.used} "
+                    f"of {self.store_capacity} (fragmentation "
+                    f"{self.store.fragmentation()[0]:.2f}; spilled "
+                    f"{len(self.spilled)} objects / {self.spilled_bytes} "
+                    "bytes; objects currently held by clients cannot be "
+                    "spilled — raise object_store_memory or release holds)"
+                )
+            await asyncio.sleep(0.1)
 
     async def _obj_seal(self, conn, p):
         oid = p["oid"]
@@ -633,6 +798,17 @@ class Raylet:
         found, missing = {}, []
         deadline = time.monotonic() + timeout if timeout else None
         for oid in p["oids"]:
+            if oid in self.spilled and oid not in self.condemned:
+                # Restore backpressure: the arena may be transiently full of
+                # client-held objects; holds release within ~1s (client flush
+                # loops), so retry until the caller's deadline.
+                while (
+                    self._restore_object(oid) is None
+                    and oid in self.spilled
+                    and p.get("block", True)
+                    and (deadline is None or time.monotonic() < deadline)
+                ):
+                    await asyncio.sleep(0.05)
             info = None if oid in self.condemned else self.store.lookup(oid)
             if info is not None and not info[2] and p.get("block", True):
                 fut = asyncio.get_running_loop().create_future()
@@ -657,7 +833,8 @@ class Raylet:
     async def _obj_contains(self, conn, p):
         return {
             "contains": {
-                oid: oid not in self.condemned and self.store.contains(oid)
+                oid: oid not in self.condemned
+                and (self.store.contains(oid) or oid in self.spilled)
                 for oid in p["oids"]
             }
         }
@@ -697,6 +874,8 @@ class Raylet:
     async def _pull_object(self, conn, p):
         """Fetch an object from a remote raylet into the local store."""
         oid = p["oid"]
+        if oid in self.spilled:
+            self._restore_object(oid)
         info = self.store.lookup(oid)
         if info is not None and info[2]:
             self._add_hold(conn, oid)
@@ -740,6 +919,8 @@ class Raylet:
             await remote.close()
 
     async def _fetch_chunk(self, conn, p):
+        if p["oid"] in self.spilled:
+            self._restore_object(p["oid"])
         info = self.store.lookup(p["oid"])
         if info is None or not info[2]:
             raise rpc.RpcError(f"object {p['oid'][:12]} not local")
@@ -813,6 +994,8 @@ class Raylet:
             "store_capacity": self.store_capacity,
             "num_objects": self.store.num_objects,
             "pending_leases": len(self.pending_leases),
+            "spilled_objects": len(self.spilled),
+            "spilled_bytes": self.spilled_bytes,
         }
         # Detail payloads for the state API (reference: raylet
         # GetTasksInfo/GetObjectsInfo, node_manager.proto:424-426).

@@ -143,10 +143,16 @@ class ReferenceTable:
     Reference: src/ray/core_worker/reference_count.cc (we implement the
     owner-side protocol; cross-worker borrow counts are conservatively
     approximated by the submitted-task count).
+
+    Thread-safe: mutated both from the event loop and from user threads
+    (ObjectRef ctor/__del__, the synchronous submission fast path).
     """
 
     def __init__(self):
+        import threading
+
         self.entries: Dict[str, RefEntry] = {}
+        self._lock = threading.Lock()
 
     def _entry(self, oid: str) -> RefEntry:
         e = self.entries.get(oid)
@@ -155,29 +161,35 @@ class ReferenceTable:
         return e
 
     def add_local(self, oid: str) -> None:
-        self._entry(oid).local += 1
+        with self._lock:
+            self._entry(oid).local += 1
 
     def mark_owned(self, oid: str) -> None:
-        self._entry(oid).owned = True
+        with self._lock:
+            self._entry(oid).owned = True
 
     def add_submitted(self, oid: str) -> None:
-        self._entry(oid).submitted += 1
+        with self._lock:
+            self._entry(oid).submitted += 1
 
     def remove_submitted(self, oid: str, core: "CoreWorker") -> None:
-        e = self.entries.get(oid)
-        if e is None:
-            return
-        e.submitted -= 1
-        self._maybe_free(oid, e, core)
+        with self._lock:
+            e = self.entries.get(oid)
+            if e is None:
+                return
+            e.submitted -= 1
+            self._maybe_free(oid, e, core)
 
     def remove_local(self, oid: str, core: "CoreWorker") -> None:
-        e = self.entries.get(oid)
-        if e is None:
-            return
-        e.local -= 1
-        self._maybe_free(oid, e, core)
+        with self._lock:
+            e = self.entries.get(oid)
+            if e is None:
+                return
+            e.local -= 1
+            self._maybe_free(oid, e, core)
 
     def _maybe_free(self, oid: str, e: RefEntry, core: "CoreWorker") -> None:
+        # Called with the lock held; the schedule_* sinks are plain appends.
         if e.local <= 0 and e.submitted <= 0 and not e.freed:
             e.freed = True
             del self.entries[oid]
@@ -1020,6 +1032,93 @@ class CoreWorker:
         rpc.spawn(self._run_task(wire, spec))
         return refs
 
+    def try_submit_task_fast(
+        self,
+        pickled_fn: bytes,
+        fn_name: str,
+        args: tuple,
+        kwargs: dict,
+        *,
+        loop,
+        num_returns: int = 1,
+        resources: Optional[Dict[str, float]] = None,
+        max_retries: Optional[int] = None,
+        retry_exceptions: bool = False,
+        pg_id: Optional[str] = None,
+        bundle_index: int = -1,
+        scheduling_strategy: Optional[dict] = None,
+        runtime_env: Optional[dict] = None,
+    ) -> Optional[List[ObjectRef]]:
+        """Synchronous submission fast path, callable from any thread.
+
+        The hot-path cost of .remote() is not the work but the thread
+        round-trip into the event loop (run_coroutine_threadsafe + wait).
+        Everything except launching the network I/O is thread-safe to do
+        here: serialization uses thread-local context, id generation is
+        random, the reference table takes a lock, and the remaining
+        bookkeeping is GIL-atomic appends/inserts. Only the launch is posted
+        (fire-and-forget) onto the loop. Returns None when this call needs
+        the async slow path (runtime_env prep, first-time function export,
+        or plasma-resident args).
+        """
+        if runtime_env:
+            return None
+        func_id = function_id_of(pickled_fn)
+        if func_id not in self._func_ids_exported:
+            return None  # first call pays the async export
+        if num_returns == "dynamic":
+            num_returns = -1
+        serialized, ref_pos, kw_refs, deps = self._prepare_args(args, kwargs)
+        if serialized.total_size > config.max_direct_call_object_size:
+            return None  # large args need an async plasma write
+        task_id = TaskID.from_random().hex()
+        return_ids = [
+            deterministic_object_id(TaskID.from_hex(task_id), i).hex()
+            for i in range(1 if num_returns == -1 else num_returns)
+        ]
+        res = ResourceSet(resources if resources is not None else {"CPU": 1.0})
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id,
+            name=fn_name,
+            func_id=func_id,
+            args_blob=serialized.to_bytes(),
+            args_object=None,
+            ref_positions=ref_pos,
+            kw_ref_keys=kw_refs,
+            dependencies=deps,
+            num_returns=num_returns,
+            return_ids=return_ids,
+            resources=res.to_units(),
+            max_retries=(
+                max_retries
+                if max_retries is not None
+                else config.default_max_task_retries
+            ),
+            retry_exceptions=retry_exceptions,
+            owner_addr=list(self.addr),
+            pg_id=pg_id,
+            bundle_index=bundle_index,
+            scheduling_strategy=scheduling_strategy,
+            runtime_env=None,
+        )
+        wire = spec.to_wire()
+        refs = []
+        for oid in return_ids:
+            self.reference_table.mark_owned(oid)
+            refs.append(ObjectRef(oid, self.addr, self))
+        for dep_oid, _ in deps:
+            self.reference_table.add_submitted(dep_oid)
+        self.record_task_event(task_id, fn_name, "PENDING")
+        self._inflight_tasks[task_id] = {"cancelled": False, "conn": None}
+        for oid in return_ids:
+            self._oid_to_task[oid] = task_id
+        loop.call_soon_threadsafe(self._spawn_run_task, wire, spec)
+        return refs
+
+    def _spawn_run_task(self, wire: dict, spec: TaskSpec) -> None:
+        rpc.spawn(self._run_task(wire, spec))
+
     async def cancel(self, ref: "ObjectRef", force: bool = False) -> bool:
         """Best-effort task cancellation (reference: ray.cancel ->
         CoreWorker::CancelTask). Queued tasks are dropped; running tasks get
@@ -1297,6 +1396,55 @@ class CoreWorker:
             self.reference_table.add_submitted(dep_oid)
         rpc.spawn(self._run_actor_task(spec))
         return refs
+
+    def try_submit_actor_task_fast(
+        self,
+        actor_id: str,
+        method_name: str,
+        args: tuple,
+        kwargs: dict,
+        *,
+        loop,
+        num_returns: int = 1,
+    ) -> Optional[List[ObjectRef]]:
+        """Synchronous actor-call fast path (see try_submit_task_fast)."""
+        serialized, ref_pos, kw_refs, deps = self._prepare_args(args, kwargs)
+        if serialized.total_size > config.max_direct_call_object_size:
+            return None
+        task_id = TaskID.from_random().hex()
+        return_ids = [
+            deterministic_object_id(TaskID.from_hex(task_id), i).hex()
+            for i in range(num_returns)
+        ]
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id,
+            name=method_name,
+            func_id="",
+            args_blob=serialized.to_bytes(),
+            args_object=None,
+            ref_positions=ref_pos,
+            kw_ref_keys=kw_refs,
+            dependencies=deps,
+            num_returns=num_returns,
+            return_ids=return_ids,
+            resources={},
+            owner_addr=list(self.addr),
+            actor_id=actor_id,
+            actor_method=method_name,
+            caller_id=self.worker_id,
+        )
+        refs = []
+        for oid in return_ids:
+            self.reference_table.mark_owned(oid)
+            refs.append(ObjectRef(oid, self.addr, self))
+        for dep_oid, _ in deps:
+            self.reference_table.add_submitted(dep_oid)
+        loop.call_soon_threadsafe(self._spawn_run_actor_task, spec)
+        return refs
+
+    def _spawn_run_actor_task(self, spec: TaskSpec) -> None:
+        rpc.spawn(self._run_actor_task(spec))
 
     async def _run_actor_task(self, spec: TaskSpec) -> None:
         try:

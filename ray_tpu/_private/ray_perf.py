@@ -1,0 +1,135 @@
+"""Control-plane microbenchmarks (port of the reference's
+python/ray/_private/ray_perf.py:93-288 suite set).
+
+Run: python -m ray_tpu._private.ray_perf [--json PATH]
+
+Suites: trivial task throughput (sync + pipelined), actor call throughput
+(1:1 sync, 1:1 async batch, n:n), put/get small objects. Each prints a
+line; with --json, a summary dict is written for the driver/CI.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Dict
+
+import ray_tpu
+
+
+def timeit(name: str, fn, multiplier: int = 1) -> float:
+    # Warmup, then 3 timed trials (reference ray_perf style).
+    fn()
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        fn()
+        dt = time.perf_counter() - t0
+        rate = multiplier / dt
+        best = max(best, rate)
+    print(f"{name}: {best:.1f} /s")
+    return best
+
+
+def main(json_path: str = "") -> Dict[str, float]:
+    results: Dict[str, float] = {}
+    ray_tpu.init(num_cpus=8, num_tpus=0)
+
+    @ray_tpu.remote
+    def trivial():
+        return b"ok"
+
+    @ray_tpu.remote
+    class Counter:
+        def small(self):
+            return b"ok"
+
+        async def asmall(self):
+            return b"ok"
+
+    # Warm the worker pool so spawn cost is not measured.
+    ray_tpu.get([trivial.remote() for _ in range(16)])
+
+    N = 1000
+    results["tasks_sync_per_s"] = timeit(
+        "single client tasks sync",
+        lambda: [ray_tpu.get(trivial.remote()) for _ in range(100)],
+        100,
+    )
+    results["tasks_async_per_s"] = timeit(
+        "single client tasks async (pipelined)",
+        lambda: ray_tpu.get([trivial.remote() for _ in range(N)]),
+        N,
+    )
+
+    actor = Counter.remote()
+    ray_tpu.get(actor.small.remote())
+    results["actor_calls_sync_per_s"] = timeit(
+        "1:1 actor calls sync",
+        lambda: [ray_tpu.get(actor.small.remote()) for _ in range(100)],
+        100,
+    )
+    results["actor_calls_async_per_s"] = timeit(
+        "1:1 actor calls async (pipelined)",
+        lambda: ray_tpu.get([actor.small.remote() for _ in range(N)]),
+        N,
+    )
+
+    ray_tpu.kill(actor)
+    async_actor = Counter.options(max_concurrency=64).remote()
+    ray_tpu.get(async_actor.asmall.remote())
+    results["async_actor_calls_per_s"] = timeit(
+        "1:1 async actor calls (pipelined)",
+        lambda: ray_tpu.get([async_actor.asmall.remote() for _ in range(N)]),
+        N,
+    )
+
+    ray_tpu.kill(async_actor)
+    n_actors = 4
+    actors = [Counter.remote() for _ in range(n_actors)]
+    ray_tpu.get([a.small.remote() for a in actors])
+    results["nn_actor_calls_per_s"] = timeit(
+        "n:n actor calls (4 actors, pipelined)",
+        lambda: ray_tpu.get(
+            [a.small.remote() for _ in range(N // n_actors) for a in actors]
+        ),
+        N,
+    )
+
+    for a in actors:
+        ray_tpu.kill(a)
+    small = b"x" * 1024
+    results["put_small_per_s"] = timeit(
+        "1KB put", lambda: [ray_tpu.put(small) for _ in range(500)], 500
+    )
+    ref = ray_tpu.put(small)
+    results["get_small_per_s"] = timeit(
+        "1KB get", lambda: [ray_tpu.get(ref) for _ in range(500)], 500
+    )
+
+    import numpy as np
+
+    big = np.zeros(16 * 1024 * 1024 // 8)  # 16 MB
+    results["put_16mb_per_s"] = timeit(
+        "16MB put (shm)", lambda: [ray_tpu.put(big) for _ in range(20)], 20
+    )
+    bref = ray_tpu.put(big)
+    results["get_16mb_per_s"] = timeit(
+        "16MB get (zero-copy)", lambda: [ray_tpu.get(bref) for _ in range(50)], 50
+    )
+
+    ray_tpu.shutdown()
+    if json_path:
+        with open(json_path, "w") as f:
+            json.dump(results, f, indent=2)
+    return results
+
+
+if __name__ == "__main__":
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--json", default="")
+    args = parser.parse_args()
+    main(args.json)

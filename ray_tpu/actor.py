@@ -29,15 +29,24 @@ class ActorMethod:
 
     def remote(self, *args, **kwargs):
         core = worker_mod._core()
-        refs = worker_mod.global_worker.run_async(
-            core.submit_actor_task(
-                self._handle._actor_id,
-                self._name,
-                args,
-                kwargs,
-                num_returns=self._num_returns,
-            )
+        refs = core.try_submit_actor_task_fast(
+            self._handle._actor_id,
+            self._name,
+            args,
+            kwargs,
+            num_returns=self._num_returns,
+            loop=worker_mod.global_worker.loop,
         )
+        if refs is None:  # large args need the async plasma path
+            refs = worker_mod.global_worker.run_async(
+                core.submit_actor_task(
+                    self._handle._actor_id,
+                    self._name,
+                    args,
+                    kwargs,
+                    num_returns=self._num_returns,
+                )
+            )
         if self._num_returns == 1:
             return refs[0]
         return refs

@@ -61,6 +61,13 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     # threshold. interval 0 disables.
     "memory_monitor_interval_s": 1.0,
     "memory_usage_threshold": 0.95,
+    # Pre-fault the shm arena's pages at raylet startup (background thread):
+    # first-touch page allocation otherwise dominates large-object put latency
+    # (~17 ms per 16 MiB on tmpfs). Off by default — it commits the whole
+    # arena's physical memory and burns CPU proportional to capacity; prompt
+    # free-span reuse makes steady-state puts hit warm pages anyway. Enable on
+    # dedicated TPU hosts for cold-start-sensitive pipelines.
+    "prefault_object_store": False,
 }
 
 

@@ -110,7 +110,11 @@ class PlasmaClient:
         if reply.get("exists"):
             return  # already stored (e.g. deterministic re-execution)
         serialized.write_to(self._slice(reply))
-        await self.conn.call("ObjSeal", {"oid": oid})
+        # Seal as a one-way push: same-connection FIFO means our own later
+        # ObjGet/ObjCreate calls observe the seal, and remote readers reach
+        # the raylet after the owner advertises the object — both ordered
+        # after this frame. Saves the second RTT of every large put.
+        self.conn.push_nowait("ObjSeal", {"oid": oid})
 
     async def put_bytes(self, oid: str, payload: bytes) -> None:
         reply = await self.conn.call(
@@ -119,7 +123,7 @@ class PlasmaClient:
         if reply.get("exists"):
             return
         self._slice(reply)[: len(payload)] = payload
-        await self.conn.call("ObjSeal", {"oid": oid})
+        self.conn.push_nowait("ObjSeal", {"oid": oid})
 
     async def get(
         self, oids: List[str], timeout: Optional[float] = None, block: bool = True

@@ -40,11 +40,16 @@ def main(json_path: str = "") -> Dict[str, float]:
     def trivial():
         return b"ok"
 
+    # Separate sync and async actor classes (reference ray_perf.py does the
+    # same): an actor with any coroutine method is an asyncio actor, whose
+    # calls all run on the event loop rather than the dedicated exec thread.
     @ray_tpu.remote
     class Counter:
         def small(self):
             return b"ok"
 
+    @ray_tpu.remote
+    class AsyncCounter:
         async def asmall(self):
             return b"ok"
 
@@ -77,7 +82,7 @@ def main(json_path: str = "") -> Dict[str, float]:
     )
 
     ray_tpu.kill(actor)
-    async_actor = Counter.options(max_concurrency=64).remote()
+    async_actor = AsyncCounter.options(max_concurrency=64).remote()
     ray_tpu.get(async_actor.asmall.remote())
     results["async_actor_calls_per_s"] = timeit(
         "1:1 async actor calls (pipelined)",

@@ -174,6 +174,24 @@ class Raylet:
 
     async def start(self) -> Tuple[str, int]:
         self.arena = shm.create(self.arena_name, self.store_capacity)
+        if config.prefault_object_store:
+            # Touch every arena page off the event loop so large-object puts
+            # don't pay first-touch page faults (plasma_allocator.cc analog).
+            import threading
+
+            def _prefault(view=self.arena.view):
+                try:
+                    from ray_tpu._native import _shm as native_shm
+
+                    native_shm.prefault(view, 4)
+                except Exception:
+                    try:
+                        for off in range(0, len(view), 4096):
+                            view[off] = view[off]
+                    except Exception:
+                        pass
+
+            threading.Thread(target=_prefault, name="arena_prefault", daemon=True).start()
         addr = await self.server.start()
         self.server.on_disconnect(self._on_disconnect)
         # Duplex: the GCS calls back over this link (LeaseWorkerForActor,
@@ -226,6 +244,7 @@ class Raylet:
         s = self.server
         s.register("RegisterWorker", self._register_worker)
         s.register("RequestWorkerLease", self._request_worker_lease)
+        s.register("CancelWorkerLease", self._cancel_worker_lease)
         s.register("ReturnWorker", self._return_worker)
         s.register("LeaseWorkerForActor", self._lease_worker_for_actor)
         s.register("KillWorker", self._kill_worker)
@@ -418,6 +437,16 @@ class Raylet:
         self._try_grant_leases()
         return await req.fut
 
+    async def _cancel_worker_lease(self, conn, p):
+        """Cancel a queued (ungranted) lease request: the surplus-request
+        drain that keeps recycled-lease pools from pinning the raylet queue
+        (reference: NodeManagerService CancelWorkerLease)."""
+        for req in self.pending_leases:
+            if req.lease_id == p["lease_id"] and not req.fut.done():
+                req.fut.set_result({"cancelled": True})
+                break
+        return {"ok": True}
+
     def _try_grant_leases(self) -> None:
         granted_any = True
         while granted_any and self.pending_leases:
@@ -558,16 +587,28 @@ class Raylet:
                 del self.condemned[oid]
 
     def _delete_object(self, oid: str) -> None:
-        """Logical delete: the object disappears from the directory now, its
-        bytes are reclaimed after the grace window (clients may hold views)."""
+        """Logical delete: the object disappears from the directory now. With
+        no client holds the span frees immediately (holds are the only source
+        of zero-copy views, so nothing can still map the bytes); held objects
+        are quarantined until the grace window passes. Immediate reuse keeps
+        sustained large-put workloads on already-faulted arena pages."""
         self._drop_spilled(oid)
-        if oid in self.condemned or self.store.lookup(oid) is None:
+        info = self.store.lookup(oid)
+        if oid in self.condemned or info is None:
             return
-        self.condemned[oid] = time.monotonic()
         self.obj_last_access.pop(oid, None)
         for fut in self.obj_waiters.pop(oid, []):
             if not fut.done():
                 fut.set_result(False)
+        # Sealed + hold-free: nothing can still map the bytes (holds are the
+        # only source of zero-copy reader views, and the writer's view is
+        # gone once sealed). Unsealed objects may have a writer mid-memcpy
+        # (e.g. a task return whose ref was dropped early) — quarantine those
+        # for the grace window instead.
+        if info[2] and oid not in self.obj_holds:
+            self.store.free(oid)
+        else:
+            self.condemned[oid] = time.monotonic()
 
     def _try_alloc(self, oid: str, size: int, pin: bool) -> int:
         """Alloc with eviction retries. Victims: condemned objects past grace
@@ -882,7 +923,11 @@ class Raylet:
             return self._obj_meta(oid, info)
         remote = await rpc.connect(*p["from_addr"], retry=3)
         try:
-            reply = await remote.call("ObjGet", {"oids": [oid], "block": False})
+            # block briefly: the owner's seal may still be in flight on its
+            # raylet connection (puts seal via one-way push).
+            reply = await remote.call(
+                "ObjGet", {"oids": [oid], "block": True, "timeout": 5}
+            )
             meta = reply["found"].get(oid)
             if meta is None:
                 raise rpc.RpcError(f"object {oid[:12]} not on remote node")

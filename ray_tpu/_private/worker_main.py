@@ -29,6 +29,76 @@ from ray_tpu._private.core_worker import CoreWorker, ObjectRef
 logger = logging.getLogger(__name__)
 
 
+class _ExecThread:
+    """Dedicated execution thread with reply batching.
+
+    The task/actor hot path never crosses loop<->thread per call the way
+    run_in_executor does: the RPC layer enqueues work items straight from
+    data_received (sync handler), the thread executes back-to-back, and
+    completed replies are flushed to the event loop in coalesced batches
+    (one call_soon_threadsafe per burst). Analog of the reference's
+    dedicated actor-scheduling-queue execution thread
+    (transport/actor_scheduling_queue.cc).
+    """
+
+    def __init__(self, executor: "Executor", loop: asyncio.AbstractEventLoop):
+        import queue
+
+        self.executor = executor
+        self.loop = loop
+        self.q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self.replies: list = []
+        self._reply_wake = False
+        self.thread = threading.Thread(
+            target=self._run, name="ray_tpu_exec", daemon=True
+        )
+        self.thread.start()
+
+    def submit(self, conn, msgid: int, method: str, wire: dict) -> None:
+        self.q.put((conn, msgid, method, wire))
+
+    def _run(self) -> None:
+        ex = self.executor
+        while True:
+            item = self.q.get()
+            if item is None:
+                return
+            conn, msgid, method, wire = item
+            track = ex.running_tasks[wire.get("task_id", "")] = {
+                "thread_id": threading.get_ident(),
+                "async_task": None,
+            }
+            try:
+                payload = ex._execute_sync(wire)
+            except BaseException as e:  # noqa: BLE001 - serialize any failure
+                if isinstance(e, SystemExit):
+                    self.loop.call_soon_threadsafe(
+                        self.loop.call_later, 0.1, os._exit, 0
+                    )
+                    payload = {
+                        "error": ex._error_payload(RuntimeError("actor exited"))
+                    }
+                else:
+                    payload = {"error": ex._error_payload(e)}
+            finally:
+                ex.running_tasks.pop(wire.get("task_id", ""), None)
+            self.replies.append((conn, msgid, method, payload))
+            if not self._reply_wake:
+                self._reply_wake = True
+                self.loop.call_soon_threadsafe(self._drain_replies)
+
+    def _drain_replies(self) -> None:
+        self._reply_wake = False
+        batch, self.replies = self.replies, []
+        for conn, msgid, method, payload in batch:
+            conn.reply_nowait(msgid, method, payload)
+
+    def run_on_loop(self, coro):
+        """Blockingly run a coroutine on the event loop (slow aspects of an
+        otherwise thread-executed call: ref resolution, plasma writes)."""
+        return asyncio.run_coroutine_threadsafe(coro, self.loop).result()
+
+
 class Executor:
     """Task/actor execution engine wired onto a CoreWorker."""
 
@@ -45,11 +115,148 @@ class Executor:
         self.exec_lock = asyncio.Lock()
         # task_id -> {"thread_id": int|None, "async_task": Task|None}
         self.running_tasks: Dict[str, dict] = {}
+        self._exec_thread: Optional[_ExecThread] = None
+        # True when the hosted actor has no coroutine methods (set at
+        # creation); gates the exec-thread fast path.
+        self.actor_all_sync = False
         core.server.register("PushTask", self.handle_push_task)
         core.server.register("PushActorTask", self.handle_push_actor_task)
         core.server.register("CreateActor", self.handle_create_actor)
         core.server.register("CancelTask", self.handle_cancel_task)
         core.server.register("Exit", self.handle_exit)
+        core.server.register_sync("PushTask", self._sync_push_task)
+        core.server.register_sync("PushActorTask", self._sync_push_actor_task)
+
+    # -- sync fast-path dispatch (called inline from data_received) ----------
+
+    def _exec(self) -> _ExecThread:
+        t = self._exec_thread
+        if t is None:
+            t = self._exec_thread = _ExecThread(self, asyncio.get_running_loop())
+        return t
+
+    def _fallback_async(self, conn, msgid, method, handler, payload) -> None:
+        async def run():
+            try:
+                result = await handler(conn, payload)
+            except Exception as e:
+                conn.reply_error_nowait(
+                    msgid, method, f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
+                )
+                return
+            conn.reply_nowait(msgid, method, result)
+
+        rpc.spawn(run())
+
+    def _sync_push_actor_task(self, conn, msgid, p) -> None:
+        wire = p["spec"]
+        if (
+            self.actor_all_sync
+            and self.actor_instance is not None
+            and (self.actor_spec or {}).get("max_concurrency", 1) == 1
+            and wire.get("actor_method") != "__rt_dag_loop__"
+        ):
+            # Ordered all-sync actor: every call funnels through the exec
+            # thread in arrival order (= per-caller seq order), which enforces
+            # the sequencing the async path needed futures for. Actors with
+            # coroutine methods stay on the loop path — their awaits must
+            # interleave across callers (e.g. rendezvous patterns).
+            # Advance the async path's seq ledger now: a later call routed
+            # through handle_push_actor_task (__rt_dag_loop__, restarts) must
+            # not wait on a turn the exec thread will never signal.
+            seq = wire.get("seq_no", -1)
+            if seq >= 0:
+                self._advance_seq(wire.get("caller_id") or "anon", seq)
+            self._exec().submit(conn, msgid, "PushActorTask", wire)
+            return
+        self._fallback_async(conn, msgid, "PushActorTask", self.handle_push_actor_task, p)
+
+    def _sync_push_task(self, conn, msgid, p) -> None:
+        wire = p["spec"]
+        fn = self.fn_cache.get(wire.get("func_id"))
+        renv = wire.get("runtime_env") or {}
+        if (
+            fn is not None
+            and not asyncio.iscoroutinefunction(fn)
+            and wire.get("args_blob") is not None
+            and not wire.get("ref_positions")
+            and not wire.get("kw_ref_keys")
+            and wire.get("num_returns") != -1
+            and not renv.get("working_dir")
+            and not renv.get("py_modules")
+        ):
+            self._exec().submit(conn, msgid, "PushTask", wire)
+            return
+        self._fallback_async(conn, msgid, "PushTask", self.handle_push_task, p)
+
+    def _execute_sync(self, wire: dict):
+        """Run one task/actor call on the exec thread; returns the reply
+        payload. Slow aspects (ref args, plasma-resident args/returns) hop to
+        the event loop via run_on_loop."""
+        core = self.core
+        exec_t = self._exec_thread
+        actor_method = wire.get("actor_method")
+        if actor_method is not None:
+            fn = getattr(self.actor_instance, actor_method)
+        else:
+            fn = self.fn_cache[wire["func_id"]]
+        # -- arguments
+        if (
+            wire.get("args_blob") is not None
+            and not wire.get("ref_positions")
+            and not wire.get("kw_ref_keys")
+        ):
+            with serialization.DeserializationContext(
+                ref_deserializer=core._deserialize_ref
+            ):
+                (args, kwargs), _ = serialization.deserialize(wire["args_blob"])
+        else:
+            args, kwargs = exec_t.run_on_loop(self.load_args(wire))
+        # -- execute
+        renv = wire.get("runtime_env") or {}
+        env_vars = renv.get("env_vars")
+        if env_vars:
+            from ray_tpu.runtime_env.context import scoped_env_vars
+
+            with scoped_env_vars(env_vars):
+                result = (
+                    exec_t.run_on_loop(fn(*args, **kwargs))
+                    if asyncio.iscoroutinefunction(fn)
+                    else fn(*args, **kwargs)
+                )
+        elif asyncio.iscoroutinefunction(fn):
+            result = exec_t.run_on_loop(fn(*args, **kwargs))
+        else:
+            result = fn(*args, **kwargs)
+        # -- returns
+        num_returns = wire["num_returns"]
+        if num_returns == 0:
+            return {"returns": []}
+        if num_returns == -1:
+            import inspect as _inspect
+
+            if _inspect.isgenerator(result):
+                dynamic = []
+                for item in result:
+                    dynamic.extend(self._store_one_sync(self._dyn_oid(wire, len(dynamic)), item))
+                return {"dynamic": dynamic}
+            num_returns = 1
+        values = [result] if num_returns == 1 else list(result)
+        if num_returns != 1 and len(values) != num_returns:
+            raise ValueError(
+                f"task declared num_returns={num_returns} but returned {len(values)}"
+            )
+        out = []
+        for oid, value in zip(wire["return_ids"], values):
+            out.extend(self._store_one_sync(oid, value))
+        return {"returns": out}
+
+    def _store_one_sync(self, oid: str, value) -> list:
+        serialized = serialization.serialize(value)
+        if serialized.total_size <= config.max_direct_call_object_size:
+            return [{"inline": serialized.to_bytes()}]
+        self._exec_thread.run_on_loop(self.core.plasma.put_serialized(oid, serialized))
+        return [{"plasma": list(self.core.raylet_addr)}]
 
     # -- function table ------------------------------------------------------
 
@@ -236,6 +443,12 @@ class Executor:
             self.actor_instance = await loop.run_in_executor(
                 self.pool, lambda: cls(*args, **kwargs)
             )
+            self.actor_all_sync = not any(
+                asyncio.iscoroutinefunction(m)
+                for _, m in inspect.getmembers(
+                    type(self.actor_instance), callable
+                )
+            )
             await self.core.gcs.call(
                 "ReportActorReady",
                 {
@@ -339,8 +552,12 @@ async def amain() -> None:
     server = rpc.Server("127.0.0.1", 0)
     addr = await server.start()
 
-    raylet_conn = await rpc.connect(*raylet_addr, handlers=server._handlers)
-    gcs_conn = await rpc.connect(*gcs_addr, handlers=server._handlers)
+    raylet_conn = await rpc.connect(
+        *raylet_addr, handlers=server._handlers, sync_handlers=server._sync_handlers
+    )
+    gcs_conn = await rpc.connect(
+        *gcs_addr, handlers=server._handlers, sync_handlers=server._sync_handlers
+    )
 
     core = CoreWorker(
         job_id=os.environ.get("RAY_TPU_JOB_ID", ""),

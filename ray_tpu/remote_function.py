@@ -55,6 +55,10 @@ class RemoteFunction:
         self._fn = fn
         self._options = options
         self._pickled: Optional[bytes] = None
+        # Per-call-invariant submission fields, computed once (the resource
+        # fixed-point conversion and strategy unpacking are hot-path costs).
+        self._res_units: Optional[Dict[str, int]] = None
+        self._strategy_cache = None
         functools.update_wrapper(self, fn)
 
     def _get_pickled(self) -> bytes:
@@ -80,23 +84,49 @@ class RemoteFunction:
     def _remote(self, args, kwargs):
         opts = self._options
         core = worker_mod._core()
-        pg_id, bundle_index, strategy = _strategy_fields(opts)
-        refs = worker_mod.global_worker.run_async(
-            core.submit_task(
-                self._get_pickled(),
-                opts.get("name") or getattr(self._fn, "__name__", "task"),
-                args,
-                kwargs,
-                num_returns=opts.get("num_returns", 1),
-                resources=_build_resources(opts),
-                max_retries=opts.get("max_retries"),
-                retry_exceptions=opts.get("retry_exceptions", False),
-                pg_id=pg_id,
-                bundle_index=bundle_index,
-                scheduling_strategy=strategy,
-                runtime_env=opts.get("runtime_env"),
-            )
+        if self._strategy_cache is None:
+            self._strategy_cache = _strategy_fields(opts)
+        pg_id, bundle_index, strategy = self._strategy_cache
+        if self._res_units is None:
+            from ray_tpu._private.common import ResourceSet
+
+            self._res_units = ResourceSet(_build_resources(opts)).to_units()
+        name = opts.get("name") or getattr(self._fn, "__name__", "task")
+        # Thread-side fast path: skips the run_coroutine_threadsafe round trip
+        # (the dominant cost of .remote()); falls back for first-call export,
+        # runtime envs, and plasma-sized args.
+        refs = core.try_submit_task_fast(
+            self._get_pickled(),
+            name,
+            args,
+            kwargs,
+            loop=worker_mod.global_worker.loop,
+            num_returns=opts.get("num_returns", 1),
+            resources_units=self._res_units,
+            max_retries=opts.get("max_retries"),
+            retry_exceptions=opts.get("retry_exceptions", False),
+            pg_id=pg_id,
+            bundle_index=bundle_index,
+            scheduling_strategy=strategy,
+            runtime_env=opts.get("runtime_env"),
         )
+        if refs is None:
+            refs = worker_mod.global_worker.run_async(
+                core.submit_task(
+                    self._get_pickled(),
+                    name,
+                    args,
+                    kwargs,
+                    num_returns=opts.get("num_returns", 1),
+                    resources=_build_resources(opts),
+                    max_retries=opts.get("max_retries"),
+                    retry_exceptions=opts.get("retry_exceptions", False),
+                    pg_id=pg_id,
+                    bundle_index=bundle_index,
+                    scheduling_strategy=strategy,
+                    runtime_env=opts.get("runtime_env"),
+                )
+            )
         num_returns = opts.get("num_returns", 1)
         if num_returns == 1 or num_returns == "dynamic":
             return refs[0]

@@ -48,8 +48,9 @@ def test_task_outputs_spill(small_store):
 
 
 def test_spill_stats_visible(small_store):
-    for i in range(12):
-        ray_tpu.put(np.full(OBJ // 8, i, dtype=np.float64))
+    # Hold the refs: unreferenced puts are freed promptly and would never
+    # pressure the store into spilling.
+    refs = [ray_tpu.put(np.full(OBJ // 8, i, dtype=np.float64)) for i in range(12)]
     stats = [
         s
         for s in ray_tpu._private.worker.global_worker.run_async(

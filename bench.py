@@ -1,33 +1,73 @@
-"""Flagship benchmark: ResNet-50 train-step throughput on one TPU chip.
+"""Flagship benchmark: ResNet-50 training on one TPU chip.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N,
+   "mfu": ..., "e2e_images_per_sec": ..., ...}
 
-Baseline: the reference's headline Train-ResNet e2e number, 40.7 images/s on
-one GPU worker (BASELINE.md / doc/source/train/benchmarks.rst:36). Same
-model family + train-step workload (synthetic ImageNet-shape data, bf16),
-so vs_baseline = images_per_sec / 40.7.
+Two phases, each in its own subprocess (the axon TPU tunnel admits one
+process at a time, and the e2e phase needs the chip free for its train
+worker):
+
+1. **step** — raw jitted train-step throughput (synthetic resident data),
+   reporting MFU. FLOPs come from XLA's own compiled cost analysis
+   (multiply-add = 2 flops — the same convention as the chip's quoted peak),
+   peak from a device-kind table. ResNet-50/b128/bf16 on v5e is
+   HBM-bandwidth-bound (~0.4 GB moved per image -> ~51 GB per 128-image
+   step vs 819 GB/s peak), so MFU plateaus near 30% — the bytes, not the
+   MXU, are the wall.
+2. **e2e** — ingest -> train through the framework, mirroring the measured
+   reference workload (doc/source/train/benchmarks.rst:36: Train ResNet e2e
+   with Ray Data ingest, 40.7 images/s on one GPU worker): a
+   ray_tpu.data pipeline (parallel synth-decode tasks -> shm object store ->
+   streaming_split) feeds a 1-worker JaxTrainer that runs the same train
+   step per batch.
+
+Baseline: the reference's headline Train-ResNet e2e number, 40.7 images/s
+(BASELINE.md). vs_baseline compares the matching e2e phase.
 """
 
 from __future__ import annotations
 
 import json
-import time
+import os
+import subprocess
+import sys
 
 BASELINE_IMAGES_PER_SEC = 40.7  # reference: 1-GPU Train ResNet e2e
 
+# Peak bf16 FLOP/s per chip by device kind (public spec sheet numbers).
+_PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6e": 918e12,
+    "TPU v6 lite": 918e12,
+}
+_DEFAULT_PEAK = 197e12
 
-def main():
+
+def _peak_for(kind: str) -> float:
+    for prefix, peak in _PEAK_FLOPS.items():
+        if kind.startswith(prefix):
+            return peak
+    return _DEFAULT_PEAK
+
+
+def phase_step() -> dict:
+    import time
+
     import jax
     import jax.numpy as jnp
     import optax
 
     from ray_tpu.models import ResNetConfig, resnet_apply, resnet_init
 
-    platform = jax.devices()[0].platform
-    batch = 256 if platform == "tpu" else 8
-    size = 224 if platform == "tpu" else 64
-    steps = 20 if platform == "tpu" else 3
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    batch = 128 if on_tpu else 8
+    size = 224 if on_tpu else 64
+    steps = 30 if on_tpu else 3
 
     cfg = ResNetConfig(depth=50, num_classes=1000, dtype=jnp.bfloat16)
     params = resnet_init(jax.random.PRNGKey(0), cfg)
@@ -40,7 +80,6 @@ def main():
         loss = -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
         return loss, new_params
 
-    @jax.jit
     def step(params, opt, images, labels):
         (loss, new_params), grads = jax.value_and_grad(
             loss_fn, has_aux=True
@@ -49,27 +88,188 @@ def main():
         params = optax.apply_updates(new_params, updates)
         return params, opt, loss
 
+    jstep = jax.jit(step, donate_argnums=(0, 1))
     images = jax.random.normal(
         jax.random.PRNGKey(1), (batch, size, size, 3), jnp.bfloat16
     )
     labels = jax.random.randint(jax.random.PRNGKey(2), (batch,), 0, 1000)
 
-    # Warmup (compile) then timed steps.
-    params, opt, loss = step(params, opt, images, labels)
+    # AOT-compile once; the timed loop runs this exact executable (so the
+    # FLOP/byte numbers below describe the thing being timed, and the jit
+    # dispatch cache isn't compiled a second time).
+    compiled = jstep.lower(params, opt, images, labels).compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0] if ca else {}
+    flops_per_step = float(ca.get("flops", 0.0) or 0.0)
+    bytes_per_step = float(ca.get("bytes accessed", 0.0) or 0.0)
+
+    # Warmup then timed steps.
+    params, opt, loss = compiled(params, opt, images, labels)
     jax.block_until_ready(loss)
     t0 = time.perf_counter()
     for _ in range(steps):
-        params, opt, loss = step(params, opt, images, labels)
+        params, opt, loss = compiled(params, opt, images, labels)
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
 
     images_per_sec = batch * steps / dt
-    print(json.dumps({
+    peak = _peak_for(dev.device_kind)
+    mfu = (flops_per_step / batch) * images_per_sec / peak if flops_per_step else 0.0
+    return {
+        "step_images_per_sec": round(images_per_sec, 2),
+        "mfu": round(mfu, 4),
+        "flops_per_image": round(flops_per_step / max(batch, 1), 0),
+        "hbm_gb_per_step": round(bytes_per_step / 1e9, 2),
+        "device_kind": dev.device_kind,
+        "peak_flops": peak,
+        "batch": batch,
+    }
+
+
+def phase_e2e() -> dict:
+    """Ingest -> train e2e: ray_tpu.data pipeline feeding a JaxTrainer."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import data as rd
+    from ray_tpu import train
+    from ray_tpu.air import RunConfig, ScalingConfig
+    from ray_tpu.train.jax import JaxTrainer
+
+    probe = os.environ.get("RAY_TPU_BENCH_PROBE") == "1"
+    n_blocks = 4 if probe else 16
+    rows_per_block = 16 if probe else 128
+    size = 64 if probe else 224
+    batch = 8 if probe else 128
+
+    def synth_block(row) -> list:
+        # Stands in for read+decode: produces raw uint8 image rows. One
+        # vectorized draw per block — the pipeline should be measuring the
+        # framework's data plane, not numpy's per-row RNG overhead.
+        seed = int(row["id"]) if isinstance(row, dict) else int(row)
+        rng = np.random.default_rng(seed)
+        block = rng.integers(
+            0, 255, (rows_per_block, size * size * 3), dtype=np.uint8
+        )
+        labels = rng.integers(0, 1000, rows_per_block)
+        return [
+            {"image": block[i].tobytes(), "label": int(labels[i])}
+            for i in range(rows_per_block)
+        ]
+
+    def train_fn(config):
+        import time
+
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.models import ResNetConfig, resnet_apply, resnet_init
+
+        size, batch = config["size"], config["batch"]
+        cfg = ResNetConfig(depth=50, num_classes=1000, dtype=jnp.bfloat16)
+        params = resnet_init(jax.random.PRNGKey(0), cfg)
+        tx = optax.sgd(0.1, momentum=0.9)
+        opt = tx.init(params)
+
+        def loss_fn(params, images, labels):
+            logits, new_params = resnet_apply(params, images, cfg, train=True)
+            logp = jax.nn.log_softmax(logits)
+            loss = -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+            return loss, new_params
+
+        @jax.jit
+        def step(params, opt, raw_u8, labels):
+            # Normalize on device: only uint8 crosses host->device.
+            images = raw_u8.astype(jnp.bfloat16) / 127.5 - 1.0
+            (loss, new_params), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params, images, labels)
+            updates, opt = tx.update(grads, opt, params)
+            params = optax.apply_updates(new_params, updates)
+            return params, opt, loss
+
+        shard = train.get_dataset_shard("train")
+        n = 0
+        t0 = None
+        for raw in shard.iter_batches(batch_size=batch, batch_format="numpy"):
+            imgs = np.stack(
+                [np.frombuffer(b, dtype=np.uint8) for b in raw["image"]]
+            ).reshape(-1, size, size, 3)
+            labels = np.asarray(raw["label"], dtype=np.int32)
+            params, opt, loss = step(params, opt, jnp.asarray(imgs), jnp.asarray(labels))
+            if t0 is None:
+                # Start the clock after the first step (compile time out).
+                jax.block_until_ready(loss)
+                t0 = time.perf_counter()
+                continue
+            n += len(imgs)
+        if t0 is None:
+            raise RuntimeError("dataset shard yielded no batches")
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        train.report({"e2e_images_per_sec": n / dt if dt > 0 else 0.0, "n": n})
+
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    try:
+        ds = rd.range(n_blocks, parallelism=4).flat_map(synth_block)
+        result = JaxTrainer(
+            train_fn,
+            train_loop_config={"size": size, "batch": batch},
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(name="bench_e2e", storage_path="/tmp/rt_bench_e2e"),
+            datasets={"train": ds},
+        ).fit()
+        return {
+            "e2e_images_per_sec": round(result.metrics["e2e_images_per_sec"], 2),
+            "e2e_images": result.metrics["n"],
+        }
+    finally:
+        ray_tpu.shutdown()
+
+
+def _run_phase(name: str) -> dict:
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--phase", name],
+        capture_output=True,
+        text=True,
+        timeout=1800,
+    )
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    raise RuntimeError(f"phase {name} produced no JSON: {out.stdout[-2000:]}\n{out.stderr[-2000:]}")
+
+
+def main():
+    if "--phase" in sys.argv:
+        idx = sys.argv.index("--phase")
+        phase = sys.argv[idx + 1] if idx + 1 < len(sys.argv) else ""
+        if phase not in ("step", "e2e"):
+            raise SystemExit(f"unknown --phase {phase!r}; expected 'step' or 'e2e'")
+        print(json.dumps(phase_step() if phase == "step" else phase_e2e()))
+        return
+    step = _run_phase("step")
+    try:
+        e2e = _run_phase("e2e")
+    except Exception as e:  # e2e must not mask the headline number
+        e2e = {"e2e_images_per_sec": 0.0, "e2e_error": str(e)[:500]}
+    out = {
         "metric": "resnet50_train_images_per_sec_1chip",
-        "value": round(images_per_sec, 2),
+        "value": step["step_images_per_sec"],
         "unit": "images/sec",
-        "vs_baseline": round(images_per_sec / BASELINE_IMAGES_PER_SEC, 2),
-    }))
+        # Baseline is the reference's e2e-with-ingest number; compare like
+        # with like.
+        "vs_baseline": round(
+            (e2e.get("e2e_images_per_sec") or 0.0) / BASELINE_IMAGES_PER_SEC, 2
+        ),
+        **{k: v for k, v in step.items() if k != "step_images_per_sec"},
+        **e2e,
+    }
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -121,9 +121,14 @@ def _conv(x, w, stride=1, dtype=jnp.bfloat16):
 def _bn(x, bn, train: bool, momentum=0.9, eps=1e-5):
     """Returns (y, new_stats). In train mode uses batch stats (the psum over
     data axes happens automatically because XLA sees the full sharded batch
-    under jit — stats are computed on the global batch)."""
-    xf = x.astype(jnp.float32)
+    under jit — stats are computed on the global batch).
+
+    Stats accumulate in f32; the normalization itself applies in the compute
+    dtype (bf16) with the per-channel affine folded to a single scale+bias —
+    ResNet training is HBM-bandwidth-bound on TPU, so activation-sized f32
+    intermediates are the thing to avoid."""
     if train:
+        xf = x.astype(jnp.float32)
         mean = xf.mean(axis=(0, 1, 2))
         var = xf.var(axis=(0, 1, 2))
         new = {
@@ -134,8 +139,10 @@ def _bn(x, bn, train: bool, momentum=0.9, eps=1e-5):
     else:
         mean, var = bn["mean"], bn["var"]
         new = bn
-    y = (xf - mean) * jax.lax.rsqrt(var + eps) * bn["scale"] + bn["bias"]
-    return y.astype(x.dtype), new
+    inv = jax.lax.rsqrt(var + eps)
+    scale = (bn["scale"] * inv).astype(x.dtype)
+    bias = (bn["bias"] - mean * bn["scale"] * inv).astype(x.dtype)
+    return x * scale + bias, new
 
 
 def resnet_apply(params, images, cfg: ResNetConfig, train: bool = False):

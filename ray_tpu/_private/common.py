@@ -68,6 +68,12 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     # free-span reuse makes steady-state puts hit warm pages anyway. Enable on
     # dedicated TPU hosts for cold-start-sensitive pipelines.
     "prefault_object_store": False,
+    # GCS fault tolerance: persist control-plane state to a session-scoped
+    # sqlite file so a restarted GCS resumes with its actor/PG/KV/job tables
+    # intact (reference: RedisStoreClient, redis_store_client.h:33). Cheap
+    # (WAL write-through of few-hundred-byte records); disable for pure
+    # in-memory control planes.
+    "gcs_persistence": True,
 }
 
 

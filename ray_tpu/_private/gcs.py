@@ -3,9 +3,11 @@
 TPU-native analog of the reference's gcs_server (src/ray/gcs/gcs_server/gcs_server.h:219):
 one asyncio process holding cluster state — node membership, actor FSM with
 restarts, placement-group 2PC, internal KV (which doubles as the function
-table), pubsub, and the job table. Persistence is in-memory (the reference's
-default StoreClient since Ray 2.x); a pluggable store interface keeps the
-Redis-equivalent door open.
+table), pubsub, and the job table. Persistence is pluggable (reference:
+store_client.h:33): in-memory by default, sqlite write-through for GCS fault
+tolerance — kill and restart the GCS and raylets/workers reconnect,
+re-register, and detached actors survive (analog of the Redis-backed FT mode
++ NotifyGCSRestart reconnect protocol, node_manager.proto:373).
 
 Health checking follows the reference's connection+liveness model
 (gcs_health_check_manager.cc): raylets hold a persistent RPC connection and
@@ -19,6 +21,8 @@ import asyncio
 import logging
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
+
+import msgpack
 
 from ray_tpu._private import rpc
 from ray_tpu._private.common import PlacementGroupSpec, ResourceSet, config
@@ -100,7 +104,15 @@ class PlacementGroupInfo:
 class GcsServer:
     """The control service. Start with `await GcsServer(...).start()`."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0, session_name: str = ""):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        session_name: str = "",
+        persist_path: Optional[str] = None,
+    ):
+        from ray_tpu._private.gcs_store import make_store
+
         self.server = rpc.Server(host, port)
         self.session_name = session_name
         self.nodes: Dict[str, NodeInfo] = {}
@@ -115,6 +127,11 @@ class GcsServer:
         self._wake_scheduler = asyncio.Event()
         self._scheduler_task: Optional[asyncio.Task] = None
         self._bg_tasks: List[asyncio.Task] = []
+        # Persistence (reference: StoreClient, store_client.h:33). The live
+        # state above stays the source of truth; mutations write through to
+        # the store, and a restarted GCS reloads it (GCS fault tolerance).
+        self.store = make_store(persist_path)
+        self._load_from_store()
         self._register_handlers()
 
     def _spawn(self, coro) -> asyncio.Task:
@@ -123,14 +140,114 @@ class GcsServer:
         self._bg_tasks = [t for t in self._bg_tasks if not t.done()]
         return task
 
+    # -- persistence (reference: gcs_table_storage.cc write-through) ---------
+
+    def _persist_actor(self, actor: ActorInfo) -> None:
+        rec = actor.to_wire()
+        rec["spec"] = actor.spec
+        self.store.put("actors", actor.actor_id, msgpack.packb(rec, use_bin_type=True))
+
+    def _persist_named(self) -> None:
+        rec = {f"{ns}\x00{name}": aid for (ns, name), aid in self.named_actors.items()}
+        self.store.put("named", "all", msgpack.packb(rec, use_bin_type=True))
+
+    def _persist_kv(self, ns: str, key: str, value: Optional[bytes]) -> None:
+        skey = f"{ns}\x00{key}"
+        if value is None:
+            self.store.delete("kv", skey)
+        else:
+            self.store.put("kv", skey, value)
+
+    def _persist_job(self, job_id: str) -> None:
+        self.store.put(
+            "jobs", job_id, msgpack.packb(self.jobs[job_id], use_bin_type=True)
+        )
+
+    def _persist_pg(self, pg: PlacementGroupInfo) -> None:
+        rec = {
+            "spec": pg.spec.to_wire(),
+            "state": pg.state,
+            "bundle_nodes": pg.bundle_nodes,
+        }
+        self.store.put("pgs", pg.spec.pg_id, msgpack.packb(rec, use_bin_type=True))
+
+    def _load_from_store(self) -> None:
+        """Reload control-plane state after a GCS restart. Node membership is
+        not persisted — raylets re-register over their reconnect loop."""
+        for skey, value in self.store.get_all("kv").items():
+            ns, _, key = skey.partition("\x00")
+            self.kv[(ns, key)] = value
+        for job_id, blob in self.store.get_all("jobs").items():
+            self.jobs[job_id] = msgpack.unpackb(blob, raw=False)
+        named = self.store.get_all("named").get("all")
+        if named:
+            for skey, aid in msgpack.unpackb(named, raw=False).items():
+                ns, _, name = skey.partition("\x00")
+                self.named_actors[(ns, name)] = aid
+        for actor_id, blob in self.store.get_all("actors").items():
+            rec = msgpack.unpackb(blob, raw=False)
+            actor = ActorInfo(actor_id, rec["spec"])
+            actor.state = rec["state"]
+            actor.addr = tuple(rec["addr"]) if rec.get("addr") else None
+            actor.worker_id = rec.get("worker_id")
+            actor.node_id = rec.get("node_id")
+            actor.num_restarts = rec.get("num_restarts", 0)
+            actor.max_restarts = rec.get("max_restarts", 0)
+            actor.death_cause = rec.get("death_cause")
+            self.actors[actor_id] = actor
+            if actor.state in (PENDING_CREATION, RESTARTING):
+                self._pending_actor_queue.append(actor_id)
+        for pg_id, blob in self.store.get_all("pgs").items():
+            rec = msgpack.unpackb(blob, raw=False)
+            pg = PlacementGroupInfo(PlacementGroupSpec.from_wire(rec["spec"]))
+            pg.state = rec["state"]
+            pg.bundle_nodes = rec.get("bundle_nodes") or pg.bundle_nodes
+            self.placement_groups[pg_id] = pg
+        if self._pending_actor_queue:
+            self._wake_scheduler.set()
+
     # -- lifecycle ----------------------------------------------------------
 
     async def start(self) -> Tuple[str, int]:
         addr = await self.server.start()
         self.server.on_disconnect(self._on_disconnect)
         self._scheduler_task = rpc.spawn(self._actor_scheduler_loop())
+        # Resume work interrupted by a restart: unplaced PGs re-enter the
+        # scheduling loop, and actors recorded ALIVE are reconciled against
+        # the nodes that actually re-register.
+        for pg in self.placement_groups.values():
+            if pg.state in ("PENDING", "RESCHEDULING"):
+                self._spawn(self._schedule_pg(pg))
+        if any(a.state == ALIVE for a in self.actors.values()):
+            self._spawn(self._reconcile_restored_actors())
         logger.info("gcs listening on %s:%s", *addr)
         return addr
+
+    async def _reconcile_restored_actors(self) -> None:
+        """Post-restart sweep: an actor restored as ALIVE whose node never
+        re-registered (or whose worker died during the outage) is treated as
+        a worker death, driving the normal restart/fail FSM."""
+        await asyncio.sleep(config.health_check_initial_delay_s)
+        for actor in list(self.actors.values()):
+            if actor.state != ALIVE:
+                continue
+            node = self.nodes.get(actor.node_id) if actor.node_id else None
+            dead = node is None or node.state != "ALIVE"
+            if not dead and actor.addr:
+                try:
+                    conn = node.conn
+                    reply = await conn.call(
+                        "KillWorker",
+                        {"worker_id": actor.worker_id, "probe": True},
+                        timeout=10,
+                    )
+                    dead = not reply.get("alive", False)
+                except rpc.RpcError:
+                    dead = True
+            if dead:
+                await self._on_actor_worker_death(
+                    actor, "node or worker lost while GCS was down"
+                )
 
     async def stop(self) -> None:
         if self._scheduler_task:
@@ -138,6 +255,7 @@ class GcsServer:
         for t in self._bg_tasks:
             t.cancel()
         await self.server.stop()
+        self.store.close()
 
     def _register_handlers(self) -> None:
         s = self.server
@@ -228,10 +346,23 @@ class GcsServer:
     async def _create_actor(self, conn, p):
         spec = p["spec"]
         actor_id = spec["actor_id"]
+        # Idempotent upsert: a retried CreateActor (e.g. across a GCS
+        # restart, where the reply was lost) must not double-enqueue or
+        # collide with its own name registration.
+        existing_self = self.actors.get(actor_id)
+        if existing_self is not None:
+            if p.get("wait_alive", True) and existing_self.state in (
+                PENDING_CREATION,
+                RESTARTING,
+            ):
+                fut = asyncio.get_running_loop().create_future()
+                existing_self.pending.append(fut)
+                return await fut
+            return {"actor": existing_self.to_wire()}
         actor = ActorInfo(actor_id, spec)
         if actor.name:
             key = (actor.namespace, actor.name)
-            if key in self.named_actors:
+            if key in self.named_actors and self.named_actors[key] != actor_id:
                 existing_id = self.named_actors[key]
                 existing = self.actors.get(existing_id)
                 if existing is not None and existing.state != DEAD:
@@ -239,7 +370,9 @@ class GcsServer:
                         return {"existing": True, "actor": existing.to_wire()}
                     raise rpc.RpcError(f"actor name {actor.name!r} already taken")
             self.named_actors[key] = actor_id
+            self._persist_named()
         self.actors[actor_id] = actor
+        self._persist_actor(actor)
         self._pending_actor_queue.append(actor_id)
         self._wake_scheduler.set()
         if p.get("wait_alive", True):
@@ -325,6 +458,7 @@ class GcsServer:
         actor.addr = tuple(p["addr"])
         actor.worker_id = p["worker_id"]
         actor.node_id = p["node_id"]
+        self._persist_actor(actor)
         result = {"actor": actor.to_wire()}
         for fut in actor.pending:
             if not fut.done():
@@ -347,6 +481,7 @@ class GcsServer:
                 actor.max_restarts,
                 cause,
             )
+            self._persist_actor(actor)
             await self._publish_msg(f"actor:{actor.actor_id}", actor.to_wire())
             self._pending_actor_queue.append(actor.actor_id)
             self._wake_scheduler.set()
@@ -365,6 +500,8 @@ class GcsServer:
         actor.pending.clear()
         if actor.name and self.named_actors.get((actor.namespace, actor.name)) == actor.actor_id:
             del self.named_actors[(actor.namespace, actor.name)]
+            self._persist_named()
+        self._persist_actor(actor)
         await self._publish_msg(f"actor:{actor.actor_id}", actor.to_wire())
 
     async def _report_worker_died(self, conn, p):
@@ -414,6 +551,7 @@ class GcsServer:
         no_restart = p.get("no_restart", True)
         if no_restart:
             actor.max_restarts = actor.num_restarts  # exhaust restarts
+            self._persist_actor(actor)
         node = self.nodes.get(actor.node_id) if actor.node_id else None
         if node is not None and node.state == "ALIVE" and actor.worker_id:
             try:
@@ -433,6 +571,7 @@ class GcsServer:
         if not p.get("overwrite", True) and key in self.kv:
             return {"added": False}
         self.kv[key] = p["value"]
+        self._persist_kv(key[0], key[1], p["value"])
         return {"added": True}
 
     async def _kv_get(self, conn, p):
@@ -444,8 +583,12 @@ class GcsServer:
             keys = [k for k in self.kv if k[0] == ns and k[1].startswith(p["key"])]
             for k in keys:
                 del self.kv[k]
+                self._persist_kv(k[0], k[1], None)
             return {"deleted": len(keys)}
-        return {"deleted": int(self.kv.pop((ns, p["key"]), None) is not None)}
+        removed = self.kv.pop((ns, p["key"]), None) is not None
+        if removed:
+            self._persist_kv(ns, p["key"], None)
+        return {"deleted": int(removed)}
 
     async def _kv_keys(self, conn, p):
         ns = p.get("ns") or ""
@@ -482,6 +625,7 @@ class GcsServer:
             "state": "RUNNING",
             "entrypoint": p.get("entrypoint", ""),
         }
+        self._persist_job(p["job_id"])
         return {"ok": True}
 
     async def _job_finished(self, conn, p):
@@ -489,6 +633,7 @@ class GcsServer:
         if job:
             job["state"] = "FINISHED"
             job["end_time"] = time.time()
+            self._persist_job(p["job_id"])
         # Kill non-detached actors owned by the job.
         for actor in list(self.actors.values()):
             if actor.job_id == p["job_id"] and not actor.detached and actor.state != DEAD:
@@ -504,6 +649,7 @@ class GcsServer:
         spec = PlacementGroupSpec.from_wire(p["spec"])
         pg = PlacementGroupInfo(spec)
         self.placement_groups[spec.pg_id] = pg
+        self._persist_pg(pg)
         self._spawn(self._schedule_pg(pg))
         if p.get("wait_ready"):
             fut = asyncio.get_running_loop().create_future()
@@ -536,6 +682,7 @@ class GcsServer:
                 if ok:
                     pg.state = "CREATED"
                     pg.bundle_nodes = placement
+                    self._persist_pg(pg)
                     for fut in pg.pending:
                         if not fut.done():
                             fut.set_result({"pg_id": spec.pg_id, "state": "CREATED"})
@@ -550,6 +697,7 @@ class GcsServer:
             # Record terminal state so later WaitPlacementGroupReady calls
             # fail fast instead of parking a future nothing will resolve.
             pg.state = "INFEASIBLE"
+            self._persist_pg(pg)
             for fut in pg.pending:
                 if not fut.done():
                     fut.set_exception(
@@ -679,6 +827,7 @@ class GcsServer:
         if pg is None:
             return {"ok": False}
         pg.state = "REMOVED"
+        self._persist_pg(pg)
         # Wake any WaitPlacementGroupReady waiters parked while pending.
         for fut in pg.pending:
             if not fut.done():
@@ -753,12 +902,58 @@ def _utilization(node: NodeInfo) -> float:
 
 
 class GcsClient:
-    """Typed async client for the GCS (used by raylets, workers, drivers)."""
+    """Typed async client for the GCS (used by raylets, workers, drivers).
+
+    Reconnecting: when the GCS restarts (fault-tolerance mode), calls redial
+    the same address, re-subscribe pubsub channels, and fire registered
+    ``on_reconnect`` callbacks (raylets re-register their node there).
+    Analog of the reference's reconnect protocol around GCS restarts
+    (NotifyGCSRestart, node_manager.proto:373; retryable gRPC client)."""
+
+    # How long callers wait out a GCS restart before giving up.
+    RECONNECT_TIMEOUT_S = 30.0
 
     def __init__(self, conn: rpc.Connection):
         self.conn = conn
         self._sub_handlers: Dict[str, List] = {}
-        conn._handlers.setdefault("Pub", self._on_pub)
+        self._handlers = conn._handlers
+        self._handlers.setdefault("Pub", self._on_pub)
+        self._reconnect_lock: Optional[asyncio.Lock] = None
+        self._on_reconnect: List = []
+
+    def on_reconnect(self, fn) -> None:
+        """Register ``async fn(client)`` run after every successful redial."""
+        self._on_reconnect.append(fn)
+
+    async def _ensure_connected(self) -> rpc.Connection:
+        if not self.conn.closed:
+            return self.conn
+        if self._reconnect_lock is None:
+            self._reconnect_lock = asyncio.Lock()
+        async with self._reconnect_lock:
+            if not self.conn.closed:
+                return self.conn
+            addr = self.conn.remote_addr or self.conn.peername
+            if addr is None:
+                raise rpc.ConnectionLost("gcs connection lost (no address to redial)")
+            conn = await rpc.connect(
+                addr[0],
+                addr[1],
+                handlers=self._handlers,
+                retry=int(self.RECONNECT_TIMEOUT_S / 0.25),
+                retry_interval=0.25,
+            )
+            conn.remote_addr = tuple(addr)
+            self.conn = conn
+            for channel in self._sub_handlers:
+                await conn.call("Subscribe", {"channel": channel})
+            for fn in self._on_reconnect:
+                try:
+                    await fn(self)
+                except Exception:
+                    logger.exception("gcs on_reconnect callback failed")
+            logger.info("reconnected to gcs at %s:%s", *addr)
+            return conn
 
     async def _on_pub(self, conn, p):
         for fn in self._sub_handlers.get(p["channel"], []):
@@ -771,22 +966,23 @@ class GcsClient:
 
     async def subscribe(self, channel: str, handler) -> None:
         self._sub_handlers.setdefault(channel, []).append(handler)
-        await self.conn.call("Subscribe", {"channel": channel})
+        conn = await self._ensure_connected()
+        await conn.call("Subscribe", {"channel": channel})
 
     async def publish(self, channel: str, msg) -> None:
-        await self.conn.call("Publish", {"channel": channel, "msg": msg})
+        await self.call("Publish", {"channel": channel, "msg": msg})
 
     async def kv_put(self, key: str, value: bytes, ns: str = "", overwrite=True) -> bool:
-        r = await self.conn.call(
+        r = await self.call(
             "KVPut", {"ns": ns, "key": key, "value": value, "overwrite": overwrite}
         )
         return r["added"]
 
     async def kv_get(self, key: str, ns: str = "") -> Optional[bytes]:
-        return (await self.conn.call("KVGet", {"ns": ns, "key": key}))["value"]
+        return (await self.call("KVGet", {"ns": ns, "key": key}))["value"]
 
     async def kv_del(self, key: str, ns: str = "", prefix=False) -> int:
-        return (await self.conn.call("KVDel", {"ns": ns, "key": key, "prefix": prefix}))[
+        return (await self.call("KVDel", {"ns": ns, "key": key, "prefix": prefix}))[
             "deleted"
         ]
 
@@ -794,7 +990,14 @@ class GcsClient:
         return (await self.call("KVExists", {"key": key, "ns": ns}))["exists"]
 
     async def kv_keys(self, prefix: str = "", ns: str = "") -> List[str]:
-        return (await self.conn.call("KVKeys", {"ns": ns, "prefix": prefix}))["keys"]
+        return (await self.call("KVKeys", {"ns": ns, "prefix": prefix}))["keys"]
 
-    def call(self, method: str, payload=None, timeout=None):
-        return self.conn.call(method, payload, timeout)
+    async def call(self, method: str, payload=None, timeout=None):
+        conn = await self._ensure_connected()
+        try:
+            return await conn.call(method, payload, timeout)
+        except rpc.ConnectionLost:
+            # One transparent retry across a GCS restart. Safe: every GCS
+            # handler is an idempotent upsert/read against keyed state.
+            conn = await self._ensure_connected()
+            return await conn.call(method, payload, timeout)

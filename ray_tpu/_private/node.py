@@ -18,6 +18,7 @@ import secrets
 import time
 from typing import Dict, Optional, Tuple
 
+from ray_tpu._private.common import config
 from ray_tpu._private.gcs import GcsServer
 from ray_tpu._private.raylet import Raylet
 
@@ -57,9 +58,22 @@ class Node:
         self.labels = labels
         self.worker_env = worker_env
 
+    def gcs_persist_path(self) -> str:
+        """Session-scoped sqlite file backing GCS fault tolerance."""
+        import tempfile
+
+        return os.path.join(
+            tempfile.gettempdir(), f"ray_tpu_{self.session_name}", "gcs.db"
+        )
+
     async def start(self) -> None:
         if self.head:
-            self.gcs_server = GcsServer(session_name=self.session_name)
+            self.gcs_server = GcsServer(
+                session_name=self.session_name,
+                persist_path=(
+                    self.gcs_persist_path() if config.gcs_persistence else None
+                ),
+            )
             self.gcs_addr = await self.gcs_server.start()
         assert self.gcs_addr is not None
         self.raylet = Raylet(
@@ -77,6 +91,35 @@ class Node:
             await self.raylet.stop()
         if self.gcs_server is not None:
             await self.gcs_server.stop()
+            if self.head and config.gcs_persistence:
+                # Final shutdown: the session is over, drop its durable state
+                # (restarts go through kill_gcs/restart_gcs, not stop()).
+                import shutil
+
+                shutil.rmtree(
+                    os.path.dirname(self.gcs_persist_path()), ignore_errors=True
+                )
+
+    async def kill_gcs(self) -> None:
+        """Fault-injection: stop the GCS process, keeping raylets/workers up."""
+        assert self.gcs_server is not None
+        await self.gcs_server.stop()
+
+    async def restart_gcs(self) -> None:
+        """Restart the GCS on the same address from its persisted state.
+        Raylets re-register over their reconnecting GCS clients; detached
+        actors and KV survive (reference: GCS FT with Redis persistence +
+        NotifyGCSRestart, node_manager.proto:373)."""
+        assert self.gcs_addr is not None
+        self.gcs_server = GcsServer(
+            host=self.gcs_addr[0],
+            port=self.gcs_addr[1],
+            session_name=self.session_name,
+            persist_path=(
+                self.gcs_persist_path() if config.gcs_persistence else None
+            ),
+        )
+        await self.gcs_server.start()
 
     @property
     def node_id(self) -> str:

@@ -198,15 +198,26 @@ class Raylet:
         # KillWorker, PG prepare/commit), so expose our handlers on it.
         conn = await rpc.connect(*self.gcs_addr, handlers=self.server._handlers)
         self.gcs = GcsClient(conn)
-        await self.gcs.call(
-            "RegisterNode",
-            {
-                "node_id": self.node_id,
-                "addr": list(addr),
-                "resources": self.total.to_units(),
-                "labels": self.labels,
-            },
-        )
+        self.addr = addr
+
+        async def _register(client) -> None:
+            # Initial registration AND post-GCS-restart re-registration
+            # (reference: raylet side of NotifyGCSRestart,
+            # node_manager.proto:373): a restarted GCS has no node table
+            # until every raylet re-announces itself.
+            await client.conn.call(
+                "RegisterNode",
+                {
+                    "node_id": self.node_id,
+                    "addr": list(self.addr),
+                    "resources": self.total.to_units(),
+                    "labels": self.labels,
+                },
+            )
+            self._mark_dirty()
+
+        self.gcs.on_reconnect(_register)
+        await _register(self.gcs)
         self._tasks.append(rpc.spawn(self._resource_report_loop()))
         self._tasks.append(rpc.spawn(self._condemned_sweep_loop()))
         if config.memory_monitor_interval_s > 0:
@@ -552,6 +563,10 @@ class Raylet:
 
     async def _kill_worker(self, conn, p):
         handle = self.workers.get(p["worker_id"])
+        if p.get("probe"):
+            # Liveness probe only (GCS post-restart actor reconciliation).
+            alive = handle is not None and handle.proc.returncode is None
+            return {"ok": True, "alive": alive}
         if handle is None:
             return {"ok": False}
         self._kill_worker_proc(handle)

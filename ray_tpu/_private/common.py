@@ -74,6 +74,9 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     # (WAL write-through of few-hundred-byte records); disable for pure
     # in-memory control planes.
     "gcs_persistence": True,
+    # Echo captured worker stdout/stderr to the driver (reference:
+    # ray.init(log_to_driver=True) + log_monitor.py streaming).
+    "log_to_driver": True,
 }
 
 
@@ -206,6 +209,7 @@ class TaskSpec:
     caller_id: Optional[str] = None
     max_restarts: int = 0
     max_concurrency: int = 1
+    max_task_retries: int = 0
     # Placement.
     pg_id: Optional[str] = None
     bundle_index: int = -1

@@ -1068,7 +1068,8 @@ class CoreWorker:
         if entry is None:
             raise ObjectLostError(
                 f"object {oid[:12]} lost and has no lineage "
-                "(ray.put objects and actor-task returns are not reconstructable)"
+                "(ray.put objects and non-retriable actor-task returns are "
+                "not reconstructable)"
             )
         task_id = entry["wire"]["task_id"]
         fut = self._recovering.get(task_id)
@@ -1098,7 +1099,12 @@ class CoreWorker:
         for dep_oid, _ in wire["dependencies"]:
             self.reference_table.add_submitted(dep_oid)
         try:
-            await self._run_task(wire)
+            if wire.get("actor_id"):
+                # Actor-task return: resubmit through the (restarted) actor
+                # (reference: task_manager.cc actor-task resubmission).
+                await self._run_actor_task(wire)
+            else:
+                await self._run_task(wire)
             fut.set_result(None)
         except BaseException as e:
             fut.set_exception(e)
@@ -1581,6 +1587,7 @@ class CoreWorker:
         resources: Optional[Dict[str, float]] = None,
         max_restarts: int = 0,
         max_concurrency: int = 1,
+        max_task_retries: int = 0,
         name: Optional[str] = None,
         namespace: Optional[str] = None,
         lifetime: Optional[str] = None,
@@ -1627,6 +1634,7 @@ class CoreWorker:
             actor_creation=True,
             max_restarts=max_restarts,
             max_concurrency=max_concurrency,
+            max_task_retries=max_task_retries,
             pg_id=pg_id,
             bundle_index=bundle_index,
             scheduling_strategy=strategy,
@@ -1653,6 +1661,7 @@ class CoreWorker:
     def _actor_wire(
         self, actor_id, method_name, args_blob, args_object,
         ref_pos, kw_refs, deps, num_returns, return_ids, task_id,
+        max_task_retries=0,
     ) -> dict:
         return {
             "task_id": task_id,
@@ -1667,7 +1676,7 @@ class CoreWorker:
             "num_returns": num_returns,
             "return_ids": return_ids,
             "resources": {},
-            "max_retries": 0,
+            "max_retries": max_task_retries,
             "retry_exceptions": False,
             "owner_addr": list(self.addr),
             "actor_id": actor_id,
@@ -1688,6 +1697,7 @@ class CoreWorker:
         args: tuple,
         kwargs: dict,
         num_returns: int = 1,
+        max_task_retries: int = 0,
     ) -> List[ObjectRef]:
         task_id = fast_unique_hex()
         return_ids = return_object_ids(task_id, num_returns)
@@ -1702,6 +1712,7 @@ class CoreWorker:
         wire = self._actor_wire(
             actor_id, method_name, args_blob, args_object,
             ref_pos, kw_refs, deps, num_returns, return_ids, task_id,
+            max_task_retries,
         )
         refs = []
         for oid in return_ids:
@@ -1724,6 +1735,7 @@ class CoreWorker:
         *,
         loop,
         num_returns: int = 1,
+        max_task_retries: int = 0,
     ) -> Optional[List[ObjectRef]]:
         """Synchronous actor-call fast path (see try_submit_task_fast)."""
         serialized, ref_pos, kw_refs, deps = self._prepare_args(args, kwargs)
@@ -1734,6 +1746,7 @@ class CoreWorker:
         wire = self._actor_wire(
             actor_id, method_name, serialized.to_bytes(), None,
             ref_pos, kw_refs, deps, num_returns, return_ids, task_id,
+            max_task_retries,
         )
         refs = []
         mark_owned = self.reference_table.mark_owned
@@ -1778,6 +1791,10 @@ class CoreWorker:
             fut = conn.call_nowait("PushActorTask", {"spec": wire})
         except rpc.ConnectionLost:
             sub.conn = None
+            if wire.get("max_retries", 0) > wire.get("_attempt", 0):
+                wire["_attempt"] = wire.get("_attempt", 0) + 1
+                self._spawn_actor_slow(wire)
+                return
             self._finish_task_error(
                 wire,
                 ActorUnavailableError(
@@ -1793,9 +1810,28 @@ class CoreWorker:
     def _on_actor_reply(self, wire: dict, sub: ActorSubmitter, fut) -> None:
         exc = fut.exception() if not fut.cancelled() else rpc.ConnectionLost("cancelled")
         if exc is None:
-            self._store_task_results(wire, fut.result())
-        elif isinstance(exc, rpc.ConnectionLost):
+            reply = fut.result()
+            self._store_task_results(wire, reply)
+            if reply.get("error") is None and wire.get("max_retries", 0) > 0:
+                # Actor-task lineage: retriable methods register their
+                # plasma-resident returns for reconstruction through the
+                # (possibly restarted) actor (reference: task_manager.cc
+                # resubmit of actor tasks with max_task_retries > 0).
+                self._register_lineage(wire, reply)
+            self._cleanup_task(wire)
+            return
+        if isinstance(exc, rpc.ConnectionLost):
             sub.conn = None
+            if wire.get("max_retries", 0) > wire.get("_attempt", 0):
+                # Resubmit through the slow path: it re-resolves the actor
+                # (waiting out a restart) before pushing again.
+                wire["_attempt"] = wire.get("_attempt", 0) + 1
+                self.record_task_event(
+                    wire["task_id"], wire["name"], "RETRY",
+                    attempt=wire["_attempt"],
+                )
+                self._spawn_actor_slow(wire)
+                return
             self._store_task_error(
                 wire,
                 ActorUnavailableError(
@@ -1814,10 +1850,26 @@ class CoreWorker:
         try:
             try:
                 await self._wait_for_deps(wire["dependencies"])
-                reply = await sub.submit(wire)
+                attempts = wire.get("max_retries", 0) + 1
+                attempt = wire.get("_attempt", 0)
+                while True:
+                    try:
+                        reply = await sub.submit(wire)
+                        break
+                    except (ActorUnavailableError, rpc.ConnectionLost) as e:
+                        attempt += 1
+                        wire["_attempt"] = attempt
+                        if attempt >= attempts:
+                            raise
+                        self.record_task_event(
+                            wire["task_id"], wire["name"], "RETRY", attempt=attempt
+                        )
+                        await asyncio.sleep(min(1.0, 0.2 * attempt))
             finally:
                 sub.pending_slow -= 1
             self._store_task_results(wire, reply)
+            if reply.get("error") is None and wire.get("max_retries", 0) > 0:
+                self._register_lineage(wire, reply)
         except Exception as e:
             self._store_task_error(wire, e)
         finally:

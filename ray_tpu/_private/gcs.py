@@ -90,6 +90,7 @@ class ActorInfo:
             "job_id": self.job_id,
             "death_cause": self.death_cause,
             "class_name": self.spec.get("name"),
+            "max_task_retries": self.spec.get("max_task_retries", 0),
         }
 
 
@@ -920,17 +921,29 @@ class GcsClient:
         self._handlers.setdefault("Pub", self._on_pub)
         self._reconnect_lock: Optional[asyncio.Lock] = None
         self._on_reconnect: List = []
+        self._closed = False
 
     def on_reconnect(self, fn) -> None:
         """Register ``async fn(client)`` run after every successful redial."""
         self._on_reconnect.append(fn)
 
+    async def close(self) -> None:
+        """Terminal close: no reconnection afterwards. A stopping raylet must
+        call this first, or a straggler RPC resurrects the 'dead' node in the
+        GCS by re-registering through the reconnect path."""
+        self._closed = True
+        await self.conn.close()
+
     async def _ensure_connected(self) -> rpc.Connection:
+        if self._closed:
+            raise rpc.ConnectionLost("gcs client closed")
         if not self.conn.closed:
             return self.conn
         if self._reconnect_lock is None:
             self._reconnect_lock = asyncio.Lock()
         async with self._reconnect_lock:
+            if self._closed:
+                raise rpc.ConnectionLost("gcs client closed")
             if not self.conn.closed:
                 return self.conn
             addr = self.conn.remote_addr or self.conn.peername

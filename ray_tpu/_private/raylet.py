@@ -66,6 +66,7 @@ class WorkerHandle:
         self.registered = asyncio.get_running_loop().create_future()
         self.lease_id: Optional[str] = None
         self.actor_id: Optional[str] = None
+        self.job_id: Optional[str] = None
         self.demand: Optional[ResourceSet] = None
         self.idle_since = time.monotonic()
 
@@ -144,6 +145,15 @@ class Raylet:
         )
         self.spill_dir = os.path.join(
             base, f"{self.session_name[:16]}_{self.node_id[:8]}"
+        )
+        # Per-worker stdout/stderr files (reference: session_latest/logs).
+        import tempfile
+
+        self.log_dir = os.path.join(
+            tempfile.gettempdir(),
+            f"ray_tpu_{self.session_name}",
+            "logs",
+            self.node_id[:8],
         )
         # Client holds (plasma's per-client buffer refcounts,
         # plasma/client.h): ObjGet increments for the calling connection,
@@ -232,6 +242,8 @@ class Raylet:
         return addr
 
     async def stop(self) -> None:
+        if self.gcs is not None:
+            await self.gcs.close()  # before anything else: no re-registration
         for t in self._tasks:
             t.cancel()
         for w in list(self.workers.values()):
@@ -272,10 +284,44 @@ class Raylet:
         s.register("CommitPGBundles", self._commit_pg)
         s.register("ReleasePGBundles", self._release_pg)
         s.register("GetNodeStats", self._node_stats)
+        s.register("GetLog", self._get_log)
+        s.register("ListLogs", self._list_logs)
         s.register("Ping", self._ping)
 
     async def _ping(self, conn, p):
         return {"pong": True, "node_id": self.node_id}
+
+    async def _list_logs(self, conn, p):
+        """Log files captured on this node (reference: state API list_logs)."""
+        try:
+            names = sorted(os.listdir(self.log_dir))
+        except OSError:
+            names = []
+        return {"node_id": self.node_id, "files": names}
+
+    async def _get_log(self, conn, p):
+        """Tail of one captured log (reference: state API get_log,
+        python/ray/util/state/api.py:1183). Accepts a filename from
+        ListLogs or a worker_id (+ stream)."""
+        filename = p.get("filename")
+        if filename is None and p.get("worker_id"):
+            filename = os.path.basename(
+                self._log_path(p["worker_id"], p.get("stream", "stderr"))
+            )
+        if filename is None or "/" in filename or ".." in filename:
+            raise rpc.RpcError("GetLog needs a valid filename or worker_id")
+        path = os.path.join(self.log_dir, filename)
+        tail = int(p.get("tail") or 1000)
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - max(tail, 1) * 200))
+                data = f.read()
+        except OSError:
+            return {"lines": [], "found": False}
+        lines = data.decode("utf-8", "replace").splitlines()
+        return {"lines": lines[-tail:], "found": True}
 
     # -- resource reporting --------------------------------------------------
 
@@ -335,13 +381,97 @@ class Raylet:
             "-m",
             "ray_tpu._private.worker_main",
             env=env,
-            stdout=None,
-            stderr=None,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE,
         )
         handle = WorkerHandle(worker_id, proc)
         self.workers[worker_id] = handle
+        # Log pipeline (reference: log_monitor.py tailing session/logs/*):
+        # worker output goes to per-worker session log files AND streams to
+        # the driver via GCS pubsub.
+        rpc.spawn(self._pump_worker_logs(handle, proc.stdout, "stdout"))
+        rpc.spawn(self._pump_worker_logs(handle, proc.stderr, "stderr"))
         rpc.spawn(self._reap_worker(handle))
         return handle
+
+    def _log_path(self, worker_id: str, stream: str) -> str:
+        return os.path.join(
+            self.log_dir, f"worker-{worker_id[:12]}.{'out' if stream == 'stdout' else 'err'}"
+        )
+
+    async def _pump_worker_logs(self, handle: WorkerHandle, pipe, stream: str) -> None:
+        """Tail one worker pipe: append to the session log file, batch lines
+        to the GCS ``logs`` pubsub channel (driver-side echo). Reference:
+        python/ray/_private/log_monitor.py + worker stdout redirection."""
+        os.makedirs(self.log_dir, exist_ok=True)
+        path = self._log_path(handle.worker_id, stream)
+        buf: List[str] = []
+        last_flush = 0.0
+
+        async def flush():
+            nonlocal buf, last_flush
+            if not buf or self.gcs is None:
+                buf = []
+                return
+            lines, buf = buf, []
+            last_flush = time.monotonic()
+            try:
+                await self.gcs.call(
+                    "Publish",
+                    {
+                        "channel": "logs",
+                        "msg": {
+                            "worker_id": handle.worker_id,
+                            "node_id": self.node_id,
+                            "pid": handle.proc.pid,
+                            "stream": stream,
+                            "lines": lines,
+                            "actor_id": handle.actor_id,
+                            # Job attribution: known for actor workers (the
+                            # creation spec carries job_id); pooled task
+                            # workers serve whatever job leases them, so
+                            # their lines are unattributed.
+                            "job_id": handle.job_id,
+                        },
+                    },
+                )
+            except rpc.RpcError:
+                pass
+
+        carry = b""
+        try:
+            with open(path, "ab", buffering=0) as f:
+                while True:
+                    # Chunked read (not readline): immune to asyncio's 64 KiB
+                    # line limit — a worker print()ing a huge repr must never
+                    # kill the pump (an undrained pipe wedges the worker).
+                    try:
+                        chunk = await asyncio.wait_for(pipe.read(65536), timeout=0.5)
+                    except asyncio.TimeoutError:
+                        if buf and time.monotonic() - last_flush > 0.2:
+                            await flush()
+                        continue
+                    if not chunk:
+                        break
+                    f.write(chunk)
+                    carry += chunk
+                    if len(carry) > (1 << 20):
+                        # Pathological single line: ship it in pieces.
+                        buf.append(carry.decode("utf-8", "replace"))
+                        carry = b""
+                    elif b"\n" in carry:
+                        *lines, carry = carry.split(b"\n")
+                        buf.extend(ln.decode("utf-8", "replace") for ln in lines)
+                    if buf and (
+                        len(buf) >= 100 or time.monotonic() - last_flush > 0.2
+                    ):
+                        await flush()
+        except (OSError, ValueError, asyncio.CancelledError):
+            pass
+        finally:
+            if carry:
+                buf.append(carry.decode("utf-8", "replace"))
+            await flush()
 
     async def _reap_worker(self, handle: WorkerHandle) -> None:
         await handle.proc.wait()
@@ -554,6 +684,7 @@ class Raylet:
             return reply
         handle = self.leases[req.lease_id]
         handle.actor_id = spec["actor_id"]
+        handle.job_id = spec.get("job_id")
         try:
             await handle.conn.call("CreateActor", {"spec": spec}, timeout=300)
         except rpc.RpcError as e:

@@ -19,7 +19,7 @@ import threading
 from typing import Any, Dict, List, Optional, Sequence
 
 from ray_tpu._private import rpc
-from ray_tpu._private.common import RayTpuError
+from ray_tpu._private.common import RayTpuError, config
 from ray_tpu._private.core_worker import CoreWorker, ObjectRef
 from ray_tpu._private.ids import JobID, WorkerID
 from ray_tpu._private.node import Node
@@ -171,6 +171,10 @@ def init(
             await core.gcs.call(
                 "RegisterJob", {"job_id": job_id, "driver_addr": list(addr)}
             )
+            if config.log_to_driver:
+                await core.gcs.subscribe(
+                    "logs", lambda msg: _print_worker_log(msg, job_id)
+                )
             return node, core, gcs_addr
 
         node, core, gcs_addr = w.run_async(_bring_up(), timeout=120)
@@ -179,6 +183,23 @@ def init(
         w.mode = "driver"
         atexit.register(shutdown)
         return {"address": f"{gcs_addr[0]}:{gcs_addr[1]}", "session": core.session_name}
+
+
+def _print_worker_log(msg: dict, my_job_id: Optional[str] = None) -> None:
+    """Echo a worker-log pubsub batch onto the driver's stderr (reference:
+    log_to_driver via log_monitor.py -> print_to_stdstream). Prefix mirrors
+    the reference's ``(pid=..., ip=...)`` tag. Batches attributed to another
+    job are dropped; unattributed batches (pooled task workers) are echoed
+    to every driver."""
+    import sys as _sys
+
+    batch_job = msg.get("job_id")
+    if batch_job is not None and my_job_id is not None and batch_job != my_job_id:
+        return
+    tag = f"(pid={msg.get('pid')}, worker={str(msg.get('worker_id'))[:8]})"
+    out = _sys.stderr
+    for line in msg.get("lines") or []:
+        print(f"{tag} {line}", file=out)
 
 
 def cluster_state_file() -> str:
@@ -336,7 +357,7 @@ def get_actor(name: str, namespace: Optional[str] = None):
     info = reply["actor"]
     if info is None or info["state"] == "DEAD":
         raise ValueError(f"no live actor named {name!r}")
-    return ActorHandle(info["actor_id"])
+    return ActorHandle(info["actor_id"], info.get("max_task_retries", 0))
 
 
 def nodes() -> List[dict]:

@@ -19,22 +19,37 @@ from ray_tpu.remote_function import _build_resources, _strategy_fields
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+    def __init__(
+        self,
+        handle: "ActorHandle",
+        name: str,
+        num_returns: int = 1,
+        max_task_retries: Optional[int] = None,
+    ):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._max_task_retries = max_task_retries
 
-    def options(self, *, num_returns: int = 1) -> "ActorMethod":
-        return ActorMethod(self._handle, self._name, num_returns)
+    def options(
+        self, *, num_returns: int = 1, max_task_retries: Optional[int] = None
+    ) -> "ActorMethod":
+        return ActorMethod(self._handle, self._name, num_returns, max_task_retries)
 
     def remote(self, *args, **kwargs):
         core = worker_mod._core()
+        retries = (
+            self._max_task_retries
+            if self._max_task_retries is not None
+            else self._handle._max_task_retries
+        )
         refs = core.try_submit_actor_task_fast(
             self._handle._actor_id,
             self._name,
             args,
             kwargs,
             num_returns=self._num_returns,
+            max_task_retries=retries,
             loop=worker_mod.global_worker.loop,
         )
         if refs is None:  # large args need the async plasma path
@@ -45,6 +60,7 @@ class ActorMethod:
                     args,
                     kwargs,
                     num_returns=self._num_returns,
+                    max_task_retries=retries,
                 )
             )
         if self._num_returns == 1:
@@ -64,8 +80,11 @@ class ActorMethod:
 
 
 class ActorHandle:
-    def __init__(self, actor_id: str):
+    def __init__(self, actor_id: str, max_task_retries: int = 0):
         self._actor_id = actor_id
+        # Default per-method retry budget (reference: @ray.remote
+        # max_task_retries on the actor class; rides handle serialization).
+        self._max_task_retries = max_task_retries
 
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):
@@ -76,7 +95,7 @@ class ActorHandle:
         return f"ActorHandle({self._actor_id[:16]})"
 
     def __reduce__(self):
-        return (ActorHandle, (self._actor_id,))
+        return (ActorHandle, (self._actor_id, self._max_task_retries))
 
     def __hash__(self):
         return hash(self._actor_id)
@@ -117,6 +136,7 @@ class ActorClass:
                 resources=resources,
                 max_restarts=opts.get("max_restarts", 0),
                 max_concurrency=opts.get("max_concurrency", 1),
+                max_task_retries=opts.get("max_task_retries", 0),
                 name=opts.get("name"),
                 namespace=opts.get("namespace") or worker_mod.global_worker.namespace,
                 lifetime=opts.get("lifetime"),
@@ -128,7 +148,7 @@ class ActorClass:
             ),
             timeout=300,
         )
-        return ActorHandle(actor_id)
+        return ActorHandle(actor_id, opts.get("max_task_retries", 0))
 
     def __call__(self, *args, **kwargs):
         raise TypeError(

@@ -75,6 +75,7 @@ class Dashboard:
         app.router.add_get("/api/jobs", self._jobs)
         app.router.add_get("/api/placement_groups", self._pgs)
         app.router.add_get("/api/tasks", self._tasks)
+        app.router.add_get("/api/logs", self._logs)
         app.router.add_get("/api/tasks/summary", self._task_summary)
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/-/healthz", self._healthz)
@@ -143,6 +144,50 @@ class Dashboard:
 
         reply = await self._gcs("ListTaskEvents", {"limit": 5000})
         return web.json_response(reply)
+
+    async def _logs(self, request):
+        """Log viewer endpoint: ?node_id=&filename=&worker_id=&tail= —
+        proxies the raylet GetLog/ListLogs RPCs (reference: dashboard log
+        module + state API get_log)."""
+        from aiohttp import web
+
+        from ray_tpu._private import rpc
+
+        q = request.query
+        nodes = (await self._gcs("GetAllNodes"))["nodes"]
+        out = {}
+        for n in nodes:
+            if n["state"] != "ALIVE":
+                continue
+            if q.get("node_id") and n["node_id"] != q["node_id"]:
+                continue
+            try:
+                conn = await rpc.connect(*n["addr"], retry=2)
+            except rpc.RpcError:
+                continue
+            try:
+                if q.get("filename") or q.get("worker_id"):
+                    try:
+                        tail = min(int(q.get("tail", 1000)), 100000)
+                    except ValueError:
+                        return web.json_response(
+                            {"error": "tail must be an integer"}, status=400
+                        )
+                    reply = await conn.call(
+                        "GetLog",
+                        {
+                            "filename": q.get("filename"),
+                            "worker_id": q.get("worker_id"),
+                            "stream": q.get("stream", "stderr"),
+                            "tail": tail,
+                        },
+                    )
+                else:
+                    reply = await conn.call("ListLogs", {})
+                out[n["node_id"]] = reply
+            finally:
+                await conn.close()
+        return web.json_response(out)
 
     async def _metrics(self, request):
         """Prometheus text exposition merged across all workers (the

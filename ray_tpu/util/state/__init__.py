@@ -2,8 +2,10 @@
 python/ray/util/state)."""
 
 from ray_tpu.util.state.api import (
+    get_log,
     list_actors,
     list_jobs,
+    list_logs,
     list_nodes,
     list_objects,
     list_placement_groups,
@@ -16,8 +18,10 @@ from ray_tpu.util.state.api import (
 )
 
 __all__ = [
+    "get_log",
     "list_actors",
     "list_jobs",
+    "list_logs",
     "list_nodes",
     "list_objects",
     "list_placement_groups",

@@ -98,6 +98,64 @@ def _each_raylet(payload: dict) -> List[dict]:
     return worker_mod.global_worker.run_async(_collect())
 
 
+def list_logs(node_id: Optional[str] = None) -> Dict[str, List[str]]:
+    """Log files captured per node (reference: ray.util.state.list_logs)."""
+    core = worker_mod._core()
+
+    async def _collect():
+        out = {}
+        for n in (await core.gcs.call("GetAllNodes"))["nodes"]:
+            if n["state"] != "ALIVE":
+                continue
+            if node_id is not None and n["node_id"] != node_id:
+                continue
+            try:
+                conn = await core.connect_to(tuple(n["addr"]))
+                reply = await conn.call("ListLogs", {})
+                out[n["node_id"]] = reply["files"]
+            except Exception:
+                pass
+        return out
+
+    return worker_mod.global_worker.run_async(_collect())
+
+
+def get_log(
+    node_id: Optional[str] = None,
+    filename: Optional[str] = None,
+    worker_id: Optional[str] = None,
+    stream: str = "stderr",
+    tail: int = 1000,
+) -> List[str]:
+    """Tail of a captured worker log (reference: ray.util.state.get_log,
+    python/ray/util/state/api.py:1183). Identify the log by filename (from
+    list_logs) or worker_id; with no node_id every node is asked."""
+    core = worker_mod._core()
+
+    async def _collect():
+        payload = {
+            "filename": filename,
+            "worker_id": worker_id,
+            "stream": stream,
+            "tail": tail,
+        }
+        for n in (await core.gcs.call("GetAllNodes"))["nodes"]:
+            if n["state"] != "ALIVE":
+                continue
+            if node_id is not None and n["node_id"] != node_id:
+                continue
+            try:
+                conn = await core.connect_to(tuple(n["addr"]))
+                reply = await conn.call("GetLog", payload)
+            except Exception:
+                continue
+            if reply.get("found"):
+                return reply["lines"]
+        return []
+
+    return worker_mod.global_worker.run_async(_collect())
+
+
 def list_workers(filters=None, limit: int = 10000) -> List[dict]:
     rows: List[dict] = []
     for stats in _each_raylet({"include_workers": True}):

@@ -124,3 +124,61 @@ def test_put_objects_are_not_reconstructable(cluster_with_victim):
     time.sleep(0.5)
     with pytest.raises(ray_tpu.RayTpuError):
         ray_tpu.get(inner_ref, timeout=30)
+
+
+def test_reconstruct_actor_task_return(cluster_with_victim):
+    """Actor-task returns with max_task_retries>0 are reconstructable by
+    resubmitting through the restarted actor (reference:
+    task_manager.cc actor-task resubmission)."""
+    cluster = cluster_with_victim
+
+    @ray_tpu.remote
+    class Producer:
+        def produce(self):
+            return np.ones(SIZE)
+
+    a = Producer.options(
+        max_restarts=3,
+        max_task_retries=3,
+        num_cpus=1,
+        resources={"victim": 1},
+    ).remote()
+    ref = a.produce.remote()
+    # Materialize WITHOUT fetching (a driver-side get would leave a local
+    # copy that survives the node kill and masks reconstruction).
+    ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=60)
+    assert ready
+
+    cluster.remove_node(cluster.raylets[_victim_node_id()])
+    cluster.add_node(num_cpus=2, resources={"victim": 2})
+    time.sleep(0.5)
+
+    # The primary copy died with the node AND the actor did too; recovery
+    # waits for the restarted incarnation and re-runs the method.
+    value = ray_tpu.get(ref, timeout=120)
+    assert float(value.sum()) == 360000.0
+
+
+def test_actor_task_not_reconstructable_without_retries(cluster_with_victim):
+    """max_task_retries=0 actor returns keep the old behavior: loss is a
+    terminal ObjectLostError."""
+    cluster = cluster_with_victim
+
+    @ray_tpu.remote
+    class Producer:
+        def produce(self):
+            return np.ones(SIZE)
+
+    a = Producer.options(
+        max_restarts=3, num_cpus=1, resources={"victim": 1}
+    ).remote()
+    ref = a.produce.remote()
+    ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=60)
+    assert ready
+
+    cluster.remove_node(cluster.raylets[_victim_node_id()])
+    cluster.add_node(num_cpus=2, resources={"victim": 2})
+    time.sleep(0.5)
+
+    with pytest.raises(ray_tpu.RayTpuError):
+        ray_tpu.get(ref, timeout=60)

@@ -210,6 +210,10 @@ class TaskSpec:
     max_restarts: int = 0
     max_concurrency: int = 1
     max_task_retries: int = 0
+    # Per-method concurrency groups (reference:
+    # transport/concurrency_group_manager.cc): {"group": max_concurrency}.
+    concurrency_groups: Optional[Dict[str, int]] = None
+    concurrency_group: Optional[str] = None
     # Placement.
     pg_id: Optional[str] = None
     bundle_index: int = -1

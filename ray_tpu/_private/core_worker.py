@@ -51,23 +51,97 @@ logger = logging.getLogger(__name__)
 
 
 class ObjectRefGenerator:
-    """Value of a num_returns="dynamic" task: an iterable of ObjectRefs
-    (reference: ray._raylet.ObjectRefGenerator / DynamicObjectRefGenerator)."""
+    """Value of a num_returns="dynamic" task: an iterable of ObjectRefs.
 
-    def __init__(self, refs):
-        self._refs = list(refs)
+    Streaming (reference: the ReportGeneratorItemReturns path +
+    TryReadObjectRefStream, core_worker.h:389): the producing worker ships
+    each yielded item as it is produced, so iterating here overlaps the
+    producer — ``__iter__`` yields the ref for item i as soon as the owner
+    has it, blocking only on items not yet produced. ``len()`` blocks until
+    the producer finishes. A generator constructed with a plain ref list
+    (legacy / fully-materialized) behaves statically.
+    """
+
+    def __init__(self, refs=None, task_id=None, owner_addr=None, total=None):
+        self._refs = list(refs) if refs is not None else None
+        self._task_id = task_id
+        self._owner_addr = tuple(owner_addr) if owner_addr else None
+        self._total = total
+        # Streaming mode: hold a ref on the stream's return object so the
+        # owner keeps the stream state (and item bookkeeping) alive for as
+        # long as any generator handle exists — the caller usually drops the
+        # raw task ref right after ray.get()ing this generator.
+        self._stream_ref = None
+        if task_id is not None:
+            try:
+                from ray_tpu._private import worker as worker_mod
+
+                core = worker_mod.global_worker.core
+                if core is not None and not core.closed:
+                    rid = return_object_ids(task_id, 1)[0]
+                    self._stream_ref = ObjectRef(rid, self._owner_addr, core)
+            except Exception:
+                pass
+
+    # -- streaming plumbing --------------------------------------------------
+
+    def _next_ref(self, i: int):
+        """Blocking: ref for item i, or None when the stream ended before i."""
+        if self._refs is not None:
+            return self._refs[i] if i < len(self._refs) else None
+        from ray_tpu._private import worker as worker_mod
+
+        w = worker_mod.global_worker
+        return w.run_async(
+            w.core.dyn_next(self._task_id, self._owner_addr, i), timeout=600
+        )
 
     def __iter__(self):
-        return iter(self._refs)
+        if self._refs is not None:
+            return iter(list(self._refs))
+
+        def it(gen=self):
+            i = 0
+            while True:
+                ref = gen._next_ref(i)
+                if ref is None:
+                    return
+                yield ref
+                i += 1
+
+        return it()
 
     def __len__(self):
-        return len(self._refs)
+        if self._refs is not None:
+            return len(self._refs)
+        if self._total is None:
+            from ray_tpu._private import worker as worker_mod
+
+            w = worker_mod.global_worker
+            self._total = w.run_async(
+                w.core.dyn_total(self._task_id, self._owner_addr), timeout=600
+            )
+        return self._total
 
     def __getitem__(self, i):
-        return self._refs[i]
+        if self._refs is not None:
+            return self._refs[i]
+        ref = self._next_ref(i)
+        if ref is None:
+            raise IndexError(i)
+        return ref
 
     def __reduce__(self):
-        return (ObjectRefGenerator, (self._refs,))
+        if self._refs is not None:
+            return (ObjectRefGenerator, (self._refs,))
+        # The stream's return object is this generator's dependency: record
+        # it so task-arg serialization pins the stream while in flight.
+        if self._stream_ref is not None:
+            serialization.record_contained_ref(self._stream_ref)
+        return (
+            ObjectRefGenerator,
+            (None, self._task_id, self._owner_addr, self._total),
+        )
 
 
 class ObjectRef:
@@ -672,6 +746,11 @@ class CoreWorker:
         # task_id -> {"cancelled": bool, "conn": live worker conn or None}
         self._inflight_tasks: Dict[str, dict] = {}
         self._oid_to_task: Dict[str, str] = {}
+        # Streaming-generator state per producing task (reference:
+        # TryReadObjectRefStream): items land here as GeneratorItem pushes
+        # arrive; "done" carries the final count from the task reply.
+        self._dyn_streams: Dict[str, dict] = {}
+        self._oid_to_dyn: Dict[str, str] = {}
         # Lineage: oid -> {"wire": producing TaskSpec wire, "attempts": int}.
         # Lost plasma-resident task returns are recomputed by re-running the
         # producing task (reference: object_recovery_manager.h:41 +
@@ -690,6 +769,8 @@ class CoreWorker:
         self._submit_wake = False
 
         server.register("GetObject", self._handle_get_object)
+        server.register("GeneratorItem", self._handle_generator_item)
+        server.register("DynNext", self._handle_dyn_next)
         server.register("WaitObject", self._handle_wait_object)
         server.register("RecoverObject", self._handle_recover_object)
         server.register("Ping", self._handle_ping)
@@ -801,6 +882,9 @@ class CoreWorker:
     def schedule_free(self, oid: str) -> None:
         self._free_queue.append(oid)
         self.lineage.pop(oid, None)
+        dyn_task = self._oid_to_dyn.pop(oid, None)
+        if dyn_task is not None:
+            self._dyn_streams.pop(dyn_task, None)
         self._wake_flush()
 
     def schedule_release(self, oid: str) -> None:
@@ -1022,6 +1106,158 @@ class CoreWorker:
             return {"status": "inline", "payload": entry.payload}
         return {"status": "plasma", "addr": list(entry.plasma_addr)}
 
+    # ---------------------------------------------- streaming generators
+
+    def _dyn_stream(self, task_id: str) -> dict:
+        st = self._dyn_streams.get(task_id)
+        if st is None:
+            st = self._dyn_streams[task_id] = {"items": {}, "done": None, "waiters": []}
+        return st
+
+    @staticmethod
+    def _dyn_wake(st: dict) -> None:
+        for w in st["waiters"]:
+            if not w.done():
+                w.set_result(None)
+        st["waiters"].clear()
+
+    def _dyn_item_oid(self, task_id: str, i: int) -> str:
+        return deterministic_object_id(TaskID.from_hex(task_id), i + 1).hex()
+
+    def _dyn_fail(self, task_id: str, error_payload: bytes) -> None:
+        """Terminate a stream on producer failure: items not yet produced
+        resolve to the task's error (consumers must not hang)."""
+        st = self._dyn_stream(task_id)
+        st["failed"] = error_payload
+        self._dyn_wake(st)
+
+    def _dyn_publish(self, task_id: str, total=None) -> None:
+        """Publish the task's return value as a streaming generator so
+        consumers start iterating while the producer still runs."""
+        rid = return_object_ids(task_id, 1)[0]
+        self._oid_to_dyn[rid] = task_id
+        if total is None and self.memory_store.contains(rid):
+            return
+        gen = ObjectRefGenerator(task_id=task_id, owner_addr=self.addr, total=total)
+        self.memory_store.put_inline(rid, serialization.serialize(gen).to_bytes())
+
+    async def _handle_generator_item(self, conn, p):
+        """One streamed item from the producing worker (reference:
+        ReportGeneratorItemReturns, core_worker.proto)."""
+        task_id, idx, ret = p["task_id"], p["index"], p["ret"]
+        st = self._dyn_stream(task_id)
+        oid = self._dyn_item_oid(task_id, idx)
+        if "inline" in ret:
+            self.memory_store.put_inline(oid, ret["inline"])
+        else:
+            self.memory_store.put_plasma_marker(oid, tuple(ret["plasma"]))
+        self.reference_table.mark_owned(oid)
+        st["items"][idx] = {k: v for k, v in ret.items() if k != "inline"} or {"inline": True}
+        self._dyn_publish(task_id)
+        self._dyn_wake(st)
+        return {"ok": True}
+
+    async def dyn_next(self, task_id: str, owner_addr, i: int):
+        """Blocking read of stream item i; None when the stream ends first."""
+        if owner_addr is None or tuple(owner_addr) == self.addr:
+            st = self._dyn_stream(task_id)
+            while True:
+                oid = self._dyn_item_oid(task_id, i)
+                if (
+                    i in st["items"]
+                    or (st["done"] is not None and i < st["done"])
+                    or self.memory_store.contains(oid)
+                ):
+                    return ObjectRef(oid, self.addr, self)
+                if st.get("failed") is not None:
+                    # Surface the producer's error through the item ref.
+                    self.memory_store.put_inline(oid, st["failed"])
+                    return ObjectRef(oid, self.addr, self)
+                if st["done"] is not None:
+                    return None
+                fut = asyncio.get_running_loop().create_future()
+                st["waiters"].append(fut)
+                await fut
+        conn = await self.connect_to(tuple(owner_addr))
+        while True:
+            reply = await conn.call(
+                "DynNext", {"task_id": task_id, "index": i, "timeout": 10}
+            )
+            if reply.get("pending"):
+                continue
+            if reply.get("gone"):
+                raise ObjectLostError(
+                    f"generator stream {task_id[:12]} is gone (freed by owner)"
+                )
+            if reply.get("done"):
+                return None
+            return ObjectRef(reply["oid"], tuple(owner_addr), self)
+
+    async def dyn_total(self, task_id: str, owner_addr):
+        if owner_addr is None or tuple(owner_addr) == self.addr:
+            st = self._dyn_stream(task_id)
+            while st["done"] is None:
+                if st.get("failed") is not None:
+                    return len(st["items"])
+                fut = asyncio.get_running_loop().create_future()
+                st["waiters"].append(fut)
+                await fut
+            return st["done"]
+        conn = await self.connect_to(tuple(owner_addr))
+        while True:
+            reply = await conn.call("DynNext", {"task_id": task_id, "timeout": 10})
+            if reply.get("pending"):
+                continue
+            if reply.get("gone"):
+                raise ObjectLostError(
+                    f"generator stream {task_id[:12]} is gone (freed by owner)"
+                )
+            return reply["total"]
+
+    async def _handle_dyn_next(self, conn, p):
+        """Borrower-side stream read (long-poll against the owner)."""
+        task_id = p["task_id"]
+        st = self._dyn_streams.get(task_id)
+        if st is None:
+            # No live stream state: answer from surviving objects, else the
+            # stream is gone (freed or owner restarted) — do not resurrect
+            # empty state that would make the borrower poll forever.
+            i = p.get("index")
+            if i is not None and self.memory_store.contains(
+                self._dyn_item_oid(task_id, i)
+            ):
+                return {"oid": self._dyn_item_oid(task_id, i)}
+            rid = return_object_ids(task_id, 1)[0]
+            if not self.memory_store.contains(rid):
+                return {"gone": True}
+            st = self._dyn_stream(task_id)
+        i = p.get("index")
+        deadline = time.monotonic() + (p.get("timeout") or 10)
+        while True:
+            if i is None:
+                if st["done"] is not None:
+                    return {"total": st["done"]}
+                if st.get("failed") is not None:
+                    return {"total": len(st["items"])}
+            else:
+                if i in st["items"] or (st["done"] is not None and i < st["done"]):
+                    return {"oid": self._dyn_item_oid(task_id, i)}
+                if st.get("failed") is not None:
+                    oid = self._dyn_item_oid(task_id, i)
+                    self.memory_store.put_inline(oid, st["failed"])
+                    return {"oid": oid}
+                if st["done"] is not None:
+                    return {"done": True}
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return {"pending": True}
+            fut = asyncio.get_running_loop().create_future()
+            st["waiters"].append(fut)
+            try:
+                await asyncio.wait_for(fut, remaining)
+            except asyncio.TimeoutError:
+                return {"pending": True}
+
     # ------------------------------------------------- lineage reconstruction
 
     def _register_lineage(self, wire: dict, reply: dict) -> None:
@@ -1040,6 +1276,12 @@ class CoreWorker:
                             TaskID.from_hex(wire["task_id"]), i + 1
                         ).hex()
                     )
+        if reply.get("dynamic_count") is not None:
+            st = self._dyn_streams.get(wire["task_id"])
+            if st is not None:
+                for i, ret in st["items"].items():
+                    if "plasma" in ret:
+                        plasma_oids.append(self._dyn_item_oid(wire["task_id"], i))
         if not plasma_oids:
             return
         for oid in plasma_oids:
@@ -1537,12 +1779,27 @@ class CoreWorker:
             payload = reply["error"]
             for oid in wire["return_ids"]:
                 self.memory_store.put_inline(oid, payload)
+            if wire.get("num_returns") == -1:
+                self._dyn_fail(wire["task_id"], payload)
             self.record_task_event(wire["task_id"], wire["name"], "FAILED")
             return
+        if reply.get("dynamic_count") is not None:
+            # Streaming-generator task finished: items were stored as they
+            # arrived (GeneratorItem pushes); record the final count and
+            # publish the total-aware generator value.
+            n = reply["dynamic_count"]
+            task_id = wire["task_id"]
+            st = self._dyn_stream(task_id)
+            st["done"] = n
+            for i in range(n):
+                self.reference_table.mark_owned(self._dyn_item_oid(task_id, i))
+            self._dyn_publish(task_id, total=n)
+            self._dyn_wake(st)
+            return
         if reply.get("dynamic") is not None:
-            # Streaming-generator task: store each yielded item under its
-            # deterministic id and make the main return value an
-            # ObjectRefGenerator over them.
+            # Legacy fully-materialized generator reply: store each yielded
+            # item under its deterministic id and make the main return value
+            # an ObjectRefGenerator over them.
             refs = []
             for i, ret in enumerate(reply["dynamic"]):
                 oid = deterministic_object_id(
@@ -1573,6 +1830,8 @@ class CoreWorker:
         payload = serialized.to_bytes()
         for oid in wire["return_ids"]:
             self.memory_store.put_inline(oid, payload)
+        if wire.get("num_returns") == -1:
+            self._dyn_fail(wire["task_id"], payload)
         self.record_task_event(wire["task_id"], wire["name"], "FAILED")
 
     # ----------------------------------------------------------- actors
@@ -1588,6 +1847,7 @@ class CoreWorker:
         max_restarts: int = 0,
         max_concurrency: int = 1,
         max_task_retries: int = 0,
+        concurrency_groups: Optional[Dict[str, int]] = None,
         name: Optional[str] = None,
         namespace: Optional[str] = None,
         lifetime: Optional[str] = None,
@@ -1635,6 +1895,7 @@ class CoreWorker:
             max_restarts=max_restarts,
             max_concurrency=max_concurrency,
             max_task_retries=max_task_retries,
+            concurrency_groups=concurrency_groups,
             pg_id=pg_id,
             bundle_index=bundle_index,
             scheduling_strategy=strategy,
@@ -1661,7 +1922,7 @@ class CoreWorker:
     def _actor_wire(
         self, actor_id, method_name, args_blob, args_object,
         ref_pos, kw_refs, deps, num_returns, return_ids, task_id,
-        max_task_retries=0,
+        max_task_retries=0, concurrency_group=None,
     ) -> dict:
         return {
             "task_id": task_id,
@@ -1688,6 +1949,7 @@ class CoreWorker:
             "bundle_index": -1,
             "scheduling_strategy": None,
             "runtime_env": None,
+            "concurrency_group": concurrency_group,
         }
 
     async def submit_actor_task(
@@ -1698,6 +1960,7 @@ class CoreWorker:
         kwargs: dict,
         num_returns: int = 1,
         max_task_retries: int = 0,
+        concurrency_group: Optional[str] = None,
     ) -> List[ObjectRef]:
         task_id = fast_unique_hex()
         return_ids = return_object_ids(task_id, num_returns)
@@ -1712,7 +1975,7 @@ class CoreWorker:
         wire = self._actor_wire(
             actor_id, method_name, args_blob, args_object,
             ref_pos, kw_refs, deps, num_returns, return_ids, task_id,
-            max_task_retries,
+            max_task_retries, concurrency_group,
         )
         refs = []
         for oid in return_ids:
@@ -1736,6 +1999,7 @@ class CoreWorker:
         loop,
         num_returns: int = 1,
         max_task_retries: int = 0,
+        concurrency_group: Optional[str] = None,
     ) -> Optional[List[ObjectRef]]:
         """Synchronous actor-call fast path (see try_submit_task_fast)."""
         serialized, ref_pos, kw_refs, deps = self._prepare_args(args, kwargs)
@@ -1746,7 +2010,7 @@ class CoreWorker:
         wire = self._actor_wire(
             actor_id, method_name, serialized.to_bytes(), None,
             ref_pos, kw_refs, deps, num_returns, return_ids, task_id,
-            max_task_retries,
+            max_task_retries, concurrency_group,
         )
         refs = []
         mark_owned = self.reference_table.mark_owned

@@ -119,6 +119,9 @@ class Executor:
         # True when the hosted actor has no coroutine methods (set at
         # creation); gates the exec-thread fast path.
         self.actor_all_sync = False
+        # Concurrency groups (set at actor creation when declared).
+        self.cgroup_sems = None
+        self.cgroup_pools = None
         core.server.register("PushTask", self.handle_push_task)
         core.server.register("PushActorTask", self.handle_push_actor_task)
         core.server.register("CreateActor", self.handle_create_actor)
@@ -152,6 +155,7 @@ class Executor:
         wire = p["spec"]
         if (
             self.actor_all_sync
+            and self.cgroup_sems is None
             and self.actor_instance is not None
             and (self.actor_spec or {}).get("max_concurrency", 1) == 1
             and wire.get("actor_method") != "__rt_dag_loop__"
@@ -370,17 +374,35 @@ class Executor:
 
                     result = await loop.run_in_executor(self.pool, run_tracked)
             if wire["num_returns"] == -1 and inspect.isgenerator(result):
-                # Streaming generator: store every yielded item as its own
-                # return (reference: ReportGeneratorItemReturns path).
-                dynamic = []
-                for item in result:
-                    dynamic.extend(
-                        await self.store_returns(
-                            {"num_returns": 1, "return_ids": [self._dyn_oid(wire, len(dynamic))]},
-                            item,
-                        )
+                # Streaming generator: each yielded item is stored and
+                # reported to the owner AS PRODUCED, so the consumer's
+                # iteration overlaps this producer (reference:
+                # ReportGeneratorItemReturns, core_worker.proto). Each
+                # next() runs on the executor pool — user code must not
+                # block the worker loop.
+                idx = 0
+                loop = asyncio.get_running_loop()
+
+                def _advance():
+                    try:
+                        return True, next(result)
+                    except StopIteration:
+                        return False, None
+
+                while True:
+                    ok, item = await loop.run_in_executor(self.pool, _advance)
+                    if not ok:
+                        break
+                    ret = await self.store_returns(
+                        {"num_returns": 1, "return_ids": [self._dyn_oid(wire, idx)]},
+                        item,
                     )
-                return {"dynamic": dynamic}
+                    conn.push_nowait(
+                        "GeneratorItem",
+                        {"task_id": wire["task_id"], "index": idx, "ret": ret[0]},
+                    )
+                    idx += 1
+                return {"dynamic_count": idx}
             returns = await self.store_returns(wire, result)
             return {"returns": returns}
         except asyncio.CancelledError:
@@ -428,7 +450,27 @@ class Executor:
         wire = p["spec"]
         self.actor_spec = wire
         max_c = wire.get("max_concurrency") or 1
-        if max_c > 1:
+        cgroups = wire.get("concurrency_groups")
+        if cgroups:
+            # Per-method concurrency groups (reference:
+            # transport/concurrency_group_manager.cc): each group gets its
+            # own semaphore (async methods) and thread pool (sync methods);
+            # calls in different groups never block each other. Ungrouped
+            # calls ride the default group sized by max_concurrency.
+            if max_c == 1:
+                max_c = 1000  # reference default for concurrency-group actors
+            self.cgroup_sems = {
+                name: asyncio.Semaphore(int(n)) for name, n in cgroups.items()
+            }
+            self.cgroup_sems["_default"] = asyncio.Semaphore(max_c)
+            self.cgroup_pools = {
+                name: concurrent.futures.ThreadPoolExecutor(max_workers=int(n))
+                for name, n in cgroups.items()
+            }
+            self.cgroup_pools["_default"] = concurrent.futures.ThreadPoolExecutor(
+                max_workers=max_c
+            )
+        if max_c > 1 and not cgroups:
             self.pool = concurrent.futures.ThreadPoolExecutor(max_workers=max_c)
         try:
             if wire.get("runtime_env"):
@@ -474,6 +516,20 @@ class Executor:
         wire = p["spec"]
         caller = wire.get("caller_id") or "anon"
         seq = wire.get("seq_no", -1)
+        if self.cgroup_sems is not None:
+            # Concurrency-group actor: out-of-order execution, bounded per
+            # group (reference: out_of_order_actor_submit_queue.cc +
+            # concurrency_group_manager.cc).
+            group = wire.get("concurrency_group") or "_default"
+            sem = self.cgroup_sems.get(group)
+            if sem is None:
+                raise rpc.RpcError(f"unknown concurrency group {group!r}")
+            if seq >= 0:
+                self._advance_seq(caller, seq)
+            async with sem:
+                return await self._run_actor_method(
+                    wire, pool=self.cgroup_pools[group]
+                )
         ordered = (self.actor_spec or {}).get("max_concurrency", 1) == 1
         if ordered and seq >= 0:
             await self._wait_my_turn(caller, seq)
@@ -500,7 +556,9 @@ class Executor:
             if not fut.done():
                 fut.set_result(None)
 
-    async def _run_actor_method(self, wire: dict):
+    async def _run_actor_method(self, wire: dict, pool=None):
+        if pool is None:
+            pool = self.pool
         try:
             if self.actor_instance is None:
                 raise RuntimeError("actor not initialized")
@@ -523,7 +581,7 @@ class Executor:
             else:
                 loop = asyncio.get_running_loop()
                 result = await loop.run_in_executor(
-                    self.pool, lambda: method(*args, **kwargs)
+                    pool, lambda: method(*args, **kwargs)
                 )
             returns = await self.store_returns(wire, result)
             return {"returns": returns}

@@ -25,16 +25,25 @@ class ActorMethod:
         name: str,
         num_returns: int = 1,
         max_task_retries: Optional[int] = None,
+        concurrency_group: Optional[str] = None,
     ):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
         self._max_task_retries = max_task_retries
+        self._concurrency_group = concurrency_group
 
     def options(
-        self, *, num_returns: int = 1, max_task_retries: Optional[int] = None
+        self,
+        *,
+        num_returns: int = 1,
+        max_task_retries: Optional[int] = None,
+        concurrency_group: Optional[str] = None,
     ) -> "ActorMethod":
-        return ActorMethod(self._handle, self._name, num_returns, max_task_retries)
+        return ActorMethod(
+            self._handle, self._name, num_returns, max_task_retries,
+            concurrency_group,
+        )
 
     def remote(self, *args, **kwargs):
         core = worker_mod._core()
@@ -50,6 +59,7 @@ class ActorMethod:
             kwargs,
             num_returns=self._num_returns,
             max_task_retries=retries,
+            concurrency_group=self._concurrency_group,
             loop=worker_mod.global_worker.loop,
         )
         if refs is None:  # large args need the async plasma path
@@ -61,6 +71,7 @@ class ActorMethod:
                     kwargs,
                     num_returns=self._num_returns,
                     max_task_retries=retries,
+                    concurrency_group=self._concurrency_group,
                 )
             )
         if self._num_returns == 1:
@@ -137,6 +148,7 @@ class ActorClass:
                 max_restarts=opts.get("max_restarts", 0),
                 max_concurrency=opts.get("max_concurrency", 1),
                 max_task_retries=opts.get("max_task_retries", 0),
+                concurrency_groups=opts.get("concurrency_groups"),
                 name=opts.get("name"),
                 namespace=opts.get("namespace") or worker_mod.global_worker.namespace,
                 lifetime=opts.get("lifetime"),

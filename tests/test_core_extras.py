@@ -163,3 +163,70 @@ def test_torch_trainer_ddp(ray_start_regular):
     )
     result = trainer.fit()
     assert result.metrics["loss"] < 1.0
+
+
+def test_streaming_generator_overlaps_producer(ray_start_regular):
+    """Consumer receives early items while the producer is still yielding
+    (reference: ReportGeneratorItemReturns streaming)."""
+    import time as _time
+
+    import ray_tpu
+
+    @ray_tpu.remote
+    def warm():
+        return 1
+
+    @ray_tpu.remote(num_returns="dynamic")
+    def slow_gen():
+        for i in range(4):
+            yield i
+            _time.sleep(0.8)
+
+    ray_tpu.get(warm.remote())  # spawn the worker outside the timed window
+
+    t0 = _time.monotonic()
+    gen = ray_tpu.get(slow_gen.remote(), timeout=30)
+    it = iter(gen)
+    first = ray_tpu.get(next(it))
+    first_latency = _time.monotonic() - t0
+    assert first == 0
+    # The full run takes >= 3*0.8s; getting item 0 must not wait for it.
+    assert first_latency < 2.0, f"first item took {first_latency:.1f}s (not streamed)"
+    rest = [ray_tpu.get(r) for r in it]
+    assert rest == [1, 2, 3]
+
+
+def test_streaming_generator_borrowed(ray_start_regular):
+    """A generator handle passed to another process iterates via the owner
+    (DynNext long-poll)."""
+    import ray_tpu
+
+    @ray_tpu.remote(num_returns="dynamic")
+    def gen():
+        for i in range(3):
+            yield i * 10
+
+    @ray_tpu.remote
+    def consume(g):
+        return [ray_tpu.get(r) for r in g]
+
+    g = ray_tpu.get(gen.remote(), timeout=30)
+    assert ray_tpu.get(consume.remote(g), timeout=60) == [0, 10, 20]
+
+
+def test_streaming_generator_failure_propagates(ray_start_regular):
+    """A generator that raises mid-stream terminates iteration with the
+    task's error instead of hanging consumers."""
+    import ray_tpu
+
+    @ray_tpu.remote(num_returns="dynamic", max_retries=0)
+    def bad_gen():
+        yield 1
+        raise ValueError("boom-mid-stream")
+
+    gen = ray_tpu.get(bad_gen.remote(), timeout=30)
+    it = iter(gen)
+    assert ray_tpu.get(next(it), timeout=30) == 1
+    with pytest.raises(Exception) as ei:
+        ray_tpu.get(next(it), timeout=30)
+    assert "boom-mid-stream" in str(ei.value)

@@ -77,6 +77,9 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     # Echo captured worker stdout/stderr to the driver (reference:
     # ray.init(log_to_driver=True) + log_monitor.py streaming).
     "log_to_driver": True,
+    # How long a caller waits for a PENDING/RESTARTING actor to come up
+    # before failing the call (reference: gcs_client actor resolution).
+    "actor_resolve_timeout_s": 300.0,
 }
 
 

@@ -288,7 +288,7 @@ class ReferenceTable:
 class Lease:
     __slots__ = (
         "lease_id", "worker_id", "addr", "conn", "raylet_conn",
-        "outstanding", "in_idle", "checked_out",
+        "outstanding", "in_idle", "checked_out", "used",
     )
 
     def __init__(self, lease_id: str, worker_id: str, addr, conn, raylet_conn):
@@ -307,6 +307,9 @@ class Lease:
         # Exclusively handed to an acquire() waiter; release() clears it.
         # While set, pipelined-task reply callbacks must not repark/return it.
         self.checked_out = False
+        # True once a task has been dispatched on it (SPREAD pools retire
+        # used leases instead of recycling them).
+        self.used = False
 
 
 class _ShapePool:
@@ -314,11 +317,11 @@ class _ShapePool:
     in-flight lease requests to the raylet."""
 
     __slots__ = (
-        "idle", "pending", "inflight", "inflight_ids",
-        "resources", "pg_id", "bundle_index",
+        "idle", "pending", "inflight", "inflight_ids", "leases",
+        "total_outstanding", "resources", "pg_id", "bundle_index", "strategy",
     )
 
-    def __init__(self, resources, pg_id, bundle_index):
+    def __init__(self, resources, pg_id, bundle_index, strategy=None):
         self.idle: List[Lease] = []
         # Work items in FIFO order. Each is either ("task", wire) — a
         # callback-dispatched task submission — or ("waiter", future) — an
@@ -328,9 +331,15 @@ class _ShapePool:
         # lease_ids of in-flight RequestWorkerLease RPCs still cancellable on
         # the home raylet.
         self.inflight_ids: set = set()
+        # Live leases of this shape (granted, not yet returned).
+        self.leases: set = set()
+        # Running total of outstanding pushes across self.leases (kept by
+        # dispatch/reply so depth decisions don't re-sum per item).
+        self.total_outstanding = 0
         self.resources = resources
         self.pg_id = pg_id
         self.bundle_index = bundle_index
+        self.strategy = strategy
 
 
 class LeasePool:
@@ -359,31 +368,37 @@ class LeasePool:
         self.pools: Dict[tuple, _ShapePool] = {}
 
     @staticmethod
-    def shape_key(resources: Dict[str, int], pg_id, bundle_index) -> tuple:
-        return (tuple(sorted((resources or {}).items())), pg_id, bundle_index)
+    def shape_key(resources: Dict[str, int], pg_id, bundle_index, strategy=None) -> tuple:
+        skey = tuple(sorted(strategy.items())) if strategy else None
+        return (tuple(sorted((resources or {}).items())), pg_id, bundle_index, skey)
 
-    def _pool(self, key, resources, pg_id, bundle_index) -> _ShapePool:
+    def _pool(self, key, resources, pg_id, bundle_index, strategy=None) -> _ShapePool:
         p = self.pools.get(key)
         if p is None:
-            p = self.pools[key] = _ShapePool(resources, pg_id, bundle_index)
+            p = self.pools[key] = _ShapePool(resources, pg_id, bundle_index, strategy)
         return p
 
     # -- intake --------------------------------------------------------------
 
     def submit_task_fast(self, wire: dict) -> None:
         """Queue a dependency-free task wire for callback dispatch."""
+        strategy = wire.get("scheduling_strategy")
         key = self.shape_key(
-            wire.get("resources"), wire.get("pg_id"), wire.get("bundle_index", -1)
+            wire.get("resources"), wire.get("pg_id"), wire.get("bundle_index", -1),
+            strategy,
         )
         pool = self._pool(
-            key, wire.get("resources") or {}, wire.get("pg_id"), wire.get("bundle_index", -1)
+            key, wire.get("resources") or {}, wire.get("pg_id"),
+            wire.get("bundle_index", -1), strategy,
         )
         pool.pending.append(("task", wire))
         self._pump(key, pool)
 
-    async def acquire(self, resources: Dict[str, int], pg_id=None, bundle_index=None) -> Lease:
-        key = self.shape_key(resources, pg_id, bundle_index)
-        pool = self._pool(key, resources, pg_id, bundle_index)
+    async def acquire(
+        self, resources: Dict[str, int], pg_id=None, bundle_index=None, strategy=None
+    ) -> Lease:
+        key = self.shape_key(resources, pg_id, bundle_index, strategy)
+        pool = self._pool(key, resources, pg_id, bundle_index, strategy)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         pool.pending.append(("waiter", fut))
         self._pump(key, pool)
@@ -392,27 +407,41 @@ class LeasePool:
     # -- pump: match pending work to leases ----------------------------------
 
     def _pump(self, key, pool: _ShapePool) -> None:
-        idle = pool.idle
         pending = pool.pending
-        while pending and idle:
-            lease = idle[-1]
-            if lease.conn.closed:
-                idle.pop()
-                lease.in_idle = False
-                continue
-            kind, item = pending.popleft()
-            if kind == "waiter":
-                # Waiters check the lease out exclusively.
-                idle.pop()
-                lease.in_idle = False
-                if item.done():  # cancelled acquire; lease stays available
-                    idle.append(lease)
-                    lease.in_idle = True
+        if pending and pool.idle:
+            # One pass per pump: prune dead leases, then fill lowest-loaded
+            # leases first up to the allowed depth. O(idle log idle + items).
+            live = []
+            for lease in pool.idle:
+                if lease.conn.closed:
+                    lease.in_idle = False
+                    pool.leases.discard(lease)
+                else:
+                    live.append(lease)
+            live.sort(key=lambda l: l.outstanding)
+            pool.idle[:] = live
+            allowed = self._allowed_depth(pool)
+            i = 0
+            while pending and i < len(pool.idle):
+                lease = pool.idle[i]
+                if lease.outstanding >= allowed:
+                    i += 1
                     continue
-                lease.checked_out = True
-                item.set_result(lease)
-            else:
-                self._dispatch_task(key, pool, lease, item)
+                kind, item = pending.popleft()
+                if kind == "waiter":
+                    # Waiters check the lease out exclusively.
+                    pool.idle.pop(i)
+                    lease.in_idle = False
+                    if item.done():  # cancelled acquire; lease stays available
+                        pool.idle.insert(i, lease)
+                        lease.in_idle = True
+                        continue
+                    lease.checked_out = True
+                    item.set_result(lease)
+                else:
+                    self._dispatch_task(key, pool, lease, item)
+                    if not lease.in_idle and i < len(pool.idle) and pool.idle[i] is not lease:
+                        continue  # dispatch removed it (depth cap/conn loss)
         shortfall = len(pool.pending) - pool.inflight
         while shortfall > 0 and pool.inflight < self.MAX_INFLIGHT:
             pool.inflight += 1
@@ -439,6 +468,23 @@ class LeasePool:
             if lease.in_idle:
                 pool.idle.remove(lease)
                 lease.in_idle = False
+            pool.leases.discard(lease)
+            return
+        if (
+            pool.strategy
+            and pool.strategy.get("spread")
+            and lease.used
+            and lease.outstanding == 0
+        ):
+            # SPREAD: one task per granted lease — recycling would funnel the
+            # burst back onto whichever node answered first instead of the
+            # round-robin placement each lease request received.
+            if lease.in_idle:
+                pool.idle.remove(lease)
+                lease.in_idle = False
+            pool.leases.discard(lease)
+            rpc.spawn(self._return_worker(lease, dirty=False))
+            self._pump(key, pool)
             return
         if not lease.in_idle:
             pool.idle.append(lease)
@@ -456,6 +502,7 @@ class LeasePool:
         ):
             pool.idle.remove(lease)
             lease.in_idle = False
+            pool.leases.discard(lease)
             rpc.spawn(self._return_worker(lease, dirty=False))
 
     async def _request_lease(self, key, pool: _ShapePool) -> None:
@@ -474,6 +521,8 @@ class LeasePool:
                         "resources": pool.resources,
                         "pg_id": pool.pg_id,
                         "bundle_index": pool.bundle_index,
+                        "strategy": pool.strategy,
+                        "spilled_from": hops > 0,
                     },
                     timeout=None,
                 )
@@ -491,6 +540,7 @@ class LeasePool:
                         raylet_conn,
                     )
                     pool.inflight -= 1
+                    pool.leases.add(lease)
                     self._lease_available(key, pool, lease)
                     return
                 spill = reply.get("spillback")
@@ -521,6 +571,26 @@ class LeasePool:
 
     # -- task dispatch over a lease (callback chain) -------------------------
 
+    def _pool_depth(self, pool: _ShapePool) -> int:
+        # SPREAD pools place per task: no pipelining, or one granted lease
+        # would swallow the whole burst the strategy wants distributed.
+        if pool.strategy and pool.strategy.get("spread"):
+            return 1
+        return self.PIPELINE_DEPTH
+
+    def _allowed_depth(self, pool: _ShapePool) -> int:
+        """Backlog-aware pipelining: pipeline deeply only when the backlog
+        exceeds the lease supply. A burst of long tasks must spread over the
+        leases (and spillback targets) being granted for it, not serialize
+        behind the first granted worker; a deep backlog of short tasks still
+        gets full-depth pipelining."""
+        base = self._pool_depth(pool)
+        if base == 1:
+            return 1
+        supply = max(1, len(pool.leases) + pool.inflight)
+        backlog = len(pool.pending) + pool.total_outstanding
+        return max(1, min(base, -(-backlog // supply)))
+
     def _dispatch_task(self, key, pool: _ShapePool, lease: Lease, wire: dict) -> None:
         """Push one task onto a lease. Caller guarantees lease.in_idle and
         capacity; this updates the capacity accounting."""
@@ -540,11 +610,14 @@ class LeasePool:
             if lease.in_idle:
                 pool.idle.remove(lease)
                 lease.in_idle = False
+            pool.leases.discard(lease)
             rpc.spawn(self._return_worker(lease, dirty=True))
             self._retry_or_fail(key, pool, wire, rpc.ConnectionLost("worker connection lost"))
             return
         lease.outstanding += 1
-        if lease.outstanding >= self.PIPELINE_DEPTH and lease.in_idle:
+        pool.total_outstanding += 1
+        lease.used = True
+        if lease.outstanding >= self._pool_depth(pool) and lease.in_idle:
             pool.idle.remove(lease)
             lease.in_idle = False
         fut.add_done_callback(
@@ -554,6 +627,7 @@ class LeasePool:
     def _on_task_reply(self, key, pool: _ShapePool, lease: Lease, wire: dict, fut) -> None:
         core = self.core
         lease.outstanding -= 1
+        pool.total_outstanding -= 1
         entry = core._inflight_tasks.get(wire["task_id"])
         if entry is not None:
             entry["conn"] = None
@@ -571,6 +645,7 @@ class LeasePool:
             if lease.in_idle:
                 pool.idle.remove(lease)
                 lease.in_idle = False
+            pool.leases.discard(lease)
             if lease.outstanding == 0:
                 rpc.spawn(self._return_worker(lease, dirty=True))
             if entry is not None and entry["cancelled"]:
@@ -610,14 +685,18 @@ class LeasePool:
 
     # -- release / teardown --------------------------------------------------
 
-    async def release(self, lease: Lease, resources, pg_id=None, bundle_index=None, dirty=False):
-        key = self.shape_key(resources, pg_id, bundle_index)
-        pool = self._pool(key, resources, pg_id, bundle_index)
+    async def release(
+        self, lease: Lease, resources, pg_id=None, bundle_index=None,
+        dirty=False, strategy=None,
+    ):
+        key = self.shape_key(resources, pg_id, bundle_index, strategy)
+        pool = self._pool(key, resources, pg_id, bundle_index, strategy)
         lease.checked_out = False
         if dirty or lease.conn.closed:
             if lease.in_idle:
                 pool.idle.remove(lease)
                 lease.in_idle = False
+            pool.leases.discard(lease)
             await self._return_worker(lease, dirty=True)
             self._pump(key, pool)
             return
@@ -635,6 +714,7 @@ class LeasePool:
         for pool in self.pools.values():
             for lease in pool.idle:
                 lease.in_idle = False
+                pool.leases.discard(lease)
                 await self._return_worker(lease, dirty=False)
             pool.idle.clear()
 
@@ -657,7 +737,9 @@ class ActorSubmitter:
         # calls in submission order).
         self.pending_slow = 0
 
-    async def _resolve(self, timeout: float = 300.0) -> None:
+    async def _resolve(self, timeout: Optional[float] = None) -> None:
+        if timeout is None:
+            timeout = config.actor_resolve_timeout_s
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             reply = await self.core.gcs.call("GetActor", {"actor_id": self.actor_id})
@@ -1754,13 +1836,16 @@ class CoreWorker:
     async def _lease_and_push(self, wire: dict) -> dict:
         resources = wire.get("resources") or {}
         pg_id, bundle_index = wire.get("pg_id"), wire.get("bundle_index", -1)
-        lease = await self.lease_pool.acquire(resources, pg_id, bundle_index)
+        strategy = wire.get("scheduling_strategy")
+        lease = await self.lease_pool.acquire(resources, pg_id, bundle_index, strategy)
         dirty = False
         entry = self._inflight_tasks.get(wire["task_id"])
         if entry is not None:
             if entry["cancelled"]:
                 # Cancellation landed while we were queued for a lease.
-                await self.lease_pool.release(lease, resources, pg_id, bundle_index)
+                await self.lease_pool.release(
+                    lease, resources, pg_id, bundle_index, strategy=strategy
+                )
                 raise TaskCancelledError(f"task {wire['name']} was cancelled")
             entry["conn"] = lease.conn
         try:
@@ -1772,7 +1857,9 @@ class CoreWorker:
         finally:
             if entry is not None:
                 entry["conn"] = None
-            await self.lease_pool.release(lease, resources, pg_id, bundle_index, dirty=dirty)
+            await self.lease_pool.release(
+                lease, resources, pg_id, bundle_index, dirty=dirty, strategy=strategy
+            )
 
     def _store_task_results(self, wire: dict, reply: dict) -> None:
         if reply.get("error") is not None:

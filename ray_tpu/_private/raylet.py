@@ -173,6 +173,10 @@ class Raylet:
         self.pg_committed: Dict[str, Tuple[ResourceSet, ResourceSet]] = {}
 
         self._resources_dirty = asyncio.Event()
+        self._view: List[dict] = []
+        self._view_time = 0.0
+        self._spread_rr = 0
+        self._view_fetch = None
         self._tasks: List[asyncio.Task] = []
         self._register_handlers()
 
@@ -364,6 +368,12 @@ class Raylet:
             env["PYTHONPATH"] = (
                 pkg_root + (os.pathsep + existing if existing else "")
             )
+        # Vars listed in RAY_TPU_WORKER_ENV_DROP are removed from worker
+        # environments (e.g. the axon sitecustomize trigger, whose jax
+        # import costs ~2s per worker spawn that CPU-only suites never use).
+        for name in (env.get("RAY_TPU_WORKER_ENV_DROP") or "").split(","):
+            if name:
+                env.pop(name, None)
         env.update(self.worker_env)
         env.update(
             {
@@ -569,14 +579,144 @@ class Raylet:
         demand = self._translate_pg_demand(
             demand, p.get("pg_id"), p.get("bundle_index")
         )
+        strategy = p.get("strategy") or {}
+        # Node affinity (reference: scheduling_options.h NODE_AFFINITY).
+        affinity = strategy.get("node_id")
+        if affinity and affinity != self.node_id:
+            target = None
+            for n in await self._cluster_view():
+                if n["node_id"] == affinity:
+                    if demand.is_subset_of(ResourceSet.from_units(n["total"])):
+                        target = {"node_id": affinity, "addr": n["addr"]}
+                    break
+            if target is not None:
+                return {"spillback": target}
+            if not strategy.get("soft"):
+                raise rpc.RpcError(
+                    f"node affinity target {affinity[:8]} not in cluster "
+                    "or cannot fit the demand"
+                )
+            affinity = None  # soft fallback: schedule as if unconstrained
+        elif affinity == self.node_id and not demand.is_subset_of(self.total):
+            if not strategy.get("soft"):
+                raise rpc.RpcError(
+                    f"demand cannot fit on affinity target {affinity[:8]}"
+                )
+            affinity = None
         if not demand.is_subset_of(self.total):
             # Infeasible here — suggest spillback target from GCS view.
             target = await self._find_spillback_node(demand)
             return {"spillback": target}
+        if not affinity and not p.get("spilled_from"):
+            # Scheduling policy (reference: hybrid_scheduling_policy.cc /
+            # scheduling_policy.h SPREAD): decide local-vs-remote before
+            # queueing. Spilled-over requests stay put to avoid ping-pong.
+            target = await self._policy_pick(demand, strategy)
+            if target is not None:
+                return {"spillback": target}
         req = LeaseRequest(p["lease_id"], demand, p)
         self.pending_leases.append(req)
         self._try_grant_leases()
         return await req.fut
+
+    # -- scheduling policy (reference: raylet/scheduling/policy/) ------------
+
+    async def _cluster_view(self) -> list:
+        """GCS node view cached briefly (the syncer keeps it ~1s fresh).
+        Concurrent refreshers share one fetch — a burst of policy decisions
+        must wait for the view, not act on a stale/empty one."""
+        now = time.monotonic()
+        if now - self._view_time > 1.0:
+            if self._view_fetch is None:
+                self._view_fetch = rpc.spawn(self._fetch_view())
+            fetch = self._view_fetch
+            # CancelledError propagates (handler cancellation must win);
+            # fetch errors leave the stale view in place.
+            await asyncio.shield(fetch)
+        return self._view
+
+    async def _fetch_view(self) -> None:
+        try:
+            reply = await self.gcs.call("GetAllNodes")
+            self._view = [n for n in reply["nodes"] if n["state"] == "ALIVE"]
+            self._view_time = time.monotonic()
+        except rpc.RpcError:
+            pass
+        finally:
+            self._view_fetch = None
+
+    async def _node_by_id(self, node_id: str):
+        for n in await self._cluster_view():
+            if n["node_id"] == node_id:
+                return {"node_id": node_id, "addr": n["addr"]}
+        return None
+
+    @staticmethod
+    def _node_util(total: Dict[str, int], available: Dict[str, int]) -> float:
+        util = 0.0
+        for k, tot in total.items():
+            if tot > 0 and not k.startswith("node:"):
+                util = max(util, 1.0 - available.get(k, 0) / tot)
+        return util
+
+    def _local_util(self) -> float:
+        return self._node_util(self.total.to_units(), self.available.to_units())
+
+    async def _policy_pick(self, demand: ResourceSet, strategy: dict):
+        """Pick a remote target per policy, or None to queue locally.
+
+        Hybrid (default, reference hybrid_scheduling_policy.cc): pack locally
+        while local utilization stays at or below the spread threshold; past
+        it, move work to a random choice among the top-k least-utilized
+        feasible nodes (randomization spreads herds of simultaneous
+        schedulers). SPREAD: always place on the least-loaded feasible node,
+        round-robin-ish via the same top-k randomization.
+        """
+        import random
+
+        spread = strategy.get("spread", False)
+        local_fits = demand.is_subset_of(self.available)
+        if spread:
+            # SPREAD: rotate over every node whose TOTAL fits the demand
+            # (a lagging availability view must not collapse the rotation
+            # onto one node).
+            ring = [
+                n
+                for n in await self._cluster_view()
+                if demand.is_subset_of(ResourceSet.from_units(n["total"]))
+            ]
+            ring.sort(key=lambda n: n["node_id"])
+            if not ring:
+                return None
+            pick = ring[self._spread_rr % len(ring)]
+            self._spread_rr += 1
+            if pick["node_id"] == self.node_id:
+                return None
+            return {"node_id": pick["node_id"], "addr": pick["addr"]}
+        if local_fits and self._local_util() <= config.scheduler_spread_threshold:
+            return None
+        cands = []
+        for n in await self._cluster_view():
+            if n["node_id"] == self.node_id:
+                continue
+            if demand.is_subset_of(ResourceSet.from_units(n["available"])):
+                cands.append(n)
+        if not cands:
+            return None
+        below = [
+            n
+            for n in cands
+            if self._node_util(n["total"], n["available"])
+            < config.scheduler_spread_threshold
+        ]
+        pool = below or cands
+        pool.sort(key=lambda n: self._node_util(n["total"], n["available"]))
+        k = max(1, int(len(pool) * config.scheduler_top_k_fraction))
+        pick = random.choice(pool[:k])
+        pick_util = self._node_util(pick["total"], pick["available"])
+        if local_fits and self._local_util() <= pick_util:
+            return None  # we're no worse than the best remote; stay local
+        return {"node_id": pick["node_id"], "addr": pick["addr"]}
 
     async def _cancel_worker_lease(self, conn, p):
         """Cancel a queued (ungranted) lease request: the surplus-request

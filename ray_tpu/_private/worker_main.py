@@ -18,6 +18,7 @@ import os
 import sys
 import threading
 import traceback
+from collections import OrderedDict
 from typing import Any, Dict, Optional
 
 import cloudpickle
@@ -64,7 +65,20 @@ class _ExecThread:
             if item is None:
                 return
             conn, msgid, method, wire = item
-            track = ex.running_tasks[wire.get("task_id", "")] = {
+            task_id = wire.get("task_id", "")
+            if task_id in ex.cancelled_tasks:
+                ex.cancelled_tasks.pop(task_id, None)
+                from ray_tpu._private.common import TaskCancelledError
+
+                self.replies.append(
+                    (conn, msgid, method,
+                     {"error": ex._error_payload(TaskCancelledError("task cancelled"))})
+                )
+                if not self._reply_wake:
+                    self._reply_wake = True
+                    self.loop.call_soon_threadsafe(self._drain_replies)
+                continue
+            track = ex.running_tasks[task_id] = {
                 "thread_id": threading.get_ident(),
                 "async_task": None,
             }
@@ -122,6 +136,11 @@ class Executor:
         # Concurrency groups (set at actor creation when declared).
         self.cgroup_sems = None
         self.cgroup_pools = None
+        # Tasks cancelled before they started executing (they may still be
+        # queued behind a running task on this worker — pipelined dispatch).
+        # Bounded: best-effort markers for races with finished tasks must not
+        # accumulate forever.
+        self.cancelled_tasks: "OrderedDict[str, None]" = OrderedDict()
         core.server.register("PushTask", self.handle_push_task)
         core.server.register("PushActorTask", self.handle_push_actor_task)
         core.server.register("CreateActor", self.handle_create_actor)
@@ -340,6 +359,11 @@ class Executor:
     async def handle_push_task(self, conn, p):
         wire = p["spec"]
         task_id = wire.get("task_id", "")
+        if task_id in self.cancelled_tasks:
+            self.cancelled_tasks.pop(task_id, None)
+            from ray_tpu._private.common import TaskCancelledError
+
+            return {"error": self._error_payload(TaskCancelledError("task cancelled"))}
         track = self.running_tasks[task_id] = {"thread_id": None, "async_task": None}
         try:
             renv = wire.get("runtime_env") or {}
@@ -358,6 +382,12 @@ class Executor:
             from ray_tpu.runtime_env.context import scoped_env_vars
 
             with scoped_env_vars(renv.get("env_vars")):
+                if task_id in self.cancelled_tasks:
+                    # Cancel arrived while args/function were being resolved.
+                    self.cancelled_tasks.pop(task_id, None)
+                    from ray_tpu._private.common import TaskCancelledError
+
+                    raise asyncio.CancelledError("task cancelled")
                 if asyncio.iscoroutinefunction(fn):
                     coro_task = asyncio.ensure_future(fn(*args, **kwargs))
                     track["async_task"] = coro_task
@@ -366,6 +396,11 @@ class Executor:
                     loop = asyncio.get_running_loop()
 
                     def run_tracked():
+                        if task_id in self.cancelled_tasks:
+                            self.cancelled_tasks.pop(task_id, None)
+                            from ray_tpu._private.common import TaskCancelledError
+
+                            raise TaskCancelledError("task cancelled")
                         track["thread_id"] = threading.get_ident()
                         try:
                             return fn(*args, **kwargs)
@@ -431,8 +466,16 @@ class Executor:
         from ray_tpu._private.common import TaskCancelledError
 
         track = self.running_tasks.get(p["task_id"])
-        if track is None:
-            return {"found": False}
+        if track is None or (
+            track.get("async_task") is None and track.get("thread_id") is None
+        ):
+            # Not executing yet: queued behind the current task (pipelined
+            # push) or waiting for the executor. Mark it so execution is
+            # skipped when its turn comes.
+            self.cancelled_tasks[p["task_id"]] = None
+            while len(self.cancelled_tasks) > 1024:
+                self.cancelled_tasks.popitem(last=False)
+            return {"found": True, "queued": True}
         if track.get("async_task") is not None:
             track["async_task"].cancel()
             return {"found": True}

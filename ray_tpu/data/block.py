@@ -119,7 +119,9 @@ def sort_block(block: pa.Table, key: str, descending: bool = False) -> pa.Table:
     order = "descending" if descending else "ascending"
     if block.num_rows == 0:
         return block
-    return block.take(pa.compute.sort_indices(block, sort_keys=[(key, order)]))
+    import pyarrow.compute as pc  # submodule: not loaded by "import pyarrow"
+
+    return block.take(pc.sort_indices(block, sort_keys=[(key, order)]))
 
 
 def hash_partition_block(

@@ -31,6 +31,12 @@ def _strategy_fields(opts):
     """Extract (pg_id, bundle_index, strategy_dict) from scheduling options."""
     pg_id, bundle_index, strategy = None, -1, None
     ss = opts.get("scheduling_strategy")
+    if ss == "SPREAD":
+        # Reference: scheduling_strategy="SPREAD" places tasks on the
+        # least-loaded feasible nodes (scheduling_options.h SPREAD).
+        return None, -1, {"spread": True}
+    if ss == "DEFAULT":
+        return None, -1, None
     if ss is not None:
         from ray_tpu.util.scheduling_strategies import (
             NodeAffinitySchedulingStrategy,

@@ -1,0 +1,80 @@
+"""Scalability-envelope tests (reference: release/benchmarks/README.md bars:
+10k+ queued tasks per node, 40k actors, 1k PGs cluster-wide — scaled to a
+single CI host). Excluded from the default run (`-m 'not scale'`); run with:
+
+    python -m pytest -m scale tests/test_scale.py -q
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+pytestmark = pytest.mark.scale
+
+
+@pytest.fixture
+def big_cluster(shutdown_only, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_MAX_WORKERS_PER_NODE", "300")
+    monkeypatch.setenv("RAY_TPU_ACTOR_RESOLVE_TIMEOUT_S", "800")
+    ray_tpu.init(num_cpus=256, num_tpus=0)
+    yield
+
+
+@pytest.mark.timeout(900)
+def test_10k_queued_tasks(big_cluster):
+    """10,000 tasks queued at once all complete (reference bar: 1M queued on
+    one m4.16xlarge; scaled to CI)."""
+
+    @ray_tpu.remote(num_cpus=8)  # bound worker-process count to ~32
+    def tick(i):
+        return i
+
+    refs = [tick.remote(i) for i in range(10_000)]
+    out = ray_tpu.get(refs, timeout=600)
+    assert out == list(range(10_000))
+
+
+@pytest.mark.timeout(900)
+def test_200_actors(big_cluster):
+    """200 concurrent actors all answer (reference bar: 40k cluster-wide)."""
+
+    @ray_tpu.remote(num_cpus=0.5)
+    class Cell:
+        def __init__(self, i):
+            self.i = i
+
+        def who(self):
+            return self.i
+
+    actors = [Cell.remote(i) for i in range(200)]
+    out = ray_tpu.get([a.who.remote() for a in actors], timeout=600)
+    assert out == list(range(200))
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+@pytest.mark.timeout(900)
+def test_50_placement_groups(big_cluster):
+    """50 simultaneous placement groups become ready and host work
+    (reference bar: 1k+ cluster-wide)."""
+    from ray_tpu.util.placement_group import placement_group, remove_placement_group
+    from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+    @ray_tpu.remote(num_cpus=1)
+    def inside():
+        return 1
+
+    pgs = [placement_group([{"CPU": 1}]) for _ in range(50)]
+    for pg in pgs:
+        assert pg.wait(timeout=120)
+    refs = [
+        inside.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(placement_group=pg)
+        ).remote()
+        for pg in pgs
+    ]
+    assert sum(ray_tpu.get(refs, timeout=600)) == 50
+    for pg in pgs:
+        remove_placement_group(pg)

@@ -1,0 +1,82 @@
+"""Scheduling policy fidelity (reference:
+src/ray/raylet/scheduling/policy/hybrid_scheduling_policy.cc +
+scheduling_options.h SPREAD/NODE_AFFINITY; test shapes mirror
+cluster_task_manager_test.cc scenarios)."""
+
+import time
+from collections import Counter
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+
+@pytest.fixture
+def three_nodes():
+    cluster = Cluster(head_node_args={"num_cpus": 2, "num_tpus": 0})
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    cluster.connect()
+    yield cluster
+    cluster.shutdown()
+
+
+@ray_tpu.remote
+def where():
+    import os
+
+    return os.environ["RAY_TPU_NODE_ID"]
+
+
+def test_spread_strategy_uses_all_nodes(three_nodes):
+    refs = [
+        where.options(scheduling_strategy="SPREAD").remote() for _ in range(12)
+    ]
+    nodes = Counter(ray_tpu.get(refs, timeout=120))
+    # SPREAD must land tasks on every node, not pile onto the head.
+    assert len(nodes) == 3, f"SPREAD used only {dict(nodes)}"
+
+
+def test_hybrid_spills_past_threshold(three_nodes):
+    """Hybrid packs locally while below the spread threshold, then moves
+    excess load to other nodes — a burst larger than the head node's CPUs
+    must not all run on the head."""
+
+    @ray_tpu.remote
+    def hold():
+        import os
+        import time as _t
+
+        _t.sleep(1.5)
+        return os.environ["RAY_TPU_NODE_ID"]
+
+    refs = [hold.remote() for _ in range(6)]
+    nodes = Counter(ray_tpu.get(refs, timeout=120))
+    assert len(nodes) >= 2, f"hybrid never spilled: {dict(nodes)}"
+
+
+def test_node_affinity_task(three_nodes):
+    target = [n["node_id"] for n in ray_tpu.nodes()][-1]
+    refs = [
+        where.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=target, soft=False
+            )
+        ).remote()
+        for _ in range(4)
+    ]
+    assert set(ray_tpu.get(refs, timeout=120)) == {target}
+
+
+def test_node_affinity_hard_missing_node_fails(three_nodes):
+    with pytest.raises(Exception, match="affinity target"):
+        ray_tpu.get(
+            where.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id="f" * 32, soft=False
+                )
+            ).remote(),
+            timeout=60,
+        )

@@ -53,6 +53,15 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     # external_storage.py): sealed objects are written to disk when the shm
     # arena fills and restored on access. Empty dir -> default under /tmp.
     "object_spilling_dir": "",
+    # JSON spilling config selecting a registered external-storage backend,
+    # e.g. '{"type": "filesystem", "params": {"directory_path": "/mnt/x"}}'
+    # (reference: RAY_object_spilling_config). Empty -> filesystem under
+    # object_spilling_dir.
+    "object_spilling_config": "",
+    # Spill/restore IO thread-pool width (reference: max_io_workers,
+    # ray_config_def.h). IO runs off the raylet event loop so multi-GiB
+    # spills never stall lease grants or RPCs.
+    "max_io_workers": 4,
     # Create-request backpressure: how long ObjCreate waits for spill/eviction
     # to make room before failing (plasma create_request_queue.cc analog).
     "object_store_create_timeout_s": 30.0,

@@ -1,0 +1,141 @@
+"""Pluggable external storage for object spilling.
+
+Analog of python/ray/_private/external_storage.py: the raylet's local object
+manager hands sealed objects to an ExternalStorage backend when the shm arena
+fills, and reads them back on access. Backends are chosen by a JSON spilling
+config (reference: ``RAY_object_spilling_config`` ``{"type": ..., "params":
+...}``) and are registered by scheme so deployments can plug remote stores
+(GCS buckets, NFS) without touching the raylet.
+
+Unlike the reference (which forks dedicated IO-worker *processes*,
+src/ray/raylet/local_object_manager.cc), IO here runs on a thread pool owned
+by the raylet: spill/restore are pure byte copies that release the GIL inside
+file read/write, so threads give the same event-loop isolation without
+process-spawn cost.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+from typing import Callable, Dict, Optional
+
+from ray_tpu._private.common import config
+
+
+class ExternalStorage:
+    """One spill backend. Implementations must be thread-safe: the raylet
+    calls spill/restore/delete concurrently from IO-pool threads."""
+
+    def spill(self, oid: str, data: memoryview) -> str:
+        """Write one object's bytes; returns an opaque URI for restore."""
+        raise NotImplementedError
+
+    def restore(self, uri: str, dest: memoryview) -> int:
+        """Read the object at ``uri`` into ``dest``; returns bytes read."""
+        raise NotImplementedError
+
+    def delete(self, uri: str) -> None:
+        raise NotImplementedError
+
+    def destroy(self) -> None:
+        """Session teardown: drop everything this backend wrote."""
+
+
+class FileSystemStorage(ExternalStorage):
+    """Spill to a local directory; one file per object (reference:
+    FileSystemStorage, external_storage.py:246)."""
+
+    def __init__(self, directory_path: str):
+        self.base = directory_path
+        self._made = False
+        self._lock = threading.Lock()
+
+    def _ensure_dir(self) -> None:
+        if not self._made:
+            with self._lock:
+                os.makedirs(self.base, exist_ok=True)
+                self._made = True
+
+    def spill(self, oid: str, data: memoryview) -> str:
+        self._ensure_dir()
+        # Unique per-spill filename: a stale fire-and-forget delete of a
+        # prior generation's URI must never unlink a fresh re-spill.
+        path = os.path.join(self.base, f"{oid}-{os.urandom(4).hex()}")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)  # readers never see partial writes
+        return "file://" + path
+
+    def restore(self, uri: str, dest: memoryview) -> int:
+        path = uri[len("file://") :]
+        with open(path, "rb") as f:
+            n = f.readinto(dest)
+        return n or 0
+
+    def delete(self, uri: str) -> None:
+        try:
+            os.unlink(uri[len("file://") :])
+        except OSError:
+            pass
+
+    def destroy(self) -> None:
+        shutil.rmtree(self.base, ignore_errors=True)
+
+
+_REGISTRY: Dict[str, Callable[[dict], ExternalStorage]] = {
+    "filesystem": lambda params: FileSystemStorage(**params),
+}
+
+
+def register_storage_backend(
+    name: str, factory: Callable[[dict], ExternalStorage]
+) -> None:
+    """Register a spill backend under ``name`` so a spilling config
+    ``{"type": name, "params": {...}}`` can select it — the hook remote
+    storage (S3-style) implementations plug into."""
+    _REGISTRY[name] = factory
+
+
+def create_storage(
+    spilling_config: str, default_dir: str, namespace: str = ""
+) -> ExternalStorage:
+    """Build the session's spill backend from the JSON spilling config, or a
+    FileSystemStorage under ``default_dir`` when the config is empty.
+
+    ``namespace`` (session+node scoped) is appended to any filesystem
+    directory — including an explicitly configured one — so raylets sharing
+    a mount never collide on files, and ``destroy()`` at node shutdown only
+    removes this node's subtree."""
+    if not spilling_config:
+        return FileSystemStorage(default_dir)
+    try:
+        cfg = json.loads(spilling_config)
+    except json.JSONDecodeError as e:
+        raise ValueError(f"bad object_spilling_config: {e}") from e
+    typ = cfg.get("type", "filesystem")
+    factory = _REGISTRY.get(typ)
+    if factory is None and ":" in typ:
+        # Importable "pkg.mod:factory" types work in subprocess-mode raylets
+        # too, where driver-side register_storage_backend() calls never ran
+        # (reference: custom external storage via importable module path).
+        import importlib
+
+        mod_name, _, attr = typ.partition(":")
+        factory = getattr(importlib.import_module(mod_name), attr)
+    if factory is None:
+        raise ValueError(
+            f"unknown spill backend {typ!r}; registered: {sorted(_REGISTRY)} "
+            "(or use an importable 'pkg.mod:factory' type)"
+        )
+    params = dict(cfg.get("params") or {})
+    if typ == "filesystem":
+        if "directory_path" in params and namespace:
+            params["directory_path"] = os.path.join(
+                params["directory_path"], namespace
+            )
+        params.setdefault("directory_path", default_dir)
+    return factory(params)

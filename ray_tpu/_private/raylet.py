@@ -17,13 +17,14 @@ mirroring the reference's TPUAcceleratorManager
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
 import logging
 import os
 import sys
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
-from ray_tpu._private import rpc, shm
+from ray_tpu._private import external_storage, rpc, shm
 from ray_tpu._private.common import ResourceSet, config
 from ray_tpu._private.gcs import GcsClient
 from ray_tpu._private.store_core import make_store_core
@@ -134,17 +135,29 @@ class Raylet:
         # Deleted objects are quarantined (not freed) for the grace window:
         # clients may still hold zero-copy views into their arena bytes.
         self.condemned: Dict[str, float] = {}
-        # Spilled objects: oid -> (path, size, pinned). Sealed objects are
-        # written out when the arena fills and restored on access (reference:
-        # raylet LocalObjectManager spill orchestration +
-        # python/ray/_private/external_storage.py file layout).
+        # Spilled objects: oid -> (uri, size, pinned). Sealed objects are
+        # written out via the pluggable ExternalStorage backend when the arena
+        # fills and restored on access (reference: raylet LocalObjectManager
+        # spill orchestration + python/ray/_private/external_storage.py).
+        # Spill/restore IO runs on a thread pool, never on the event loop
+        # (reference spills via async IO workers, local_object_manager.cc) —
+        # `spilling` tracks in-flight writes (bytes still live in the arena
+        # until the write lands), `restoring` coalesces concurrent reads.
         self.spilled: Dict[str, Tuple[str, int, bool]] = {}
         self.spilled_bytes = 0
+        self.spilling: Dict[str, asyncio.Task] = {}
+        self.restoring: Dict[str, asyncio.Future] = {}
         base = config.object_spilling_dir or os.path.join(
             "/tmp", "ray_tpu_spill"
         )
-        self.spill_dir = os.path.join(
-            base, f"{self.session_name[:16]}_{self.node_id[:8]}"
+        spill_ns = f"{self.session_name[:16]}_{self.node_id[:8]}"
+        self.spill_dir = os.path.join(base, spill_ns)
+        self.storage = external_storage.create_storage(
+            config.object_spilling_config, self.spill_dir, namespace=spill_ns
+        )
+        self._io_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(1, config.max_io_workers),
+            thread_name_prefix=f"spill-io-{self.node_id[:6]}",
         )
         # Per-worker stdout/stderr files (reference: session_latest/logs).
         import tempfile
@@ -252,17 +265,39 @@ class Raylet:
             t.cancel()
         for w in list(self.workers.values()):
             self._kill_worker_proc(w)
+        # Quiesce spill IO before the arena unmaps: pool threads and
+        # suspended spill/restore frames hold memoryview slices into it;
+        # mmap.close() with exported views raises BufferError.
+        spill_tasks = list(self.spilling.values())
+        for t in spill_tasks:
+            t.cancel()
+        if spill_tasks:
+            await asyncio.gather(*spill_tasks, return_exceptions=True)
+        self.spilling.clear()
+        self.spilled.clear()
+        self._io_pool.shutdown(wait=True, cancel_futures=True)
+        for fut in list(self.restoring.values()):
+            try:
+                await asyncio.wait_for(asyncio.shield(fut), timeout=5)
+            except Exception:
+                pass
+        try:
+            self.storage.destroy()
+        except Exception:
+            pass
         if self.arena is not None:
-            self.arena.close()
+            for _ in range(100):
+                try:
+                    self.arena.close()
+                    break
+                except BufferError:
+                    # An RPC handler frame still holds a view; it releases
+                    # within a loop turn or two.
+                    await asyncio.sleep(0.05)
             try:
                 shm.unlink(self.arena_name)
             except Exception:
                 pass
-        if self.spilled:
-            import shutil
-
-            self.spilled.clear()
-            shutil.rmtree(self.spill_dir, ignore_errors=True)
         await self.server.stop()
         if self.gcs is not None:
             await self.gcs.conn.close()
@@ -866,8 +901,10 @@ class Raylet:
         now = time.monotonic()
         grace = config.object_store_eviction_grace_s
         for oid, t in list(self.condemned.items()):
-            if oid in self.obj_holds:
-                continue  # a client still maps it; reclaim after release
+            if oid in self.obj_holds or oid in self.restoring:
+                # A client still maps it, or a restore IO thread is writing
+                # into the span — reclaim once that settles.
+                continue
             if force or now - t >= grace:
                 self.store.free(oid)
                 del self.condemned[oid]
@@ -913,7 +950,7 @@ class Raylet:
         grace = config.object_store_eviction_grace_s
         candidates = []
         for vic, last in self.obj_last_access.items():
-            if now - last < grace or vic in self.obj_holds:
+            if now - last < grace or vic in self.obj_holds or vic in self.spilling:
                 continue
             info = self.store.lookup(vic)
             if info is not None and info[2] and not info[3]:
@@ -925,83 +962,182 @@ class Raylet:
             offset = self.store.alloc(oid, size, pin)
             if offset >= 0:
                 return offset
-        # Still no room: spill sealed, unheld objects (LRU-first) to disk.
-        # Reference: LocalObjectManager::SpillObjectsOfSize.
-        spill_candidates = []
-        for vic, last in self.obj_last_access.items():
-            if vic in self.obj_holds or vic in self.condemned:
-                continue
-            info = self.store.lookup(vic)
-            if info is not None and info[2]:
-                spill_candidates.append((last, vic))
-        spill_candidates.sort()
-        for _, vic in spill_candidates:
-            self._spill_object(vic)
-            offset = self.store.alloc(oid, size, pin)
-            if offset >= 0:
-                return offset
+        # Still no room: start spilling sealed, unheld objects (LRU-first).
+        # Spill IO is asynchronous (thread pool) — the span only frees once
+        # the write lands, so report failure now and let the caller's retry
+        # loop (ObjCreate backpressure / restore retries) pick up the freed
+        # space. Reference: LocalObjectManager::SpillObjectsOfSize + async IO
+        # workers (local_object_manager.cc).
+        self._start_spills(size)
         return -1
 
     # -- spilling (reference: local_object_manager.cc, external_storage.py) --
 
-    def _spill_object(self, oid: str) -> None:
-        info = self.store.lookup(oid)
-        if info is None or not info[2]:
+    def _start_spills(self, need_bytes: int) -> None:
+        """Schedule spill writes for LRU victims until in-flight spills cover
+        ``need_bytes`` (or no candidates remain)."""
+        in_flight = 0
+        for vic in self.spilling:
+            info = self.store.lookup(vic)
+            if info is not None:
+                in_flight += info[1]
+        if in_flight >= need_bytes:
             return
-        off, size, _, pinned = info
-        os.makedirs(self.spill_dir, exist_ok=True)
-        path = os.path.join(self.spill_dir, oid)
-        with open(path, "wb") as f:
-            f.write(self.arena.view[off : off + size])
-        self.spilled[oid] = (path, size, pinned)
-        self.spilled_bytes += size
-        self.store.free(oid)
-        self.obj_last_access.pop(oid, None)
-        logger.info(
-            "spilled %s (%d bytes) to disk; store %d/%d",
-            oid[:12],
-            size,
-            self.store.used,
-            self.store_capacity,
-        )
+        candidates = []
+        for vic, last in self.obj_last_access.items():
+            if (
+                vic in self.obj_holds
+                or vic in self.condemned
+                or vic in self.spilling
+                or vic in self.restoring
+            ):
+                continue
+            info = self.store.lookup(vic)
+            if info is not None and info[2]:
+                candidates.append((last, vic, info[1]))
+        candidates.sort()
+        for _, vic, vsize in candidates:
+            self.spilling[vic] = rpc.spawn(self._spill_task(vic))
+            in_flight += vsize
+            if in_flight >= need_bytes:
+                break
 
-    def _restore_object(self, oid: str) -> Optional[int]:
+    async def _spill_task(self, oid: str) -> None:
+        """One spill write: copy arena bytes out via the storage backend on
+        the IO pool, then free the span — unless the object was deleted or
+        grabbed by a client while the write was in flight."""
+        try:
+            info = self.store.lookup(oid)
+            if info is None or not info[2]:
+                return
+            off, size, _, pinned = info
+            view = self.arena.view[off : off + size]
+            loop = asyncio.get_running_loop()
+            try:
+                uri = await loop.run_in_executor(
+                    self._io_pool, self.storage.spill, oid, view
+                )
+            except Exception:
+                logger.exception("spill of %s failed", oid[:12])
+                return
+            # Re-check: a delete/condemn, a new client hold, or a
+            # delete-then-recreate (same oid, new span — detectable as a
+            # changed offset/size or an unsealed state) during the write
+            # means the external copy is stale or the arena copy is still
+            # the live one — discard the external copy.
+            info2 = self.store.lookup(oid)
+            if (
+                info2 is None
+                or info2[0] != off
+                or info2[1] != size
+                or not info2[2]
+                or oid in self.condemned
+                or oid in self.obj_holds
+                or oid in self.spilled
+            ):
+                await loop.run_in_executor(self._io_pool, self.storage.delete, uri)
+                return
+            self.spilled[oid] = (uri, size, pinned)
+            self.spilled_bytes += size
+            self.store.free(oid)
+            self.obj_last_access.pop(oid, None)
+            logger.info(
+                "spilled %s (%d bytes) to %s; store %d/%d",
+                oid[:12],
+                size,
+                uri.split("://", 1)[0],
+                self.store.used,
+                self.store_capacity,
+            )
+        finally:
+            self.spilling.pop(oid, None)
+
+    async def _restore_object(self, oid: str) -> Optional[int]:
         """Bring a spilled object back into the arena; returns offset or
-        None. Restoring may itself spill colder objects."""
+        None (arena transiently full — caller retries). Concurrent restores
+        of one object coalesce on a shared future; the read runs on the IO
+        pool so the event loop never blocks on storage."""
+        fut = self.restoring.get(oid)
+        if fut is not None:
+            return await asyncio.shield(fut)
         entry = self.spilled.get(oid)
         if entry is None:
             return None
-        path, size, pinned = entry
+        uri, size, pinned = entry
         offset = self._try_alloc(oid, size, pinned)
         if offset < 0:
             return None
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self.restoring[oid] = fut
+        ok = False
         try:
-            with open(path, "rb") as f:
-                data = f.read()
-            self.arena.view[offset : offset + len(data)] = data
-        except OSError:
-            self.store.free(oid)
+            dest = self.arena.view[offset : offset + size]
+            try:
+                n = await loop.run_in_executor(
+                    self._io_pool, self.storage.restore, uri, dest
+                )
+                ok = n == size
+            except Exception:
+                logger.exception("restore of %s failed", oid[:12])
+            if oid in self.condemned:
+                # Deleted while the read was in flight: abandon the restore;
+                # the condemned sweep reclaims the span now that we are no
+                # longer writing it.
+                self.store.free(oid)
+                self.condemned.pop(oid, None)
+                fut.set_result(None)
+                return None
+            if not ok or self.store.lookup(oid) is None:
+                # IO errors are treated as transient (a remote backend can
+                # 503): keep the spilled entry and the external copy so the
+                # caller's backpressure loop can retry; only the caller's
+                # deadline turns persistent failure into object-lost.
+                self.store.free(oid)
+                fut.set_result(None)
+                return None
+            self.store.seal(oid)
+            self.obj_last_access[oid] = time.monotonic()
             if self.spilled.pop(oid, None) is not None:
                 self.spilled_bytes -= size
-            return None
-        self.store.seal(oid)
-        self.obj_last_access[oid] = time.monotonic()
-        del self.spilled[oid]
-        self.spilled_bytes -= size
-        try:
-            os.unlink(path)
-        except OSError:
-            pass
-        return offset
+            # Fire-and-forget: the external copy's deletion must not hold the
+            # RPC reply (or fail it after a successful restore).
+            try:
+                self._io_pool.submit(self.storage.delete, uri)
+            except RuntimeError:  # pool already shut down at teardown
+                pass
+            fut.set_result(offset)
+            for w in self.obj_waiters.pop(oid, []):
+                if not w.done():
+                    w.set_result(True)
+            return offset
+        finally:
+            if not fut.done():
+                fut.set_result(None)
+            self.restoring.pop(oid, None)
+
+    async def _restore_with_backpressure(self, oid: str) -> None:
+        """Restore a spilled object, retrying while the arena is transiently
+        full (async spills free room within ~the IO latency). A restore
+        failure here must stay transient, not become a spurious copy-lost:
+        the bytes still exist in external storage."""
+        deadline = time.monotonic() + config.object_store_create_timeout_s
+        while oid in self.spilled and oid not in self.condemned:
+            if await self._restore_object(oid) is not None:
+                return
+            if time.monotonic() >= deadline:
+                return
+            await asyncio.sleep(0.05)
 
     def _drop_spilled(self, oid: str) -> None:
         entry = self.spilled.pop(oid, None)
         if entry is None:
             return
         self.spilled_bytes -= entry[1]
+        uri = entry[0]
         try:
-            os.unlink(entry[0])
-        except OSError:
+            self._io_pool.submit(self.storage.delete, uri)
+        except RuntimeError:  # pool already shut down at teardown
             pass
 
     # -- memory monitor (reference: memory_monitor.h + worker_killing_policy)
@@ -1066,6 +1202,13 @@ class Raylet:
         pin = bool(p.get("pin", True))
         deadline = time.monotonic() + config.object_store_create_timeout_s
         while True:
+            fut = self.restoring.get(oid)
+            if fut is not None:
+                # A restore IO thread is writing this span: let it finish
+                # before any free/recreate decision (the restored bytes are
+                # the deterministically identical object anyway).
+                await asyncio.shield(fut)
+                continue
             if oid in self.condemned:
                 if oid in self.obj_holds:
                     # A client still maps the old (deterministically
@@ -1080,7 +1223,7 @@ class Raylet:
             if oid in self.spilled:
                 # Deterministic recreate of a spilled object: restore it (may
                 # fail transiently while the arena is full of held objects).
-                self._restore_object(oid)
+                await self._restore_object(oid)
             info = self.store.lookup(oid)
             if info is not None:
                 self.obj_last_access[oid] = time.monotonic()
@@ -1128,12 +1271,19 @@ class Raylet:
             if oid in self.spilled and oid not in self.condemned:
                 # Restore backpressure: the arena may be transiently full of
                 # client-held objects; holds release within ~1s (client flush
-                # loops), so retry until the caller's deadline.
+                # loops), so retry until the caller's deadline — but never
+                # past the create-timeout cap: a timeout-less blocking get on
+                # a persistently failing restore must surface as missing, not
+                # hang the RPC forever.
+                restore_cap = (
+                    time.monotonic() + config.object_store_create_timeout_s
+                )
                 while (
-                    self._restore_object(oid) is None
+                    await self._restore_object(oid) is None
                     and oid in self.spilled
                     and p.get("block", True)
                     and (deadline is None or time.monotonic() < deadline)
+                    and time.monotonic() < restore_cap
                 ):
                     await asyncio.sleep(0.05)
             info = None if oid in self.condemned else self.store.lookup(oid)
@@ -1201,8 +1351,7 @@ class Raylet:
     async def _pull_object(self, conn, p):
         """Fetch an object from a remote raylet into the local store."""
         oid = p["oid"]
-        if oid in self.spilled:
-            self._restore_object(oid)
+        await self._restore_with_backpressure(oid)
         info = self.store.lookup(oid)
         if info is not None and info[2]:
             self._add_hold(conn, oid)
@@ -1220,6 +1369,9 @@ class Raylet:
             size = meta["size"]
             create = await self._obj_create(conn, {"oid": oid, "size": size, "pin": False})
             if create.get("sealed"):
+                # Hold for the caller like the sibling paths: an unheld span
+                # could be spilled/evicted before the puller reads it.
+                self._add_hold(conn, oid)
                 return create
             if create.get("exists"):
                 # Another pull is filling it; wait for the seal and verify.
@@ -1250,8 +1402,7 @@ class Raylet:
             await remote.close()
 
     async def _fetch_chunk(self, conn, p):
-        if p["oid"] in self.spilled:
-            self._restore_object(p["oid"])
+        await self._restore_with_backpressure(p["oid"])
         info = self.store.lookup(p["oid"])
         if info is None or not info[2]:
             raise rpc.RpcError(f"object {p['oid'][:12]} not local")

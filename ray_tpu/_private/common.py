@@ -65,6 +65,10 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     # Create-request backpressure: how long ObjCreate waits for spill/eviction
     # to make room before failing (plasma create_request_queue.cc analog).
     "object_store_create_timeout_s": 30.0,
+    # Push manager: max chunks in flight across ALL destination pushes from
+    # one node (reference: push_manager.h max_chunks_in_flight). With 8 MiB
+    # chunks the default bounds broadcast buffering at ~64 MiB.
+    "push_manager_max_chunks": 8,
     # Memory monitor (reference: memory_monitor.h:52 + worker_killing_policy):
     # kill the newest leased worker when system memory use crosses the
     # threshold. interval 0 disables.

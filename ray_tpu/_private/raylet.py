@@ -25,6 +25,7 @@ import time
 from typing import Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private import external_storage, rpc, shm
+from ray_tpu._private.push_manager import PushManager
 from ray_tpu._private.common import ResourceSet, config
 from ray_tpu._private.gcs import GcsClient
 from ray_tpu._private.store_core import make_store_core
@@ -159,6 +160,11 @@ class Raylet:
             max_workers=max(1, config.max_io_workers),
             thread_name_prefix=f"spill-io-{self.node_id[:6]}",
         )
+        # Cross-node transfer: source-side push fan-out with a global chunk
+        # budget (reference: push_manager.h); `push_assembly` tracks inbound
+        # pushes being written into unsealed spans.
+        self.push_manager = PushManager(self)
+        self.push_assembly: Dict[str, Dict[str, int]] = {}
         # Per-worker stdout/stderr files (reference: session_latest/logs).
         import tempfile
 
@@ -275,7 +281,19 @@ class Raylet:
             await asyncio.gather(*spill_tasks, return_exceptions=True)
         self.spilling.clear()
         self.spilled.clear()
-        self._io_pool.shutdown(wait=True, cancel_futures=True)
+        try:
+            # Bounded: a wedged storage backend (stalled NFS/remote store)
+            # must not hang node shutdown; the arena-close retry below copes
+            # if a thread is abandoned mid-IO.
+            await asyncio.wait_for(
+                asyncio.get_running_loop().run_in_executor(
+                    None,
+                    lambda: self._io_pool.shutdown(wait=True, cancel_futures=True),
+                ),
+                timeout=10,
+            )
+        except (asyncio.TimeoutError, RuntimeError):
+            logger.warning("spill IO pool did not quiesce; abandoning threads")
         for fut in list(self.restoring.values()):
             try:
                 await asyncio.wait_for(asyncio.shield(fut), timeout=5)
@@ -285,6 +303,8 @@ class Raylet:
             self.storage.destroy()
         except Exception:
             pass
+        self.push_assembly.clear()
+        await self.push_manager.close()
         if self.arena is not None:
             for _ in range(100):
                 try:
@@ -319,6 +339,9 @@ class Raylet:
         s.register("ObjPin", self._obj_pin)
         s.register("PullObject", self._pull_object)
         s.register("FetchChunk", self._fetch_chunk)
+        s.register("PushObject", self._push_object)
+        s.register("PushStart", self._push_start)
+        s.register("PushChunk", self._push_chunk)
         s.register("PreparePGBundles", self._prepare_pg)
         s.register("CommitPGBundles", self._commit_pg)
         s.register("ReleasePGBundles", self._release_pg)
@@ -568,6 +591,11 @@ class Raylet:
             holds.pop(cid, None)
             if not holds:
                 del self.obj_holds[oid]
+        # Abort inbound pushes whose source link died: a half-assembled
+        # unsealed span would otherwise stay unfetchable forever.
+        for oid, st in list(self.push_assembly.items()):
+            if st.get("conn") == cid:
+                self._abort_push_assembly(oid)
         worker_id = conn.context.get("worker_id")
         if worker_id and worker_id in self.workers:
             handle = self.workers[worker_id]
@@ -894,6 +922,17 @@ class Raylet:
         while True:
             await asyncio.sleep(1.0)
             self._sweep_condemned()
+            # Expire inbound pushes with no chunk progress (source wedged
+            # without disconnecting): 60s of silence vastly exceeds any
+            # chunk cadence the push budget allows.
+            now = time.monotonic()
+            for oid, st in list(self.push_assembly.items()):
+                if now - st.get("last", now) > 60.0:
+                    logger.warning(
+                        "aborting stalled inbound push of %s (%d/%d bytes)",
+                        oid[:12], st["recv"], st["size"],
+                    )
+                    self._abort_push_assembly(oid)
 
     def _sweep_condemned(self, force: bool = False) -> None:
         """Return quarantined spans to the allocator once the grace window has
@@ -901,9 +940,14 @@ class Raylet:
         now = time.monotonic()
         grace = config.object_store_eviction_grace_s
         for oid, t in list(self.condemned.items()):
-            if oid in self.obj_holds or oid in self.restoring:
-                # A client still maps it, or a restore IO thread is writing
-                # into the span — reclaim once that settles.
+            if (
+                oid in self.obj_holds
+                or oid in self.restoring
+                or oid in self.push_assembly
+            ):
+                # A client still maps it, a restore IO thread is writing the
+                # span, or an inbound push is mid-assembly — reclaim once
+                # that settles (assemblies abort on the next chunk/expiry).
                 continue
             if force or now - t >= grace:
                 self.store.free(oid)
@@ -1348,8 +1392,67 @@ class Raylet:
         holds = self.obj_holds.setdefault(oid, {})
         holds[id(conn)] = holds.get(id(conn), 0) + 1
 
+    # -- inbound push handlers (reference: object_manager HandlePush) --------
+
+    async def _push_object(self, conn, p):
+        """Source side: stream our local copy of an object to a destination
+        raylet. Triggered by the destination's pull; the push manager dedups
+        concurrent requests and bounds chunks in flight across ALL
+        destinations (broadcast-safe fan-out)."""
+        await self.push_manager.push(p["oid"], tuple(p["to"]))
+        return {"ok": True}
+
+    async def _push_start(self, conn, p):
+        """Destination side: allocate an unsealed span for an inbound push.
+        Returns needed=False when the object already exists or another
+        transfer is assembling it."""
+        oid, size = p["oid"], p["size"]
+        meta = await self._obj_create(conn, {"oid": oid, "size": size, "pin": False})
+        if meta.get("exists") or oid in self.push_assembly:
+            return {"needed": False}
+        self.push_assembly[oid] = {
+            "offset": meta["offset"],
+            "size": size,
+            "recv": 0,
+            "conn": id(conn),
+            "last": time.monotonic(),
+        }
+        return {"needed": True}
+
+    async def _push_chunk(self, conn, p):
+        """Destination side: one inbound chunk (one-way message). Seals and
+        wakes waiters when the last byte lands."""
+        st = self.push_assembly.get(p["oid"])
+        if st is None:
+            return  # assembly aborted (e.g. object deleted mid-push)
+        if p["oid"] in self.condemned:
+            # Deleted mid-assembly: stop writing before the condemned sweep
+            # can free the span out from under us.
+            del self.push_assembly[p["oid"]]
+            return
+        data = p["data"]
+        base = st["offset"] + p["offset"]
+        self.arena.view[base : base + len(data)] = data
+        st["recv"] += len(data)
+        st["last"] = time.monotonic()
+        if st["recv"] >= st["size"]:
+            del self.push_assembly[p["oid"]]
+            await self._obj_seal(conn, {"oid": p["oid"]})
+
+    def _abort_push_assembly(self, oid: str) -> None:
+        """Drop a dead inbound push so the oid does not stay permanently
+        unfetchable (exists-unsealed would make every future PushStart answer
+        needed=False). Deleting the unsealed object quarantines the span;
+        the next pull re-creates and re-transfers it."""
+        if self.push_assembly.pop(oid, None) is not None:
+            self._delete_object(oid)
+
     async def _pull_object(self, conn, p):
-        """Fetch an object from a remote raylet into the local store."""
+        """Fetch an object from a remote raylet into the local store.
+
+        Fast path: ask the source to *push* (one-way chunk stream through its
+        push manager — broadcast-friendly). Fallback: the legacy chunk pull
+        (request/reply FetchChunk loop)."""
         oid = p["oid"]
         await self._restore_with_backpressure(oid)
         info = self.store.lookup(oid)
@@ -1358,6 +1461,18 @@ class Raylet:
             return self._obj_meta(oid, info)
         remote = await rpc.connect(*p["from_addr"], retry=3)
         try:
+            try:
+                await remote.call(
+                    "PushObject", {"oid": oid, "to": list(self.addr)}, timeout=120
+                )
+                got = await self._obj_get(
+                    conn, {"oids": [oid], "block": True, "timeout": 30}
+                )
+                found = got["found"].get(oid)
+                if found is not None:
+                    return found  # _obj_get already holds it for this conn
+            except rpc.RpcError as e:
+                logger.debug("push-based pull of %s failed (%s); falling back", oid[:12], e)
             # block briefly: the owner's seal may still be in flight on its
             # raylet connection (puts seal via one-way push).
             reply = await remote.call(
@@ -1478,6 +1593,7 @@ class Raylet:
             "pending_leases": len(self.pending_leases),
             "spilled_objects": len(self.spilled),
             "spilled_bytes": self.spilled_bytes,
+            "push_stats": dict(self.push_manager.stats),
         }
         # Detail payloads for the state API (reference: raylet
         # GetTasksInfo/GetObjectsInfo, node_manager.proto:424-426).

@@ -77,6 +77,35 @@ def test_cross_node_object_transfer(cluster_3):
     assert ray_tpu.get(out, timeout=60) == 360000.0
 
 
+def test_broadcast_uses_push_manager(cluster_3):
+    """One object consumed on every other node: transfers go through the
+    source's push manager (bounded one-way chunk fan-out, reference
+    push_manager.h) rather than per-chunk request/reply pulls."""
+    from ray_tpu._private.common import config
+
+    @ray_tpu.remote(num_cpus=2)
+    def consume(x):
+        return float(x[0] + x[-1])
+
+    data = np.arange(3 * 1024 * 1024, dtype=np.float64)  # 24 MB -> 3 chunks
+    ref = ray_tpu.put(data)  # lands in the head node's store
+    nodes = [n for n in ray_tpu.nodes() if n["total"].get("CPU", 0) >= 20000]
+    assert len(nodes) >= 2
+    outs = [
+        consume.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(n["node_id"])
+        ).remote(ref)
+        for n in nodes
+        for _ in range(2)
+    ]
+    expected = float(data[0] + data[-1])
+    assert all(v == expected for v in ray_tpu.get(outs, timeout=120))
+    stats = cluster_3.head_node.raylet.push_manager.stats
+    assert stats["pushes_completed"] >= 2, stats
+    assert stats["chunks_sent"] >= 2 * 3, stats
+    assert stats["peak_inflight_chunks"] <= config.push_manager_max_chunks, stats
+
+
 def test_placement_group_spread(cluster_3):
     pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
     assert pg.ready(timeout=30)

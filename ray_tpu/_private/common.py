@@ -27,6 +27,11 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     # Worker lease / pool.
     "worker_lease_timeout_s": 60.0,
     "idle_worker_keep_s": 60.0,
+    # How long an owner's surplus idle leases (beyond LeasePool.MAX_IDLE)
+    # park before returning to the raylet. Bursty submitters reuse the full
+    # worker set across bursts; other clients wait at most this long for the
+    # pinned CPUs (in-flight lease requests still force immediate return).
+    "worker_lease_idle_keep_s": 0.5,
     "max_workers_per_node": 64,
     # Health checks (reference cadence: ray_config_def.h:847-853).
     "health_check_initial_delay_s": 5.0,
@@ -65,6 +70,9 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     # Create-request backpressure: how long ObjCreate waits for spill/eviction
     # to make room before failing (plasma create_request_queue.cc analog).
     "object_store_create_timeout_s": 30.0,
+    # Task-event ring: max buffered owner-side task events between 1 Hz GCS
+    # flushes; oldest drop first (reference: task_events_max_num_... knobs).
+    "task_events_max_buffer": 10000,
     # Push manager: max chunks in flight across ALL destination pushes from
     # one node (reference: push_manager.h max_chunks_in_flight). With 8 MiB
     # chunks the default bounds broadcast buffering at ~64 MiB.

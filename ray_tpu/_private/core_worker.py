@@ -247,6 +247,15 @@ class ReferenceTable:
         with self._lock:
             self._entry(oid).local += 1
 
+    def register_task(self, return_ids, dep_oids) -> None:
+        """Submission-time registration under ONE lock acquire (hot path):
+        mark returns owned, count deps as submitted."""
+        with self._lock:
+            for oid in return_ids:
+                self._entry(oid).owned = True
+            for oid in dep_oids:
+                self._entry(oid).submitted += 1
+
     def mark_owned(self, oid: str) -> None:
         with self._lock:
             self._entry(oid).owned = True
@@ -288,7 +297,7 @@ class ReferenceTable:
 class Lease:
     __slots__ = (
         "lease_id", "worker_id", "addr", "conn", "raylet_conn",
-        "outstanding", "in_idle", "checked_out", "used",
+        "outstanding", "in_idle", "checked_out", "used", "parked_at",
     )
 
     def __init__(self, lease_id: str, worker_id: str, addr, conn, raylet_conn):
@@ -310,6 +319,8 @@ class Lease:
         # True once a task has been dispatched on it (SPREAD pools retire
         # used leases instead of recycling them).
         self.used = False
+        # monotonic() when the lease last went fully idle (keep-alive sweep).
+        self.parked_at = 0.0
 
 
 class _ShapePool:
@@ -319,6 +330,7 @@ class _ShapePool:
     __slots__ = (
         "idle", "pending", "inflight", "inflight_ids", "leases",
         "total_outstanding", "resources", "pg_id", "bundle_index", "strategy",
+        "sweep_scheduled",
     )
 
     def __init__(self, resources, pg_id, bundle_index, strategy=None):
@@ -340,6 +352,8 @@ class _ShapePool:
         self.pg_id = pg_id
         self.bundle_index = bundle_index
         self.strategy = strategy
+        # A keep-alive sweep timer is pending for this pool's parked leases.
+        self.sweep_scheduled = False
 
 
 class LeasePool:
@@ -360,8 +374,11 @@ class LeasePool:
     # max_pending_lease_requests_per_scheduling_category).
     MAX_INFLIGHT = 16
     # Tasks pushed-but-unreplied per leased worker (execution stays serial on
-    # the worker; >1 hides the push/reply RTT behind execution).
-    PIPELINE_DEPTH = 8
+    # the worker; >1 hides the push/reply RTT behind execution). 16 keeps a
+    # fast worker's queue non-empty across the dispatch round trip at
+    # 10k+ tasks/s; _allowed_depth scales this down whenever the backlog is
+    # small relative to the lease supply, so long-task bursts still spread.
+    PIPELINE_DEPTH = 16
 
     def __init__(self, core: "CoreWorker"):
         self.core = core
@@ -418,7 +435,8 @@ class LeasePool:
                     pool.leases.discard(lease)
                 else:
                     live.append(lease)
-            live.sort(key=lambda l: l.outstanding)
+            if len(live) > 1:
+                live.sort(key=lambda l: l.outstanding)
             pool.idle[:] = live
             allowed = self._allowed_depth(pool)
             i = 0
@@ -490,20 +508,52 @@ class LeasePool:
             pool.idle.append(lease)
             lease.in_idle = True
         self._pump(key, pool)
-        # Trim surplus idle capacity back to the raylet: anything beyond
-        # MAX_IDLE, and everything while lease requests are still in flight
-        # (a parked lease + a queued request = a pinned CPU another client
-        # may be waiting on).
-        if (
-            not pool.pending
-            and lease.in_idle
-            and lease.outstanding == 0
-            and (len(pool.idle) > self.MAX_IDLE or pool.inflight > 0)
-        ):
+        # Trim surplus idle capacity back to the raylet. Immediate return
+        # only while lease requests are still in flight (a parked lease + a
+        # queued request = a pinned CPU another client may be waiting on);
+        # otherwise surplus leases park for a short keep-alive window so a
+        # bursty submitter (trial loops, iterative drivers) reuses the full
+        # worker set instead of re-leasing per burst (reference:
+        # worker_lease keepalive in the direct task submitter).
+        if not pool.pending and lease.in_idle and lease.outstanding == 0:
+            if pool.inflight > 0:
+                pool.idle.remove(lease)
+                lease.in_idle = False
+                pool.leases.discard(lease)
+                rpc.spawn(self._return_worker(lease, dirty=False))
+                return
+            lease.parked_at = time.monotonic()
+            if len(pool.idle) > self.MAX_IDLE:
+                self._schedule_idle_sweep(key, pool)
+
+    def _schedule_idle_sweep(self, key, pool: _ShapePool) -> None:
+        if getattr(pool, "sweep_scheduled", False):
+            return
+        pool.sweep_scheduled = True
+        keep = config.worker_lease_idle_keep_s
+        asyncio.get_running_loop().call_later(
+            keep, self._sweep_idle_leases, key, pool
+        )
+
+    def _sweep_idle_leases(self, key, pool: _ShapePool) -> None:
+        pool.sweep_scheduled = False
+        if pool.pending:
+            return  # busy again; leases are in use
+        keep = config.worker_lease_idle_keep_s
+        now = time.monotonic()
+        surplus = len(pool.idle) - self.MAX_IDLE
+        expired = [
+            l
+            for l in pool.idle
+            if l.outstanding == 0 and now - l.parked_at >= keep
+        ]
+        for lease in expired[:surplus] if surplus > 0 else []:
             pool.idle.remove(lease)
             lease.in_idle = False
             pool.leases.discard(lease)
             rpc.spawn(self._return_worker(lease, dirty=False))
+        if len(pool.idle) > self.MAX_IDLE:
+            self._schedule_idle_sweep(key, pool)
 
     async def _request_lease(self, key, pool: _ShapePool) -> None:
         from ray_tpu._private.ids import fast_unique_hex
@@ -605,7 +655,15 @@ class LeasePool:
             entry["conn"] = lease.conn
         core.record_task_event(wire["task_id"], wire["name"], "RUNNING")
         try:
-            fut = lease.conn.call_nowait("PushTask", {"spec": wire})
+            # Inline reply callback (no Future/call_soon hop): the reply
+            # dispatches _on_task_reply straight from the read path.
+            lease.conn.call_cb(
+                "PushTask",
+                {"spec": wire},
+                lambda r, e, k=key, p=pool, l=lease, w=wire: self._on_task_reply(
+                    k, p, l, w, r, e
+                ),
+            )
         except rpc.ConnectionLost:
             if lease.in_idle:
                 pool.idle.remove(lease)
@@ -620,20 +678,22 @@ class LeasePool:
         if lease.outstanding >= self._pool_depth(pool) and lease.in_idle:
             pool.idle.remove(lease)
             lease.in_idle = False
-        fut.add_done_callback(
-            lambda f, k=key, p=pool, l=lease, w=wire: self._on_task_reply(k, p, l, w, f)
-        )
 
-    def _on_task_reply(self, key, pool: _ShapePool, lease: Lease, wire: dict, fut) -> None:
+    def _on_task_reply(self, key, pool: _ShapePool, lease: Lease, wire: dict, reply, err) -> None:
         core = self.core
         lease.outstanding -= 1
         pool.total_outstanding -= 1
         entry = core._inflight_tasks.get(wire["task_id"])
         if entry is not None:
             entry["conn"] = None
-        exc = fut.exception() if not fut.cancelled() else rpc.ConnectionLost("cancelled")
+        exc = None
+        if err is not None:
+            exc = (
+                rpc.ConnectionLost("worker connection lost")
+                if err == rpc._CONNECTION_LOST
+                else rpc.RpcError(err)
+            )
         if exc is None:
-            reply = fut.result()
             core._store_task_results(wire, reply)
             if reply.get("error") is None and wire.get("actor_id") is None:
                 core._register_lineage(wire, reply)
@@ -819,7 +879,14 @@ class CoreWorker:
         self.actor_submitters: Dict[str, ActorSubmitter] = {}
         self._conns: Dict[Tuple[str, int], rpc.Connection] = {}
         self._func_ids_exported: set = set()
-        self._task_events: List[dict] = []
+        # Bounded (reference: task_event_buffer max buffer size): under
+        # sustained 10k+ tasks/s the ring drops oldest events rather than
+        # growing the 1 Hz GCS flush without bound.
+        import collections as _collections
+
+        self._task_events: "deque" = _collections.deque(
+            maxlen=config.task_events_max_buffer
+        )
         self._free_queue: List[str] = []
         self._release_queue: List[str] = []
         # Single-hold releases from value finalizers; appended from whatever
@@ -936,7 +1003,12 @@ class CoreWorker:
     async def _flush_task_events(self) -> None:
         if not self._task_events:
             return
-        events, self._task_events = self._task_events, []
+        import collections as _collections
+
+        events, self._task_events = (
+            list(self._task_events),
+            _collections.deque(maxlen=config.task_events_max_buffer),
+        )
         # Expand the hot-path tuples into wire dicts at flush time (the
         # constant per-process fields are added once here, not per event).
         out = []
@@ -1009,9 +1081,24 @@ class CoreWorker:
         if isinstance(refs, ObjectRef):
             refs, single = [refs], True
         deadline = time.monotonic() + timeout if timeout is not None else None
-        payloads = await asyncio.gather(
-            *(self._resolve_payload(r, deadline) for r in refs)
-        )
+        # Fast path: inline values already in the memory store resolve
+        # synchronously — no gather Task per ref (matters when getting
+        # thousands of mostly-completed refs).
+        payloads = [None] * len(refs)
+        pending_idx = []
+        mget = self.memory_store.get
+        for i, r in enumerate(refs):
+            entry = mget(r.hex())
+            if entry is not None and entry.kind == INLINE:
+                payloads[i] = entry.payload
+            else:
+                pending_idx.append(i)
+        if pending_idx:
+            fetched = await asyncio.gather(
+                *(self._resolve_payload(refs[i], deadline) for i in pending_idx)
+            )
+            for i, p in zip(pending_idx, fetched):
+                payloads[i] = p
         values = []
         with serialization.DeserializationContext(
             ref_deserializer=self._deserialize_ref
@@ -1593,35 +1680,43 @@ class CoreWorker:
                    pg_id=None, bundle_index=-1, scheduling_strategy=None,
                    runtime_env=None) -> dict:
         """Build a task wire dict directly (hot-path form of TaskSpec.to_wire;
-        same keys, no dataclass round-trip)."""
-        return {
+        same keys, no dataclass round-trip).
+
+        SPARSE encoding: fields at their TaskSpec defaults are omitted — all
+        consumers read optional fields with .get() and TaskSpec.from_wire
+        fills dataclass defaults, so the ~12 always-default actor/placement
+        fields never pay msgpack pack+wire+unpack on the normal-task path
+        (a few us per task at 10k tasks/s)."""
+        wire = {
             "task_id": task_id,
             "job_id": self.job_id,
             "name": name,
             "func_id": func_id,
             "args_blob": args_blob,
-            "args_object": args_object,
-            "ref_positions": ref_positions,
-            "kw_ref_keys": kw_ref_keys,
             "dependencies": dependencies,
             "num_returns": num_returns,
             "return_ids": return_ids,
             "resources": resources,
             "max_retries": max_retries,
-            "retry_exceptions": retry_exceptions,
             "owner_addr": list(self.addr),
-            "actor_id": None,
-            "actor_creation": False,
-            "actor_method": None,
-            "seq_no": -1,
             "caller_id": self.worker_id,
-            "max_restarts": 0,
-            "max_concurrency": 1,
-            "pg_id": pg_id,
-            "bundle_index": bundle_index,
-            "scheduling_strategy": scheduling_strategy,
-            "runtime_env": runtime_env,
         }
+        if args_object is not None:
+            wire["args_object"] = args_object
+        if ref_positions:
+            wire["ref_positions"] = ref_positions
+        if kw_ref_keys:
+            wire["kw_ref_keys"] = kw_ref_keys
+        if retry_exceptions:
+            wire["retry_exceptions"] = retry_exceptions
+        if pg_id is not None:
+            wire["pg_id"] = pg_id
+            wire["bundle_index"] = bundle_index
+        if scheduling_strategy is not None:
+            wire["scheduling_strategy"] = scheduling_strategy
+        if runtime_env is not None:
+            wire["runtime_env"] = runtime_env
+        return wire
 
     def _launch_task(self, wire: dict) -> List[ObjectRef]:
         """Register bookkeeping for a built task wire and launch it.
@@ -1634,13 +1729,11 @@ class CoreWorker:
         return refs
 
     def _register_task_bookkeeping(self, wire: dict) -> List[ObjectRef]:
-        refs = []
-        mark_owned = self.reference_table.mark_owned
-        for oid in wire["return_ids"]:
-            mark_owned(oid)
-            refs.append(ObjectRef(oid, self.addr, self))
-        for dep_oid, _ in wire["dependencies"]:
-            self.reference_table.add_submitted(dep_oid)
+        return_ids = wire["return_ids"]
+        self.reference_table.register_task(
+            return_ids, [d for d, _ in wire["dependencies"]]
+        )
+        refs = [ObjectRef(oid, self.addr, self) for oid in return_ids]
         self.record_task_event(wire["task_id"], wire["name"], "PENDING")
         self._inflight_tasks[wire["task_id"]] = {"cancelled": False, "conn": None}
         oid_to_task = self._oid_to_task

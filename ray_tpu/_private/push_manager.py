@@ -101,8 +101,17 @@ class PushManager:
                         "PushChunk", {"oid": oid, "offset": sent, "data": data}
                     )
                     # TCP backpressure: wait for the socket buffer to fall
-                    # below the high-water mark before the next chunk.
-                    await conn.drain()
+                    # below the high-water mark before the next chunk — but
+                    # bounded: a wedged destination (zero-window, stuck loop)
+                    # must not pin a global chunk-budget slot forever.
+                    try:
+                        await asyncio.wait_for(conn.drain(), timeout=30)
+                    except asyncio.TimeoutError:
+                        await conn.close()  # dest aborts assembly on the drop
+                        self._conns.pop(dest, None)
+                        raise rpc.RpcError(
+                            f"push to {dest} stalled (drain timeout)"
+                        )
                     self.stats["chunks_sent"] += 1
                 finally:
                     self.stats["inflight_chunks"] -= 1

@@ -1425,6 +1425,11 @@ class Raylet:
         st = self.push_assembly.get(p["oid"])
         if st is None:
             return  # assembly aborted (e.g. object deleted mid-push)
+        if st.get("conn") != id(conn):
+            # Chunk from a stale source (an aborted push's connection that
+            # un-wedged after a fresh PushStart re-created the assembly):
+            # counting it would seal before the live transfer's tail lands.
+            return
         if p["oid"] in self.condemned:
             # Deleted mid-assembly: stop writing before the condemned sweep
             # can free the span out from under us.
@@ -1471,7 +1476,7 @@ class Raylet:
                 found = got["found"].get(oid)
                 if found is not None:
                     return found  # _obj_get already holds it for this conn
-            except rpc.RpcError as e:
+            except (rpc.RpcError, asyncio.TimeoutError, OSError) as e:
                 logger.debug("push-based pull of %s failed (%s); falling back", oid[:12], e)
             # block briefly: the owner's seal may still be in flight on its
             # raylet connection (puts seal via one-way push).

@@ -67,6 +67,11 @@ _KIND_PUSH = 3
 _MAX_FRAME = 1 << 31
 
 
+# Sentinel error string delivered to call_cb callbacks on connection loss
+# (distinguishes transport death from a handler-level error reply).
+_CONNECTION_LOST = "__connection_lost__"
+
+
 class RpcError(Exception):
     """Raised on the caller when the remote handler raised or the link died."""
 
@@ -142,6 +147,8 @@ class Connection:
         self._on_close = on_close
         self._msgid = itertools.count(1)
         self._pending: Dict[int, asyncio.Future] = {}
+        # Inline reply callbacks (call_cb): msgid -> cb(reply, error).
+        self._cb_pending: Dict[int, Callable] = {}
         self._closed = False
         self._loop = asyncio.get_running_loop()
         self._protocol = _RpcProtocol(self)
@@ -213,6 +220,21 @@ class Connection:
             raise
         return fut
 
+    def call_cb(self, method: str, payload: Any, cb: Callable[[Any, Optional[str]], None]) -> None:
+        """Issue a request whose reply invokes ``cb(reply, error)`` INLINE
+        from the read path — no Future, no call_soon hop. The per-message
+        saving (~5us) matters on >10k-msgs/s pipelines (task dispatch).
+        ``cb`` runs on the loop thread and must not raise; on connection
+        loss every outstanding callback fires with error='connection lost'.
+        Loop thread only."""
+        msgid = next(self._msgid)
+        self._cb_pending[msgid] = cb
+        try:
+            self._send_nowait([msgid, _KIND_REQ, method, payload])
+        except ConnectionLost:
+            self._cb_pending.pop(msgid, None)
+            raise
+
     async def call(self, method: str, payload: Any = None, timeout: Optional[float] = None):
         """Issue a request and await the reply."""
         fut = self.call_nowait(method, payload)
@@ -264,6 +286,16 @@ class Connection:
         elif kind == _KIND_PUSH:
             spawn(self._dispatch(None, method, payload))
         else:
+            cb = self._cb_pending.pop(msgid, None)
+            if cb is not None:
+                try:
+                    if kind == _KIND_REP:
+                        cb(payload, None)
+                    else:
+                        cb(None, payload)
+                except Exception:
+                    logger.exception("inline reply callback failed")
+                return
             fut = self._pending.pop(msgid, None)
             if fut is not None and not fut.done():
                 if kind == _KIND_REP:
@@ -307,6 +339,13 @@ class Connection:
             if not fut.done():
                 fut.set_exception(ConnectionLost("connection closed"))
         self._pending.clear()
+        if self._cb_pending:
+            cbs, self._cb_pending = self._cb_pending, {}
+            for cb in cbs.values():
+                try:
+                    cb(None, _CONNECTION_LOST)
+                except Exception:
+                    logger.exception("inline reply callback failed at teardown")
         try:
             if self._protocol.transport is not None:
                 self._protocol.transport.close()

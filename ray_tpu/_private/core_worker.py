@@ -1003,12 +1003,8 @@ class CoreWorker:
     async def _flush_task_events(self) -> None:
         if not self._task_events:
             return
-        import collections as _collections
-
-        events, self._task_events = (
-            list(self._task_events),
-            _collections.deque(maxlen=config.task_events_max_buffer),
-        )
+        events = list(self._task_events)
+        self._task_events.clear()
         # Expand the hot-path tuples into wire dicts at flush time (the
         # constant per-process fields are added once here, not per event).
         out = []
@@ -2036,6 +2032,7 @@ class CoreWorker:
         bundle_index: int = -1,
         scheduling_strategy: Optional[dict] = None,
         runtime_env: Optional[dict] = None,
+        prepared_args: Optional[tuple] = None,
     ) -> str:
         if runtime_env:
             from ray_tpu.runtime_env.context import prepare
@@ -2044,18 +2041,29 @@ class CoreWorker:
         func_id = await self.export_function(pickled_cls)
         actor_id = ActorID.from_random().hex()
         task_id = TaskID.from_random().hex()
-        serialized, ref_pos, kw_refs, deps = self._prepare_args(args, kwargs)
         strategy = dict(scheduling_strategy or {})
         if lifetime == "detached":
             strategy["detached"] = True
         res = ResourceSet(resources if resources is not None else {"CPU": 1.0})
         args_blob, args_object = None, None
-        if serialized.total_size <= config.max_direct_call_object_size:
-            args_blob = serialized.to_bytes()
+        if prepared_args is not None:
+            # Pre-serialized args (client proxy path: the proxy cannot
+            # deserialize user values, so payloads pass through opaque).
+            payload, ref_pos, kw_refs, deps = prepared_args
+            if payload is None or len(payload) <= config.max_direct_call_object_size:
+                args_blob = payload
+            else:
+                args_object = ObjectID.from_random().hex()
+                await self.plasma.put_bytes(args_object, payload)
+                self.memory_store.put_plasma_marker(args_object, self.raylet_addr)
         else:
-            args_object = ObjectID.from_random().hex()
-            await self.plasma.put_serialized(args_object, serialized)
-            self.memory_store.put_plasma_marker(args_object, self.raylet_addr)
+            serialized, ref_pos, kw_refs, deps = self._prepare_args(args, kwargs)
+            if serialized.total_size <= config.max_direct_call_object_size:
+                args_blob = serialized.to_bytes()
+            else:
+                args_object = ObjectID.from_random().hex()
+                await self.plasma.put_serialized(args_object, serialized)
+                self.memory_store.put_plasma_marker(args_object, self.raylet_addr)
         spec = TaskSpec(
             task_id=task_id,
             job_id=self.job_id,
@@ -2141,17 +2149,27 @@ class CoreWorker:
         num_returns: int = 1,
         max_task_retries: int = 0,
         concurrency_group: Optional[str] = None,
+        prepared_args: Optional[tuple] = None,
     ) -> List[ObjectRef]:
         task_id = fast_unique_hex()
         return_ids = return_object_ids(task_id, num_returns)
-        serialized, ref_pos, kw_refs, deps = self._prepare_args(args, kwargs)
         args_blob, args_object = None, None
-        if serialized.total_size <= config.max_direct_call_object_size:
-            args_blob = serialized.to_bytes()
+        if prepared_args is not None:
+            payload, ref_pos, kw_refs, deps = prepared_args
+            if payload is None or len(payload) <= config.max_direct_call_object_size:
+                args_blob = payload
+            else:
+                args_object = ObjectID.from_random().hex()
+                await self.plasma.put_bytes(args_object, payload)
+                self.memory_store.put_plasma_marker(args_object, self.raylet_addr)
         else:
-            args_object = ObjectID.from_random().hex()
-            await self.plasma.put_serialized(args_object, serialized)
-            self.memory_store.put_plasma_marker(args_object, self.raylet_addr)
+            serialized, ref_pos, kw_refs, deps = self._prepare_args(args, kwargs)
+            if serialized.total_size <= config.max_direct_call_object_size:
+                args_blob = serialized.to_bytes()
+            else:
+                args_object = ObjectID.from_random().hex()
+                await self.plasma.put_serialized(args_object, serialized)
+                self.memory_store.put_plasma_marker(args_object, self.raylet_addr)
         wire = self._actor_wire(
             actor_id, method_name, args_blob, args_object,
             ref_pos, kw_refs, deps, num_returns, return_ids, task_id,

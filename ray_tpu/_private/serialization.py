@@ -140,7 +140,10 @@ def _is_simple(value: Any, depth: int = 3) -> bool:
     if name == "ndarray" and t.__module__ == "numpy":
         # object-dtype arrays can hold cloudpickle-only values.
         return not value.dtype.hasobject
-    if name in ("ObjectRef", "ActorHandle") and t.__module__.startswith("ray_tpu"):
+    if (
+        name in ("ObjectRef", "ActorHandle", "ClientObjectRef")
+        and t.__module__.startswith("ray_tpu")
+    ):
         return True
     if depth > 0:
         if t is tuple or t is list:

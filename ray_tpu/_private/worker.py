@@ -34,6 +34,8 @@ class Worker:
         self.mode: str = "disconnected"
         self.namespace: str = "default"
         self._owns_loop = False
+        # Client-mode context (remote driver via proxy); set by init("ray-tpu://...").
+        self.client = None
 
     @property
     def connected(self) -> bool:
@@ -106,6 +108,24 @@ def init(
     cluster.
     """
     import os as _os
+
+    if address and (address.startswith("ray-tpu://") or address.startswith("ray://")):
+        # Client mode (reference: Ray Client, ray.init("ray://...")): drive
+        # the cluster through its proxy endpoint; this process never joins
+        # the cluster network.
+        from ray_tpu.util import client as client_mod
+
+        with _init_lock:
+            w = global_worker
+            if w.connected or w.mode == "client":
+                if ignore_reinit_error:
+                    return {"address": address}
+                raise RayTpuError("ray_tpu.init() called twice")
+            ctx = client_mod.connect(address, namespace=namespace)
+            w.client = ctx
+            w.mode = "client"
+            atexit.register(shutdown)
+            return {"address": address, "job_id": ctx.job_id}
 
     if address == "auto":
         address = _os.environ.get("RAY_TPU_ADDRESS") or _read_cluster_address()
@@ -231,6 +251,16 @@ def attach_existing(core: CoreWorker, loop: asyncio.AbstractEventLoop) -> None:
 
 def shutdown() -> None:
     w = global_worker
+    if w.mode == "client":
+        try:
+            atexit.unregister(shutdown)
+        except Exception:
+            pass
+        ctx, w.client = w.client, None
+        w.mode = "disconnected"
+        if ctx is not None:
+            ctx.disconnect()
+        return
     if not w.connected:
         return
     try:
@@ -298,10 +328,14 @@ def _core() -> CoreWorker:
 
 
 def put(value: Any) -> ObjectRef:
+    if global_worker.mode == "client":
+        return global_worker.client.put(value)
     return global_worker.run_async(_core().put(value))
 
 
 def get(refs, timeout: Optional[float] = None):
+    if global_worker.mode == "client":
+        return global_worker.client.get(refs, timeout)
     single = isinstance(refs, ObjectRef)
     ref_list: List[ObjectRef] = [refs] if single else list(refs)
     for r in ref_list:
@@ -323,6 +357,10 @@ def wait(
     ref_list = list(refs)
     if num_returns > len(ref_list):
         raise ValueError("num_returns exceeds number of refs")
+    if global_worker.mode == "client":
+        return global_worker.client.wait(
+            ref_list, num_returns=num_returns, timeout=timeout
+        )
     return global_worker.run_async(
         _core().wait(ref_list, num_returns, timeout),
         timeout=None if timeout is None else timeout + 30,
@@ -332,6 +370,9 @@ def wait(
 def cancel(ref: ObjectRef, *, force: bool = False) -> None:
     """Best-effort cancellation of the task producing `ref` (reference:
     ray.cancel at worker.py:2932)."""
+    if global_worker.mode == "client":
+        global_worker.client.cancel(ref, force=force)
+        return
     if not isinstance(ref, ObjectRef):
         raise TypeError("ray_tpu.cancel expects an ObjectRef")
     global_worker.run_async(_core().cancel(ref, force))
@@ -342,12 +383,17 @@ def kill(actor, *, no_restart: bool = True) -> None:
 
     if not isinstance(actor, ActorHandle):
         raise TypeError("ray_tpu.kill expects an ActorHandle")
+    if global_worker.mode == "client":
+        global_worker.client.kill(actor._actor_id, no_restart=no_restart)
+        return
     global_worker.run_async(_core().kill_actor(actor._actor_id, no_restart))
 
 
 def get_actor(name: str, namespace: Optional[str] = None):
     from ray_tpu.actor import ActorHandle
 
+    if global_worker.mode == "client":
+        return global_worker.client.get_actor(name, namespace)
     reply = global_worker.run_async(
         _core().gcs.call(
             "GetNamedActor",
@@ -361,6 +407,8 @@ def get_actor(name: str, namespace: Optional[str] = None):
 
 
 def nodes() -> List[dict]:
+    if global_worker.mode == "client":
+        return global_worker.client.nodes()
     return global_worker.run_async(_core().gcs.call("GetAllNodes"))["nodes"]
 
 
@@ -385,4 +433,4 @@ def available_resources() -> Dict[str, float]:
 
 
 def is_initialized() -> bool:
-    return global_worker.connected
+    return global_worker.connected or global_worker.mode == "client"

@@ -46,12 +46,20 @@ class ActorMethod:
         )
 
     def remote(self, *args, **kwargs):
-        core = worker_mod._core()
         retries = (
             self._max_task_retries
             if self._max_task_retries is not None
             else self._handle._max_task_retries
         )
+        w = worker_mod.global_worker
+        if w.mode == "client":
+            refs = w.client.call_actor_method(
+                self._handle._actor_id, self._name, args, kwargs,
+                num_returns=self._num_returns, max_task_retries=retries,
+                concurrency_group=self._concurrency_group,
+            )
+            return refs[0] if self._num_returns == 1 else refs
+        core = worker_mod._core()
         refs = core.try_submit_actor_task_fast(
             self._handle._actor_id,
             self._name,
@@ -135,6 +143,9 @@ class ActorClass:
 
     def remote(self, *args, **kwargs) -> ActorHandle:
         opts = self._options
+        w = worker_mod.global_worker
+        if w.mode == "client":
+            return w.client.create_actor(self, args, kwargs)
         core = worker_mod._core()
         pg_id, bundle_index, strategy = _strategy_fields(opts)
         resources = _build_resources(opts)

@@ -89,6 +89,11 @@ class RemoteFunction:
 
     def _remote(self, args, kwargs):
         opts = self._options
+        w = worker_mod.global_worker
+        if w.mode == "client":
+            refs = w.client.submit_remote_function(self, args, kwargs)
+            num_returns = opts.get("num_returns", 1)
+            return refs[0] if num_returns in (1, "dynamic") else refs
         core = worker_mod._core()
         if self._strategy_cache is None:
             self._strategy_cache = _strategy_fields(opts)

@@ -80,16 +80,31 @@ def cmd_start(args) -> None:
             dash = Dashboard(node.gcs_addr, port=args.dashboard_port)
             host, port = await dash.start()
             dash_addr = f"http://{host}:{port}"
+        client_srv = None
+        if args.client_server_port >= 0:
+            from ray_tpu.util.client.server import ClientServer
+
+            client_srv = ClientServer(
+                node.gcs_addr, port=args.client_server_port
+            )
+            chost, cport = await client_srv.start()
         _write_state(address, dash_addr)
         print(f"ray_tpu head started at {address}")
         if dash_addr:
             print(f"dashboard: {dash_addr}")
         print(f"connect with ray_tpu.init(address='{address}') or address='auto'")
+        if client_srv is not None:
+            print(
+                "remote drivers: "
+                f"ray_tpu.init(address='ray-tpu://{chost}:{cport}')"
+            )
         stop_event = asyncio.Event()
         loop = asyncio.get_running_loop()
         for sig in (signal.SIGINT, signal.SIGTERM):
             loop.add_signal_handler(sig, stop_event.set)
         await stop_event.wait()
+        if client_srv is not None:
+            await client_srv.stop()
         if dash is not None:
             await dash.stop()
         await node.stop()
@@ -221,6 +236,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--object-store-memory", type=int, default=None)
     sp.add_argument("--no-dashboard", action="store_true")
     sp.add_argument("--dashboard-port", type=int, default=8265)
+    # Remote-driver proxy (reference: Ray Client, default port 10001).
+    # 0 = ephemeral port, negative = disabled.
+    sp.add_argument("--client-server-port", type=int, default=10001)
     sp.set_defaults(fn=cmd_start)
 
     sp = sub.add_parser("stop", help="stop the head started on this machine")

@@ -1,0 +1,177 @@
+"""Remote-driver (Ray Client analog) tests.
+
+Reference: python/ray/util/client + ray_client.proto:326. The proxy session
+owns all objects; the client holds opaque handles and moves only serialized
+payloads."""
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import worker as worker_mod
+from ray_tpu.util.client import ClientContext
+from ray_tpu.util.client.server import ClientServer
+
+
+@pytest.fixture
+def client_setup():
+    """Cluster + proxy in this process; a ClientContext on its own loop."""
+    info = ray_tpu.init(num_cpus=4, num_tpus=0)
+    w = worker_mod.global_worker
+    gcs_addr = w.node.gcs_addr
+
+    async def _start():
+        srv = ClientServer(gcs_addr, host="127.0.0.1")
+        await srv.start()
+        return srv
+
+    srv = w.run_async(_start(), timeout=30)
+    ctx = ClientContext("127.0.0.1", srv.addr[1])
+    yield ctx, srv
+    ctx.disconnect()
+
+    async def _stop():
+        await srv.stop()
+
+    w.run_async(_stop(), timeout=30)
+    ray_tpu.shutdown()
+
+
+def test_client_put_get_roundtrip(client_setup):
+    ctx, _ = client_setup
+    ref = ctx.put({"a": 1, "b": [1, 2, 3]})
+    assert ctx.get(ref) == {"a": 1, "b": [1, 2, 3]}
+    big = np.arange(1 << 20, dtype=np.float32)  # 4 MB -> plasma path
+    bref = ctx.put(big)
+    out = ctx.get(bref)
+    assert out.shape == big.shape and out[-1] == big[-1]
+
+
+def test_client_task_submission(client_setup):
+    ctx, _ = client_setup
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    refs = ctx.submit_remote_function(add, (2, 3), {})
+    assert ctx.get(refs[0]) == 5
+    # Ref args: a client ref passed as a task arg resolves cluster-side.
+    xref = ctx.put(10)
+    refs2 = ctx.submit_remote_function(add, (xref, 5), {})
+    assert ctx.get(refs2[0]) == 15
+
+
+def test_client_task_error_propagates(client_setup):
+    ctx, _ = client_setup
+
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise ValueError("kaboom")
+
+    refs = ctx.submit_remote_function(boom, (), {})
+    with pytest.raises(Exception, match="kaboom"):
+        ctx.get(refs[0], timeout=60)
+
+
+def test_client_wait(client_setup):
+    ctx, _ = client_setup
+
+    @ray_tpu.remote
+    def fast():
+        return 1
+
+    @ray_tpu.remote
+    def slow():
+        import time
+
+        time.sleep(30)
+        return 2
+
+    r1 = ctx.submit_remote_function(fast, (), {})[0]
+    r2 = ctx.submit_remote_function(slow, (), {})[0]
+    ready, not_ready = ctx.wait([r1, r2], num_returns=1, timeout=30)
+    assert [r.hex() for r in ready] == [r1.hex()]
+    assert [r.hex() for r in not_ready] == [r2.hex()]
+
+
+def test_client_actor_lifecycle(client_setup):
+    ctx, _ = client_setup
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start):
+            self.n = start
+
+        def inc(self, k=1):
+            self.n += k
+            return self.n
+
+    handle = ctx.create_actor(Counter, (100,), {})
+    r1 = ctx.call_actor_method(handle._actor_id, "inc", (), {})[0]
+    assert ctx.get(r1, timeout=60) == 101
+    r2 = ctx.call_actor_method(handle._actor_id, "inc", (5,), {})[0]
+    assert ctx.get(r2, timeout=60) == 106
+    ctx.kill(handle._actor_id)
+
+
+def test_client_mode_via_public_api():
+    """Full path: a subprocess driver uses ray_tpu.init("ray-tpu://...") and
+    the plain public API (remote/put/get/actors) end to end."""
+    info = ray_tpu.init(num_cpus=4, num_tpus=0)
+    w = worker_mod.global_worker
+    gcs_addr = w.node.gcs_addr
+
+    async def _start():
+        srv = ClientServer(gcs_addr, host="127.0.0.1")
+        await srv.start()
+        return srv
+
+    srv = w.run_async(_start(), timeout=30)
+    port = srv.addr[1]
+    script = f"""
+import ray_tpu
+ray_tpu.init(address="ray-tpu://127.0.0.1:{port}")
+
+@ray_tpu.remote
+def sq(x):
+    return x * x
+
+assert ray_tpu.get(sq.remote(7)) == 49
+ref = ray_tpu.put(21)
+assert ray_tpu.get(sq.remote(ref)) == 441
+
+@ray_tpu.remote
+class Acc:
+    def __init__(self):
+        self.total = 0
+    def add(self, v):
+        self.total += v
+        return self.total
+
+a = Acc.remote()
+assert ray_tpu.get(a.add.remote(3)) == 3
+assert ray_tpu.get(a.add.remote(4)) == 7
+ready, pending = ray_tpu.wait([sq.remote(2)], num_returns=1, timeout=30)
+assert len(ready) == 1 and not pending
+assert any(n["state"] == "ALIVE" for n in ray_tpu.nodes())
+ray_tpu.shutdown()
+print("CLIENT_OK")
+"""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True,
+            text=True,
+            timeout=180,
+        )
+        assert "CLIENT_OK" in out.stdout, f"stdout={out.stdout}\nstderr={out.stderr}"
+    finally:
+        async def _stop():
+            await srv.stop()
+
+        w.run_async(_stop(), timeout=30)
+        ray_tpu.shutdown()

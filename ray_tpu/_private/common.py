@@ -33,11 +33,17 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     # pinned CPUs (in-flight lease requests still force immediate return).
     "worker_lease_idle_keep_s": 0.5,
     "max_workers_per_node": 64,
-    # Health checks (reference cadence: ray_config_def.h:847-853).
+    # Health checks (reference cadence: ray_config_def.h:847-853). The GCS
+    # actively Pings every ALIVE node each period; `threshold` consecutive
+    # misses mark it DEAD (catches wedged-but-connected raylets). period 0
+    # disables active probing (connection loss still triggers death).
     "health_check_initial_delay_s": 5.0,
     "health_check_period_s": 3.0,
     "health_check_timeout_s": 10.0,
     "health_check_failure_threshold": 5,
+    # Pubsub: per-subscriber bounded queue length; a subscriber falling this
+    # far behind starts losing its OLDEST messages (publisher.h analog).
+    "pubsub_max_buffered_msgs": 1000,
     # Task defaults.
     "default_max_task_retries": 3,
     "actor_default_max_restarts": 0,

@@ -25,6 +25,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 import msgpack
 
 from ray_tpu._private import rpc
+from ray_tpu._private.pubsub import Publisher
 from ray_tpu._private.common import PlacementGroupSpec, ResourceSet, config
 
 logger = logging.getLogger(__name__)
@@ -47,6 +48,9 @@ class NodeInfo:
         self.conn: rpc.Connection = conn
         self.state = "ALIVE"
         self.last_seen = time.monotonic()
+        # Health-check manager state (reference: gcs_health_check_manager.cc).
+        self.health_misses = 0
+        self.health_probe_inflight = False
 
     def to_wire(self, include_conn=False) -> dict:
         return {
@@ -120,7 +124,8 @@ class GcsServer:
         self.actors: Dict[str, ActorInfo] = {}
         self.named_actors: Dict[Tuple[str, str], str] = {}  # (ns, name) -> actor_id
         self.kv: Dict[Tuple[str, str], bytes] = {}
-        self.subscribers: Dict[str, Set[rpc.Connection]] = {}
+        # Bounded per-subscriber pubsub (reference: pubsub/publisher.h).
+        self.publisher = Publisher()
         self.jobs: Dict[str, dict] = {}
         self.placement_groups: Dict[str, PlacementGroupInfo] = {}
         self.task_events: List[dict] = []  # ring buffer of task state events
@@ -213,6 +218,8 @@ class GcsServer:
         addr = await self.server.start()
         self.server.on_disconnect(self._on_disconnect)
         self._scheduler_task = rpc.spawn(self._actor_scheduler_loop())
+        if config.health_check_period_s > 0:
+            self._spawn(self._health_check_loop())
         # Resume work interrupted by a restart: unplaced PGs re-enter the
         # scheduling loop, and actors recorded ALIVE are reconciled against
         # the nodes that actually re-register.
@@ -223,6 +230,58 @@ class GcsServer:
             self._spawn(self._reconcile_restored_actors())
         logger.info("gcs listening on %s:%s", *addr)
         return addr
+
+    async def _health_check_loop(self) -> None:
+        """Active node health probing (reference: gcs_health_check_manager.cc
+        + knobs ray_config_def.h:847-853). Connection loss already triggers
+        death handling; this catches the wedged-but-connected raylet — a
+        stuck event loop that keeps its TCP session alive while serving
+        nothing. Each node is Pinged every period; `health_check_failure_
+        threshold` consecutive timeouts/errors mark it DEAD."""
+        await asyncio.sleep(config.health_check_initial_delay_s)
+        while True:
+            await asyncio.sleep(config.health_check_period_s)
+            for node in list(self.nodes.values()):
+                if node.state != "ALIVE" or node.health_probe_inflight:
+                    continue
+                node.health_probe_inflight = True
+                rpc.spawn(self._probe_node(node))
+
+    async def _probe_node(self, node: NodeInfo) -> None:
+        try:
+            await node.conn.call(
+                "Ping", {}, timeout=config.health_check_timeout_s
+            )
+            node.health_misses = 0
+            node.last_seen = time.monotonic()
+        except (rpc.RpcError, asyncio.TimeoutError, OSError):
+            node.health_misses += 1
+            logger.warning(
+                "health check miss %d/%d for node %s",
+                node.health_misses,
+                config.health_check_failure_threshold,
+                node.node_id[:8],
+            )
+            if (
+                node.health_misses >= config.health_check_failure_threshold
+                and node.state == "ALIVE"
+            ):
+                logger.error(
+                    "node %s failed %d consecutive health checks: marking DEAD",
+                    node.node_id[:8],
+                    node.health_misses,
+                )
+                await self._handle_node_death(node.node_id)
+                # Drop the (still-open) link: an unwedged raylet must learn
+                # it was declared dead — its client reconnects and
+                # re-registers as a fresh node rather than running zombie
+                # actors against a DEAD entry forever.
+                try:
+                    await node.conn.close()
+                except Exception:
+                    pass
+        finally:
+            node.health_probe_inflight = False
 
     async def _reconcile_restored_actors(self) -> None:
         """Post-restart sweep: an actor restored as ALIVE whose node never
@@ -297,7 +356,7 @@ class GcsServer:
         info = NodeInfo(p["node_id"], p["addr"], p["resources"], p.get("labels"), conn)
         self.nodes[p["node_id"]] = info
         conn.context["node_id"] = p["node_id"]
-        await self._publish_msg("nodes", {"event": "added", "node": info.to_wire()})
+        self._publish_msg("nodes", {"event": "added", "node": info.to_wire()})
         self._wake_scheduler.set()
         return {"ok": True, "session_name": self.session_name}
 
@@ -322,8 +381,7 @@ class GcsServer:
                 rpc.spawn(self._handle_node_death(node_id))
             except RuntimeError:
                 pass  # loop already stopped (interpreter shutdown)
-        for subs in self.subscribers.values():
-            subs.discard(conn)
+        self.publisher.remove_subscriber(conn)
 
     async def _handle_node_death(self, node_id: str) -> None:
         node = self.nodes.get(node_id)
@@ -331,7 +389,7 @@ class GcsServer:
             return
         node.state = "DEAD"
         logger.warning("node %s died", node_id[:8])
-        await self._publish_msg("nodes", {"event": "removed", "node": node.to_wire()})
+        self._publish_msg("nodes", {"event": "removed", "node": node.to_wire()})
         # Fail/restart actors that lived there.
         for actor in list(self.actors.values()):
             if actor.node_id == node_id and actor.state in (ALIVE, PENDING_CREATION, RESTARTING):
@@ -465,7 +523,7 @@ class GcsServer:
             if not fut.done():
                 fut.set_result(result)
         actor.pending.clear()
-        await self._publish_msg(f"actor:{actor.actor_id}", actor.to_wire())
+        self._publish_msg(f"actor:{actor.actor_id}", actor.to_wire())
         return {"ok": True}
 
     async def _on_actor_worker_death(self, actor: ActorInfo, cause: str) -> None:
@@ -483,7 +541,7 @@ class GcsServer:
                 cause,
             )
             self._persist_actor(actor)
-            await self._publish_msg(f"actor:{actor.actor_id}", actor.to_wire())
+            self._publish_msg(f"actor:{actor.actor_id}", actor.to_wire())
             self._pending_actor_queue.append(actor.actor_id)
             self._wake_scheduler.set()
         else:
@@ -503,7 +561,7 @@ class GcsServer:
             del self.named_actors[(actor.namespace, actor.name)]
             self._persist_named()
         self._persist_actor(actor)
-        await self._publish_msg(f"actor:{actor.actor_id}", actor.to_wire())
+        self._publish_msg(f"actor:{actor.actor_id}", actor.to_wire())
 
     async def _report_worker_died(self, conn, p):
         """Raylet reports a worker process exit (reference:
@@ -602,19 +660,18 @@ class GcsServer:
     # -- pubsub -------------------------------------------------------------
 
     async def _subscribe(self, conn, p):
-        self.subscribers.setdefault(p["channel"], set()).add(conn)
+        self.publisher.subscribe(p["channel"], conn)
         return {"ok": True}
 
     async def _publish(self, conn, p):
-        await self._publish_msg(p["channel"], p["msg"])
+        self._publish_msg(p["channel"], p["msg"])
         return {"ok": True}
 
-    async def _publish_msg(self, channel: str, msg: Any) -> None:
-        for sub in list(self.subscribers.get(channel, ())):
-            try:
-                await sub.push("Pub", {"channel": channel, "msg": msg})
-            except rpc.RpcError:
-                self.subscribers[channel].discard(sub)
+    def _publish_msg(self, channel: str, msg: Any) -> None:
+        """Non-blocking fan-out: per-subscriber bounded queues + dedicated
+        drain tasks (a slow subscriber drops ITS backlog, never stalls the
+        control plane)."""
+        self.publisher.publish(channel, msg)
 
     # -- jobs ---------------------------------------------------------------
 
@@ -688,7 +745,7 @@ class GcsServer:
                         if not fut.done():
                             fut.set_result({"pg_id": spec.pg_id, "state": "CREATED"})
                     pg.pending.clear()
-                    await self._publish_msg(f"pg:{spec.pg_id}", {"state": "CREATED"})
+                    self._publish_msg(f"pg:{spec.pg_id}", {"state": "CREATED"})
                     self._wake_scheduler.set()
                     return
             if time.monotonic() > deadline:

@@ -1,0 +1,105 @@
+"""GCS-side pubsub publisher with per-subscriber bounded queues.
+
+Analog of src/ray/pubsub/publisher.h: each subscriber connection gets its own
+bounded message queue drained by its own sender task with transport-level
+backpressure (``conn.drain()``). A slow or wedged subscriber therefore never
+blocks the publisher's event loop or other subscribers; once its queue fills,
+its OLDEST messages drop (counted) — matching the reference's
+``publisher_entity_buffer`` overflow policy of shedding the backlog rather
+than the publisher.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import deque
+from typing import Any, Dict
+
+from ray_tpu._private import rpc
+from ray_tpu._private.common import config
+
+logger = logging.getLogger(__name__)
+
+
+class _SubscriberState:
+    __slots__ = ("conn", "queue", "draining", "dropped")
+
+    def __init__(self, conn: rpc.Connection, maxlen: int):
+        self.conn = conn
+        self.queue: deque = deque(maxlen=maxlen)
+        self.draining = False
+        self.dropped = 0
+
+
+class Publisher:
+    def __init__(self) -> None:
+        # channel -> {conn id -> state}
+        self.channels: Dict[str, Dict[int, _SubscriberState]] = {}
+        self.total_dropped = 0
+
+    def subscribe(self, channel: str, conn: rpc.Connection) -> None:
+        self.channels.setdefault(channel, {})[id(conn)] = _SubscriberState(
+            conn, max(1, config.pubsub_max_buffered_msgs)
+        )
+
+    def remove_subscriber(self, conn: rpc.Connection) -> None:
+        cid = id(conn)
+        for subs in self.channels.values():
+            subs.pop(cid, None)
+
+    def publish(self, channel: str, msg: Any) -> None:
+        """Enqueue to every subscriber; returns immediately (never blocks the
+        caller on a slow subscriber's socket)."""
+        subs = self.channels.get(channel)
+        if not subs:
+            return
+        frame = {"channel": channel, "msg": msg}
+        for state in list(subs.values()):
+            if state.conn.closed:
+                subs.pop(id(state.conn), None)
+                continue
+            if len(state.queue) == state.queue.maxlen:
+                state.dropped += 1
+                self.total_dropped += 1
+                if state.dropped in (1, 100, 10000):
+                    logger.warning(
+                        "pubsub subscriber %s slow on %r: %d messages dropped",
+                        state.conn.peername,
+                        channel,
+                        state.dropped,
+                    )
+            state.queue.append(frame)
+            if not state.draining:
+                state.draining = True
+                rpc.spawn(self._drain(state))
+
+    async def _drain(self, state: _SubscriberState) -> None:
+        try:
+            while state.queue:
+                frame = state.queue.popleft()
+                try:
+                    state.conn.push_nowait("Pub", frame)
+                    # Backpressure on THIS subscriber's transport only.
+                    await state.conn.drain()
+                except (rpc.ConnectionLost, rpc.RpcError):
+                    self.remove_subscriber(state.conn)
+                    return
+        finally:
+            state.draining = False
+            # Re-check: a publish may have raced the finally.
+            if state.queue and not state.conn.closed:
+                state.draining = True
+                rpc.spawn(self._drain(state))
+
+    def stats(self) -> dict:
+        return {
+            "channels": {
+                ch: {
+                    "subscribers": len(subs),
+                    "queued": sum(len(s.queue) for s in subs.values()),
+                    "dropped": sum(s.dropped for s in subs.values()),
+                }
+                for ch, subs in self.channels.items()
+            },
+            "total_dropped": self.total_dropped,
+        }

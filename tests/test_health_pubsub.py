@@ -1,0 +1,106 @@
+"""GCS active health checks + pubsub backpressure.
+
+Reference analogs: gcs_health_check_manager.cc (periodic probe with miss
+counting) and pubsub/publisher.h (per-subscriber bounded queues)."""
+
+import asyncio
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import rpc
+from ray_tpu._private import worker as worker_mod
+from ray_tpu.cluster_utils import Cluster
+
+
+def test_wedged_node_detected_by_health_checks(monkeypatch):
+    """A raylet whose event loop stops serving RPCs (but keeps its TCP
+    session) must be detected by periodic Pings with miss counting —
+    connection-centric death detection alone would never notice it."""
+    monkeypatch.setenv("RAY_TPU_HEALTH_CHECK_INITIAL_DELAY_S", "0.1")
+    monkeypatch.setenv("RAY_TPU_HEALTH_CHECK_PERIOD_S", "0.2")
+    monkeypatch.setenv("RAY_TPU_HEALTH_CHECK_TIMEOUT_S", "0.5")
+    monkeypatch.setenv("RAY_TPU_HEALTH_CHECK_FAILURE_THRESHOLD", "3")
+    cluster = Cluster(head_node_args={"num_cpus": 1, "num_tpus": 0})
+    wedged = cluster.add_node(num_cpus=1)
+    cluster.connect()
+    try:
+        assert len([n for n in ray_tpu.nodes() if n["state"] == "ALIVE"]) == 2
+
+        async def hang(conn, p):
+            await asyncio.sleep(3600)
+
+        wedged.server._handlers["Ping"] = hang
+
+        deadline = time.monotonic() + 30
+        dead = False
+        while time.monotonic() < deadline:
+            states = {n["node_id"]: n["state"] for n in ray_tpu.nodes()}
+            if states.get(wedged.node_id) == "DEAD":
+                dead = True
+                break
+            time.sleep(0.25)
+        assert dead, "wedged raylet was never marked DEAD by health checks"
+    finally:
+        cluster.shutdown()
+
+
+def test_slow_subscriber_backpressure(monkeypatch):
+    """A subscriber that stops reading its socket must not stall the GCS:
+    its queue bounds, oldest messages drop, and other RPCs stay fast."""
+    monkeypatch.setenv("RAY_TPU_PUBSUB_MAX_BUFFERED_MSGS", "50")
+    cluster = Cluster(head_node_args={"num_cpus": 1, "num_tpus": 0})
+    cluster.connect()
+    w = worker_mod.global_worker
+    gcs = cluster.gcs_server
+    try:
+        received = []
+
+        async def connect_sub():
+            async def on_pub(conn, p):
+                received.append(p["msg"])
+
+            conn = await rpc.connect(*cluster.gcs_addr, handlers={"Pub": on_pub})
+            await conn.call("Subscribe", {"channel": "bench"})
+            return conn
+
+        sub_conn = w.run_async(connect_sub(), timeout=30)
+
+        async def stall_and_publish():
+            # Stop reading: the server's sends back up on this transport.
+            sub_conn._protocol.transport.pause_reading()
+            payload = "x" * 4096
+            for i in range(2000):
+                gcs.publisher.publish("bench", {"i": i, "pad": payload})
+            await asyncio.sleep(0.5)  # let drain tasks hit the full socket
+
+        w.run_async(stall_and_publish(), timeout=60)
+        # Other RPCs still served promptly.
+        t0 = time.monotonic()
+        assert any(n["state"] == "ALIVE" for n in ray_tpu.nodes())
+        assert time.monotonic() - t0 < 2.0
+        stats = gcs.publisher.stats()
+        assert stats["total_dropped"] > 0, stats
+        bench = stats["channels"]["bench"]
+        assert bench["queued"] <= 50, stats
+
+        async def resume():
+            sub_conn._protocol.transport.resume_reading()
+
+        w.run_async(resume(), timeout=10)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and not received:
+            time.sleep(0.1)
+        # The tail of the stream (newest retained messages) arrives.
+        assert received and received[-1]["i"] >= 1950, (
+            len(received),
+            received[-1]["i"] if received else None,
+        )
+
+        async def close_sub():
+            await sub_conn.close()
+
+        w.run_async(close_sub(), timeout=10)
+    finally:
+        cluster.shutdown()

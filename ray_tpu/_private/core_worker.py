@@ -573,6 +573,9 @@ class LeasePool:
                         "bundle_index": pool.bundle_index,
                         "strategy": pool.strategy,
                         "spilled_from": hops > 0,
+                        # Owning job: the raylet's memory-monitor kill policy
+                        # groups leased workers by owner for fair shedding.
+                        "job_id": self.core.job_id,
                     },
                     timeout=None,
                 )

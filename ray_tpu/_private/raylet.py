@@ -819,6 +819,7 @@ class Raylet:
         handle.lease_id = req.lease_id
         handle.demand = req.demand  # type: ignore[attr-defined]
         handle.leased_since = time.monotonic()  # type: ignore[attr-defined]
+        handle.job_id = req.payload.get("job_id") or handle.job_id
         self.leases[req.lease_id] = handle
         if not req.fut.done():
             req.fut.set_result(
@@ -845,6 +846,11 @@ class Raylet:
         if handle is None:
             return None
         handle.lease_id = None
+        if handle.actor_id is None:
+            # Pooled worker returning to idle: drop the lease's job
+            # attribution so log lines and the memory-kill policy never
+            # blame a previous tenant.
+            handle.job_id = None
         self._free_lease_resources(handle)
         if dirty or handle.actor_id:
             self._kill_worker_proc(handle)
@@ -1212,25 +1218,42 @@ class Raylet:
                 continue
             logger.warning(
                 "memory usage %.1f%% over threshold %.1f%%: killing worker "
-                "%s (newest lease; owner will retry per max_retries)",
+                "%s (%s)",
                 frac * 100,
                 config.memory_usage_threshold * 100,
                 victim.worker_id[:8],
+                "newest task worker of largest owner group; owner retries "
+                "per max_retries"
+                if victim.actor_id is None
+                else f"actor {victim.actor_id[:8]}; owner sees restart or "
+                "ActorDiedError",
             )
             self._kill_worker_proc(victim)
 
     def _pick_memory_victim(self) -> Optional["WorkerHandle"]:
-        """Newest-leased task worker first (reference retriable-FIFO policy:
-        kill the most recently started retriable work so older work can
-        finish); never kill actor workers before task workers."""
-        task_workers = [
-            h for h in self.leases.values() if h.actor_id is None
-        ]
+        """Group-by-owner fair killing (reference:
+        worker_killing_policy_group_by_owner.h / worker_killing_policy.h:34).
+
+        Task workers first (their owners retry per max_retries): group
+        leased workers by owning job and pick the NEWEST worker from the
+        LARGEST group — the job consuming the most workers sheds load first,
+        so one memory-hungry job cannot starve every tenant on the node.
+        Actor workers are eligible as a last resort, newest first (their
+        owners see a restart or ActorDiedError) — a runaway actor must not
+        OOM the node while the monitor watches."""
+        newest = lambda h: getattr(h, "leased_since", h.idle_since)  # noqa: E731
+        task_workers = [h for h in self.leases.values() if h.actor_id is None]
         if task_workers:
-            return max(
-                task_workers,
-                key=lambda h: getattr(h, "leased_since", h.idle_since),
+            groups: Dict[Optional[str], List[WorkerHandle]] = {}
+            for h in task_workers:
+                groups.setdefault(h.job_id, []).append(h)
+            largest = max(
+                groups.values(), key=lambda g: (len(g), max(newest(h) for h in g))
             )
+            return max(largest, key=newest)
+        actors = [h for h in self.workers.values() if h.actor_id is not None]
+        if actors:
+            return max(actors, key=newest)
         return None
 
     async def _obj_create(self, conn, p):

@@ -170,6 +170,31 @@ def test_pluggable_remote_spill_backend(shutdown_only, monkeypatch):
         assert ray_tpu.get(ref, timeout=60)[0] == i
 
 
+def test_memory_monitor_kills_runaway_actor(shutdown_only, monkeypatch):
+    """With no task workers leased, an actor worker is eligible (reference:
+    group-by-owner policy kills actors as last resort — a runaway actor must
+    not OOM the node while the monitor only watches tasks)."""
+    monkeypatch.setenv("RAY_TPU_MEMORY_USAGE_THRESHOLD", "0.0")
+    monkeypatch.setenv("RAY_TPU_MEMORY_MONITOR_INTERVAL_S", "0.2")
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+
+    @ray_tpu.remote(max_restarts=0)
+    class Hog:
+        def spin(self):
+            import time
+
+            time.sleep(60)
+            return 1
+
+    hog = Hog.remote()
+    # Specifically the actor-death surface — a plain GetTimeoutError would
+    # mean the monitor never selected the actor worker.
+    from ray_tpu._private.common import ActorDiedError, ActorUnavailableError
+
+    with pytest.raises((ActorDiedError, ActorUnavailableError)):
+        ray_tpu.get(hog.spin.remote(), timeout=120)
+
+
 def test_memory_monitor_kills_newest_task(shutdown_only, monkeypatch):
     """With the threshold forced to 0, the monitor kills the newest leased
     task worker; a non-retriable task surfaces WorkerCrashedError."""

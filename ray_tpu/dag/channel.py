@@ -71,8 +71,10 @@ class Channel:
                 payload = bytes(view[DATA_OFFSET : DATA_OFFSET + length])
                 seq2, _ = HEADER.unpack_from(view, 0)
                 if seq2 == seq:  # seqlock validate: no concurrent rewrite
+                    # Decode strictly AFTER validation, from the private
+                    # copy: torn slot bytes must be retried, never parsed.
                     self._last_read_seq = seq
-                    return cloudpickle.loads(payload)
+                    return self._decode_payload(payload)
             polls += 1
             if deadline is not None and polls % 64 == 0 and time.monotonic() > deadline:
                 raise TimeoutError(f"channel {self.name} read timed out")
@@ -86,6 +88,11 @@ class Channel:
             else:
                 time.sleep(0.001)
 
+    def _decode_payload(self, payload: bytes) -> Any:
+        """Subclass hook: turn a validated snapshot of the slot into a value
+        (TensorChannel parses a raw array header instead of unpickling)."""
+        return cloudpickle.loads(payload)
+
     def close(self, unlink: bool = False) -> None:
         try:
             self._seg.close()
@@ -98,6 +105,13 @@ class Channel:
                 pass
 
 
-def open_channel(spec: Tuple[str, int]) -> Channel:
-    name, size = spec
-    return Channel(name, size)
+def make_channel(spec, *, create: bool = False) -> Channel:
+    """Open a channel from its wire spec (name, size[, kind]): kind
+    "tensor" -> array-native TensorChannel, else the pickle Channel."""
+    name, size = spec[0], spec[1]
+    kind = spec[2] if len(spec) > 2 else "chan"
+    if kind == "tensor":
+        from ray_tpu.dag.tensor_channel import TensorChannel
+
+        return TensorChannel(name, size, create=create)
+    return Channel(name, size, create=create)

@@ -13,7 +13,7 @@ import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private.common import RayTpuError
-from ray_tpu.dag.channel import Channel
+from ray_tpu.dag.channel import Channel, make_channel
 from ray_tpu.dag.exec_loop import STOP, unwrap
 from ray_tpu.dag.nodes import ClassMethodNode, DAGNode, InputNode, MultiOutputNode
 
@@ -96,11 +96,11 @@ class CompiledDAG:
 
         self._all_chan_names: List[str] = []
 
-        def new_chan_spec() -> Tuple[str, int]:
+        def new_chan_spec(kind: str = "chan") -> Tuple[str, int, str]:
             self._counter += 1
             name = f"rtdag_{self._uid}_{self._counter}"
             self._all_chan_names.append(name)
-            return (name, self._max_buf)
+            return (name, self._max_buf, kind)
 
         for node in order:
             arg_specs = []
@@ -116,8 +116,10 @@ class CompiledDAG:
                 "kwarg_specs": kwarg_specs,
             }
         for lf in leaves:
-            spec = new_chan_spec()
-            self._output_channels.append(Channel(spec[0], spec[1], create=True))
+            spec = new_chan_spec(
+                "tensor" if lf._tensor_transport else "chan"
+            )
+            self._output_channels.append(make_channel(spec, create=True))
             node_out_specs[id(lf)].append(spec)
 
         # Start the resident loops (one long-running actor task per node).
@@ -132,14 +134,16 @@ class CompiledDAG:
 
     def _arg_spec(self, a, node_out_specs, new_chan_spec):
         if isinstance(a, InputNode):
-            spec = new_chan_spec()
-            ch = Channel(spec[0], spec[1], create=True)
+            spec = new_chan_spec("tensor" if a._tensor_transport else "chan")
+            ch = make_channel(spec, create=True)
             self._input_channels.append(ch)
             return ("chan", spec)
         if isinstance(a, ClassMethodNode):
-            spec = new_chan_spec()
+            # Edge transport follows the PRODUCER's annotation
+            # (reference: with_tensor_transport on the upstream node).
+            spec = new_chan_spec("tensor" if a._tensor_transport else "chan")
             # Create driver-side so the consumer can open it immediately.
-            Channel(spec[0], spec[1], create=True).close()
+            make_channel(spec, create=True).close()
             node_out_specs[id(a)].append(spec)
             return ("chan", spec)
         if isinstance(a, DAGNode):

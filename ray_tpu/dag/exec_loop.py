@@ -10,7 +10,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List
 
-from ray_tpu.dag.channel import Channel
+from ray_tpu.dag.channel import Channel, make_channel
 
 STOP = "__RT_DAG_STOP__"
 
@@ -29,7 +29,7 @@ def dag_exec_loop(actor_instance: Any, spec: Dict[str, Any]) -> int:
         if kind == "const":
             arg_fns.append(("const", payload))
         else:
-            ch = Channel(payload[0], payload[1])
+            ch = make_channel(payload)
             in_channels.append(ch)
             arg_fns.append(("chan", ch))
     kwarg_fns = {}
@@ -37,10 +37,10 @@ def dag_exec_loop(actor_instance: Any, spec: Dict[str, Any]) -> int:
         if kind == "const":
             kwarg_fns[key] = ("const", payload)
         else:
-            ch = Channel(payload[0], payload[1])
+            ch = make_channel(payload)
             in_channels.append(ch)
             kwarg_fns[key] = ("chan", ch)
-    outs = [Channel(name, size) for name, size in spec["out_channels"]]
+    outs = [make_channel(sp) for sp in spec["out_channels"]]
 
     iterations = 0
 

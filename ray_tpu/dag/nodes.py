@@ -12,10 +12,22 @@ from typing import Any, Dict, List, Optional, Tuple
 
 
 class DAGNode:
+    # Transport for this node's OUTPUT edges: None (pickle shm channel) or
+    # "tensor" (array-native shm channel; reference analog:
+    # TorchTensorType/with_tensor_transport on aDAG edges).
+    _tensor_transport: Optional[str] = None
+
     def experimental_compile(self, *, max_buf_size: int = 10 * 1024 * 1024):
         from ray_tpu.dag.compiled import CompiledDAG
 
         return CompiledDAG(self, max_buf_size=max_buf_size)
+
+    def with_tensor_transport(self, transport: str = "tensor") -> "DAGNode":
+        """Mark this node's outputs as array payloads: they move through
+        raw-buffer channels (dtype/shape header + memcpy — no pickle).
+        Reference: DAGNode.with_tensor_transport(...)."""
+        self._tensor_transport = transport
+        return self
 
     def _upstream(self) -> List["DAGNode"]:
         return []

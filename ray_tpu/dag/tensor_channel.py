@@ -1,0 +1,154 @@
+"""Array-native channels for compiled DAGs + the ICI device-to-device path.
+
+Analog of python/ray/experimental/channel/torch_tensor_nccl_channel.py: the
+reference moves GPU tensors between compiled-DAG actors over NCCL, skipping
+the object store and host memory. The TPU-native translation has two layers:
+
+1. ``TensorChannel`` — a shm channel specialized for jax/numpy arrays: raw
+   dtype/shape header + buffer memcpy instead of cloudpickle (which both
+   copies and byte-stuffs). Cross-actor, same-host.
+
+2. ``make_ici_transfer`` — the true device-to-device path: a jitted
+   shard_map ppermute hop over a live Mesh. On TPU hardware the transfer
+   rides ICI links without touching host memory; the same program compiles
+   and runs on a virtual CPU mesh for testing. Both DAG actors participate
+   in the one SPMD program (multi-controller jax), exactly as both ranks
+   participate in the reference's NCCL send/recv.
+"""
+
+from __future__ import annotations
+
+import struct
+from functools import partial
+from typing import Any
+
+import numpy as np
+
+from ray_tpu.dag.channel import DATA_OFFSET, HEADER, Channel, ChannelFullError
+
+_MAGIC_ARRAY = 0xA1
+_MAGIC_ARRAY_OK = 0xA2  # array wrapped in the exec-loop ("ok", value) tuple
+_MAGIC_PICKLE = 0xB2
+# [magic: u8][ndim: u8][dtype-len: u8][reserved: u8][nbytes: u64]
+_AHDR = struct.Struct("<BBBxQ")
+
+
+class TensorChannel(Channel):
+    """One-slot shm channel whose array payloads skip pickle entirely.
+
+    Synchronization is inherited from Channel (seqlock read loop + decode
+    hook); only the payload encoding differs.
+    """
+
+    # -- writer side ---------------------------------------------------------
+
+    def write(self, value: Any) -> None:
+        magic = _MAGIC_ARRAY
+        if (
+            type(value) is tuple
+            and len(value) == 2
+            and isinstance(value[0], str)
+            and value[0] == "ok"
+        ):
+            # Exec-loop wire tuple: keep the array fast path for the value.
+            magic = _MAGIC_ARRAY_OK
+            value = value[1]
+        arr = self._as_array(value)
+        if arr is None or arr.dtype.hasobject:
+            payload = _pickle_payload(
+                ("ok", value) if magic == _MAGIC_ARRAY_OK else value
+            )
+            self._write_raw(_MAGIC_PICKLE, payload, b"", ())
+            return
+        shape = arr.shape  # BEFORE ascontiguousarray (it promotes 0-d to 1-d)
+        arr = np.ascontiguousarray(arr)
+        self._write_raw(
+            magic, arr.view(np.uint8).reshape(-1), arr.dtype.str.encode(), shape
+        )
+
+    @staticmethod
+    def _as_array(value: Any):
+        if isinstance(value, np.ndarray):
+            return value
+        t = type(value)
+        if t.__module__.startswith("jax") or t.__name__ == "ArrayImpl":
+            import jax
+
+            return np.asarray(jax.device_get(value))
+        return None
+
+    def _write_raw(self, magic: int, body, dtype_b: bytes, shape) -> None:
+        shape_b = b"".join(struct.pack("<q", d) for d in shape)
+        nbytes = body.nbytes if isinstance(body, np.ndarray) else len(body)
+        total = _AHDR.size + len(dtype_b) + len(shape_b) + nbytes
+        if total > self.max_buf_size:
+            raise ChannelFullError(
+                f"message of {total} bytes exceeds channel capacity "
+                f"{self.max_buf_size}; recompile with a larger max_buf_size"
+            )
+        view = self._seg.view
+        seq, _ = HEADER.unpack_from(view, 0)
+        HEADER.pack_into(view, 0, seq + 1, total)  # odd = writing
+        off = DATA_OFFSET
+        _AHDR.pack_into(view, off, magic, len(shape), len(dtype_b), nbytes)
+        off += _AHDR.size
+        view[off : off + len(dtype_b)] = dtype_b
+        off += len(dtype_b)
+        view[off : off + len(shape_b)] = shape_b
+        off += len(shape_b)
+        if isinstance(body, np.ndarray):
+            np.frombuffer(view, dtype=np.uint8, count=nbytes, offset=off)[:] = body
+        else:
+            view[off : off + nbytes] = body
+        HEADER.pack_into(view, 0, seq + 2, total)  # even = sealed
+
+    # -- reader side ---------------------------------------------------------
+
+    def _decode_payload(self, payload: bytes) -> Any:
+        """Parse a validated snapshot (Channel.read's seqlock already copied
+        it out of the slot, so no extra array copy is needed here)."""
+        magic, ndim, dlen, nbytes = _AHDR.unpack_from(payload, 0)
+        off = _AHDR.size
+        dtype_b = payload[off : off + dlen]
+        off += dlen
+        shape = tuple(
+            struct.unpack_from("<q", payload, off + 8 * i)[0] for i in range(ndim)
+        )
+        off += 8 * ndim
+        if magic == _MAGIC_PICKLE:
+            import cloudpickle
+
+            return cloudpickle.loads(payload[off : off + nbytes])
+        data = np.frombuffer(payload, dtype=np.uint8, count=nbytes, offset=off)
+        out = data.view(np.dtype(dtype_b.decode())).reshape(shape)
+        return ("ok", out) if magic == _MAGIC_ARRAY_OK else out
+
+
+def _pickle_payload(value) -> bytes:
+    import pickle
+
+    import cloudpickle
+
+    return cloudpickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def make_ici_transfer(mesh, axis: str, src: int, dst: int):
+    """Compile a device-to-device shard transfer over a live mesh.
+
+    Returns a jitted fn moving the ``src`` device's shard of ``x`` onto the
+    ``dst`` device's shard slot via one ppermute hop — on TPU this is one
+    ICI link traversal with no host round trip (reference analog: NCCL
+    send/recv between aDAG actors). Other shards pass through unchanged.
+    """
+    import jax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    @partial(shard_map, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
+    def _hop(x):
+        moved = jax.lax.ppermute(x, axis, perm=[(src, dst)])
+        idx = jax.lax.axis_index(axis)
+        # dst's slot takes the moved shard; everyone else keeps their own.
+        return jax.numpy.where(idx == dst, moved, x)
+
+    return jax.jit(_hop)

@@ -27,10 +27,11 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     # Worker lease / pool.
     "worker_lease_timeout_s": 60.0,
     "idle_worker_keep_s": 60.0,
-    # How long an owner's surplus idle leases (beyond LeasePool.MAX_IDLE)
-    # park before returning to the raylet. Bursty submitters reuse the full
-    # worker set across bursts; other clients wait at most this long for the
-    # pinned CPUs (in-flight lease requests still force immediate return).
+    # How long an owner's idle leases park before returning to the raylet.
+    # Bursty submitters reuse the full worker set across bursts; other
+    # clients (and autoscaler idle scale-down) wait at most this long for
+    # the pinned resources (in-flight lease requests force immediate
+    # return).
     "worker_lease_idle_keep_s": 0.5,
     "max_workers_per_node": 64,
     # Health checks (reference cadence: ray_config_def.h:847-853). The GCS

@@ -368,8 +368,6 @@ class LeasePool:
     into the next queued item (direct_task_transport.h:75 analog).
     """
 
-    # Idle leases kept per shape before returning workers to the raylet.
-    MAX_IDLE = 2
     # Max in-flight RequestWorkerLease RPCs per shape (reference knob:
     # max_pending_lease_requests_per_scheduling_category).
     MAX_INFLIGHT = 16
@@ -523,8 +521,7 @@ class LeasePool:
                 rpc.spawn(self._return_worker(lease, dirty=False))
                 return
             lease.parked_at = time.monotonic()
-            if len(pool.idle) > self.MAX_IDLE:
-                self._schedule_idle_sweep(key, pool)
+            self._schedule_idle_sweep(key, pool)
 
     def _schedule_idle_sweep(self, key, pool: _ShapePool) -> None:
         if getattr(pool, "sweep_scheduled", False):
@@ -536,23 +533,26 @@ class LeasePool:
         )
 
     def _sweep_idle_leases(self, key, pool: _ShapePool) -> None:
+        """Return EVERY lease parked past the keep-alive window — a parked
+        lease pins its CPUs/TPUs cluster-wide (blocks other jobs and the
+        autoscaler's idle scale-down), so the cache is strictly
+        time-bounded."""
         pool.sweep_scheduled = False
         if pool.pending:
             return  # busy again; leases are in use
         keep = config.worker_lease_idle_keep_s
         now = time.monotonic()
-        surplus = len(pool.idle) - self.MAX_IDLE
         expired = [
             l
             for l in pool.idle
             if l.outstanding == 0 and now - l.parked_at >= keep
         ]
-        for lease in expired[:surplus] if surplus > 0 else []:
+        for lease in expired:
             pool.idle.remove(lease)
             lease.in_idle = False
             pool.leases.discard(lease)
             rpc.spawn(self._return_worker(lease, dirty=False))
-        if len(pool.idle) > self.MAX_IDLE:
+        if pool.idle:
             self._schedule_idle_sweep(key, pool)
 
     async def _request_lease(self, key, pool: _ShapePool) -> None:

@@ -34,29 +34,14 @@ logger = logging.getLogger(__name__)
 
 
 def detect_tpu_resources() -> Dict[str, float]:
-    """Probe local TPU chips (reference: tpu.py:104-120 probes /dev/accel* and
-    /dev/vfio). Under JAX we can also ask the runtime, but daemons must not
-    grab the chips, so probe device files and env only."""
-    resources: Dict[str, float] = {}
-    count = 0
-    for i in range(16):
-        if os.path.exists(f"/dev/accel{i}") or os.path.exists(f"/dev/accel_{i}"):
-            count += 1
-    if count == 0 and os.path.isdir("/dev/vfio"):
-        entries = [e for e in os.listdir("/dev/vfio") if e.isdigit()]
-        count = len(entries)
-    env_chips = os.environ.get("TPU_VISIBLE_CHIPS") or os.environ.get("RAY_TPU_CHIPS")
-    if env_chips:
-        count = len([c for c in env_chips.split(",") if c.strip()])
-    if count:
-        resources["TPU"] = float(count)
-        pod_type = os.environ.get("TPU_POD_TYPE") or os.environ.get(
-            "TPU_ACCELERATOR_TYPE"
-        )
-        worker_id = os.environ.get("TPU_WORKER_ID", "0")
-        if pod_type and worker_id == "0":
-            resources[f"TPU-{pod_type}-head"] = 1.0
-    return resources
+    """Probe local accelerators through the pluggable manager registry
+    (reference: accelerators/__init__.py + TPUAcceleratorManager tpu.py:75 —
+    env overrides, /dev/accel*, /dev/vfio, then GCE/GKE instance metadata
+    for the pod slice). Daemons must not grab the chips, so nothing here
+    touches the JAX runtime."""
+    from ray_tpu._private.accelerators import detect_accelerator_resources
+
+    return detect_accelerator_resources()
 
 
 class WorkerHandle:
@@ -184,6 +169,9 @@ class Raylet:
         self.workers: Dict[str, WorkerHandle] = {}
         self.idle_workers: List[WorkerHandle] = []
         self.pending_leases: List[LeaseRequest] = []
+        # Cluster-wide-infeasible leases parked off the FIFO grant queue
+        # until the cluster scales (autoscaler demand input).
+        self.infeasible_leases: List[LeaseRequest] = []
         self.leases: Dict[str, WorkerHandle] = {}
 
         # Placement group bundles committed on this node:
@@ -253,6 +241,7 @@ class Raylet:
         await _register(self.gcs)
         self._tasks.append(rpc.spawn(self._resource_report_loop()))
         self._tasks.append(rpc.spawn(self._condemned_sweep_loop()))
+        self._tasks.append(rpc.spawn(self._infeasible_retry_loop()))
         if config.memory_monitor_interval_s > 0:
             self._tasks.append(rpc.spawn(self._memory_monitor_loop()))
         logger.info(
@@ -669,7 +658,23 @@ class Raylet:
         if not demand.is_subset_of(self.total):
             # Infeasible here — suggest spillback target from GCS view.
             target = await self._find_spillback_node(demand)
-            return {"spillback": target}
+            if target is not None:
+                return {"spillback": target}
+            # Cluster-wide infeasible: park on a SIDE queue and wait rather
+            # than fail — the demand shows up in pending_demand, the
+            # autoscaler can add a node that fits, and the retry loop spills
+            # the request there (reference: infeasible tasks warn and wait;
+            # resource_demand_scheduler feeds on their shapes). Not on
+            # pending_leases: the grant loop is FIFO and an unsatisfiable
+            # head would block every feasible lease behind it.
+            logger.warning(
+                "infeasible resource demand %s on all current nodes; "
+                "queueing until the cluster scales",
+                demand.to_dict(),
+            )
+            req = LeaseRequest(p["lease_id"], demand, p)
+            self.infeasible_leases.append(req)
+            return await req.fut
         if not affinity and not p.get("spilled_from"):
             # Scheduling policy (reference: hybrid_scheduling_policy.cc /
             # scheduling_policy.h SPREAD): decide local-vs-remote before
@@ -683,6 +688,29 @@ class Raylet:
         return await req.fut
 
     # -- scheduling policy (reference: raylet/scheduling/policy/) ------------
+
+    async def _infeasible_retry_loop(self) -> None:
+        """Re-evaluate parked cluster-wide-infeasible leases: once a node
+        that fits registers (autoscaler scale-up, manual join), spill the
+        request to it. Local feasibility (this node grew) re-enters the
+        normal grant queue."""
+        while True:
+            await asyncio.sleep(1.0)
+            for req in list(self.infeasible_leases):
+                if req.fut.done():
+                    self.infeasible_leases.remove(req)
+                    continue
+                if req.demand.is_subset_of(self.total):
+                    self.infeasible_leases.remove(req)
+                    self.pending_leases.append(req)
+                    self._try_grant_leases()
+                    continue
+                target = await self._find_spillback_node(req.demand)
+                if target is None:
+                    continue
+                self.infeasible_leases.remove(req)
+                if not req.fut.done():
+                    req.fut.set_result({"spillback": target})
 
     async def _cluster_view(self) -> list:
         """GCS node view cached briefly (the syncer keeps it ~1s fresh).
@@ -785,7 +813,7 @@ class Raylet:
         """Cancel a queued (ungranted) lease request: the surplus-request
         drain that keeps recycled-lease pools from pinning the raylet queue
         (reference: NodeManagerService CancelWorkerLease)."""
-        for req in self.pending_leases:
+        for req in list(self.pending_leases) + list(self.infeasible_leases):
             if req.lease_id == p["lease_id"] and not req.fut.done():
                 req.fut.set_result({"cancelled": True})
                 break
@@ -1618,10 +1646,17 @@ class Raylet:
             "store_used": self.store_used,
             "store_capacity": self.store_capacity,
             "num_objects": self.store.num_objects,
-            "pending_leases": len(self.pending_leases),
+            "pending_leases": len(self.pending_leases) + len(self.infeasible_leases),
             "spilled_objects": len(self.spilled),
             "spilled_bytes": self.spilled_bytes,
             "push_stats": dict(self.push_manager.stats),
+            # Unmet demand shapes for the autoscaler's bin-packing
+            # (reference: resource_demand_scheduler reads task demands).
+            # Infeasible shapes first — they are the scale-up signal.
+            "pending_demand": [
+                req.demand.to_units()
+                for req in (self.infeasible_leases + self.pending_leases)[:20]
+            ],
         }
         # Detail payloads for the state API (reference: raylet
         # GetTasksInfo/GetObjectsInfo, node_manager.proto:424-426).

@@ -28,7 +28,9 @@ class AutoscalerConfig:
 
 @dataclass
 class _NodeTracker:
-    provider_node_id: str
+    """One scaling unit: a single host or a whole TPU pod slice gang."""
+
+    provider_node_ids: List[str]
     node_type: str
     launched_at: float = field(default_factory=time.monotonic)
     idle_since: Optional[float] = None
@@ -55,6 +57,13 @@ class Autoscaler:
         pending = sum(s.get("pending_leases", 0) for s in stats)
         return pending, stats
 
+    @staticmethod
+    def _pending_demands(stats: List[dict]) -> List[Dict[str, int]]:
+        demands: List[Dict[str, int]] = []
+        for s in stats:
+            demands.extend(s.get("pending_demand") or [])
+        return demands
+
     # -- scaling decisions ---------------------------------------------------
 
     def update(self) -> Dict[str, int]:
@@ -69,37 +78,43 @@ class Autoscaler:
             counts[t.node_type] = counts.get(t.node_type, 0) + 1
         for node_type, spec in self.provider.node_types.items():
             while counts.get(node_type, 0) < spec.get("min_workers", 0):
-                self._launch(node_type)
+                launched += self._launch(node_type)
                 counts[node_type] = counts.get(node_type, 0) + 1
-                launched += 1
 
         # Upscale on sustained unmet demand.
         if pending > 0:
             if self._demand_since is None:
                 self._demand_since = now
             elif now - self._demand_since >= self.config.upscale_delay_s:
-                for _ in range(
+                demands = self._pending_demands(stats)
+                for i in range(
                     min(self.config.max_launches_per_round, pending)
                 ):
-                    node_type = self._pick_type()
+                    node_type = self._pick_type(
+                        demands[i] if i < len(demands) else None
+                    )
                     if node_type is None:
-                        break
-                    self._launch(node_type)
-                    launched += 1
+                        # This shape fits no type (or no headroom) — a later
+                        # demand may still be satisfiable.
+                        continue
+                    launched += self._launch(node_type)
                 self._demand_since = None
         else:
             self._demand_since = None
 
-        # Downscale idle tracked nodes.
+        # Downscale idle tracked nodes (slice gangs go together).
         busy_ids = {
             s["node_id"]
             for s in stats
             if s.get("num_workers", 0) - s.get("num_idle", 0) > 0
             or s.get("pending_leases", 0) > 0
         }
-        for pid, t in list(self._tracked.items()):
-            raylet_id = getattr(self.provider, "raylet_node_id", lambda _p: None)(pid)
-            is_busy = raylet_id in busy_ids if raylet_id else False
+        for key, t in list(self._tracked.items()):
+            raylet_of = getattr(self.provider, "raylet_node_id", lambda _p: None)
+            is_busy = any(
+                (raylet_of(pid) in busy_ids) if raylet_of(pid) else False
+                for pid in t.provider_node_ids
+            )
             if is_busy:
                 t.idle_since = None
                 continue
@@ -111,30 +126,87 @@ class Autoscaler:
                 now - t.idle_since >= self.config.idle_timeout_s
                 and self._count(t.node_type) > spec.get("min_workers", 0)
             ):
-                self.provider.terminate_node(pid)
-                del self._tracked[pid]
-                terminated += 1
+                # A TPU pod slice is one failure/billing domain: its hosts
+                # terminate together (reference: TPU pod scale-down removes
+                # whole replicas, never individual slice hosts).
+                for pid in t.provider_node_ids:
+                    self.provider.terminate_node(pid)
+                    terminated += 1
+                del self._tracked[key]
         return {"launched": launched, "terminated": terminated}
 
     def _count(self, node_type: str) -> int:
         return sum(1 for t in self._tracked.values() if t.node_type == node_type)
 
-    def _pick_type(self) -> Optional[str]:
-        """Smallest type with headroom (reference bin-packs demand shapes;
-        single-resource-type clusters reduce to this)."""
-        best = None
-        for node_type, spec in sorted(
+    def _pick_type(self, demand: Optional[Dict[str, int]] = None) -> Optional[str]:
+        """Cheapest node type with headroom that covers the demand shape
+        (reference: resource_demand_scheduler bin-packing). With no shape,
+        smallest type with headroom; with a shape that provably fits no
+        type, None — launching hardware that can never satisfy the demand
+        would just churn."""
+        from ray_tpu._private.common import RESOURCE_UNIT
+
+        candidates = sorted(
             self.provider.node_types.items(),
             key=lambda kv: sum(kv[1].get("resources", {}).values()),
-        ):
-            if self._count(node_type) < spec.get("max_workers", 0):
-                best = node_type
-                break
-        return best
+        )
+        fallback = None
+        for node_type, spec in candidates:
+            if self._count(node_type) >= spec.get("max_workers", 0):
+                continue
+            if fallback is None:
+                fallback = node_type
+            if demand and self._covers(spec, demand, RESOURCE_UNIT):
+                return node_type
+        return None if demand else fallback
 
-    def _launch(self, node_type: str) -> None:
-        pid = self.provider.create_node(node_type)
-        self._tracked[pid] = _NodeTracker(pid, node_type)
+    @staticmethod
+    def _covers(spec: dict, demand: Dict[str, int], unit: int) -> bool:
+        have = spec.get("resources", {})
+        slice_n = int(spec.get("workers_per_slice", 1))
+        for r, units in demand.items():
+            if r.startswith("node:"):
+                continue
+            if r.startswith("TPU-") and r.endswith("-head"):
+                # Gang resource TPU-{pod}-head: only a slice of that exact
+                # pod type will ever advertise it.
+                pod = r[len("TPU-") : -len("-head")]
+                if spec.get("tpu_pod_slice") == pod or f"TPU-{pod}-head" in have:
+                    continue
+                return False
+            scale = slice_n if r == "TPU" else 1
+            if have.get(r, 0.0) * scale * unit < units:
+                return False
+        return True
+
+    def _launch(self, node_type: str) -> int:
+        """Launch one *unit* of the type: a single host, or every host of a
+        TPU pod slice as a gang (reference: TPU pod worker groups scale in
+        whole slices). Returns hosts launched. Partially-created gangs are
+        still tracked so the downscaler reclaims them."""
+        spec = self.provider.node_types.get(node_type, {})
+        n = int(spec.get("workers_per_slice", 1))
+        if n == 1 and spec.get("tpu_pod_slice"):
+            from ray_tpu._private.accelerators import TPUAcceleratorManager
+
+            n = TPUAcceleratorManager.get_num_workers_in_pod(
+                spec["tpu_pod_slice"]
+            )
+        pids: List[str] = []
+        try:
+            for _ in range(max(1, n)):
+                pids.append(self.provider.create_node(node_type))
+        except Exception:
+            logger.exception(
+                "slice launch of %s failed after %d/%d hosts; tracking the "
+                "partial gang for reclamation",
+                node_type,
+                len(pids),
+                n,
+            )
+        if pids:
+            self._tracked[pids[0]] = _NodeTracker(pids, node_type)
+        return len(pids)
 
     # -- loop ----------------------------------------------------------------
 

@@ -1,0 +1,181 @@
+"""Accelerator manager registry + TPU pod detection + slice-aware scaling.
+
+Reference analogs: python/ray/_private/accelerators/tpu.py (env + device +
+GCE metadata probe order), autoscaler gcp/tpu pod handling."""
+
+import http.server
+import threading
+
+import pytest
+
+from ray_tpu._private.accelerators import (
+    TPUAcceleratorManager,
+    detect_accelerator_resources,
+    get_accelerator_manager_for_resource,
+)
+
+
+@pytest.fixture
+def fake_metadata_server():
+    """A local GCE metadata server double (reference: tpu.py queries
+    metadata.google.internal for accelerator-type / agent-worker-number)."""
+    values = {
+        "/computeMetadata/v1/instance/attributes/accelerator-type": "v5litepod-16",
+        "/computeMetadata/v1/instance/attributes/agent-worker-number": "0",
+    }
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.headers.get("Metadata-Flavor") != "Google":
+                self.send_response(403)
+                self.end_headers()
+                return
+            val = values.get(self.path)
+            if val is None:
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = val.encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"127.0.0.1:{srv.server_port}", values
+    srv.shutdown()
+
+
+def test_tpu_detection_via_gce_metadata(fake_metadata_server, monkeypatch):
+    host, _ = fake_metadata_server
+    monkeypatch.setenv("GCE_METADATA_HOST", host)
+    monkeypatch.setenv("TPU_VISIBLE_CHIPS", "0,1,2,3")
+    monkeypatch.delenv("TPU_POD_TYPE", raising=False)
+    monkeypatch.delenv("TPU_ACCELERATOR_TYPE", raising=False)
+    monkeypatch.delenv("TPU_WORKER_ID", raising=False)
+    res = detect_accelerator_resources()
+    assert res["TPU"] == 4.0
+    assert res["TPU-v5litepod-16-head"] == 1.0
+    assert res["accelerator_type:TPU-V5LITEPOD"] == 1.0
+
+
+def test_tpu_nonzero_worker_gets_no_head_resource(fake_metadata_server, monkeypatch):
+    host, values = fake_metadata_server
+    values["/computeMetadata/v1/instance/attributes/agent-worker-number"] = "2"
+    monkeypatch.setenv("GCE_METADATA_HOST", host)
+    monkeypatch.setenv("TPU_VISIBLE_CHIPS", "0,1,2,3")
+    monkeypatch.delenv("TPU_POD_TYPE", raising=False)
+    monkeypatch.delenv("TPU_WORKER_ID", raising=False)
+    res = detect_accelerator_resources()
+    assert res["TPU"] == 4.0
+    assert "TPU-v5litepod-16-head" not in res
+
+
+def test_manager_registry_lookup():
+    assert get_accelerator_manager_for_resource("TPU") is TPUAcceleratorManager
+    assert get_accelerator_manager_for_resource("GPU") is None
+
+
+def test_pod_worker_count_heuristics():
+    # v4 reports cores (2 per chip); 4 chips per host.
+    assert TPUAcceleratorManager.get_num_workers_in_pod("v4-16") == 2
+    assert TPUAcceleratorManager.get_num_workers_in_pod("v4-8") == 1
+    # v5e reports chips directly.
+    assert TPUAcceleratorManager.get_num_workers_in_pod("v5litepod-16") == 4
+    assert TPUAcceleratorManager.get_num_workers_in_pod("bogus") == 1
+
+
+def test_gce_provider_command_shapes():
+    from ray_tpu.autoscaler.node_provider import GCETPUNodeProvider
+
+    commands = []
+    provider = GCETPUNodeProvider(
+        project="proj-x",
+        zone="us-central2-b",
+        accelerator_type="v5litepod-8",
+        runner=lambda cmd: commands.append(cmd) or "",
+    )
+    pid = provider.create_node("worker")
+    assert provider.non_terminated_nodes() == [pid]
+    create = commands[0]
+    assert create[:5] == ["gcloud", "compute", "tpus", "tpu-vm", "create"]
+    assert "--project=proj-x" in create and "--zone=us-central2-b" in create
+    assert "--accelerator-type=v5litepod-8" in create
+    provider.terminate_node(pid)
+    assert commands[1][:5] == ["gcloud", "compute", "tpus", "tpu-vm", "delete"]
+    assert provider.non_terminated_nodes() == []
+
+
+def test_infeasible_task_does_not_block_feasible(shutdown_only):
+    """A cluster-wide-infeasible demand parks on the side queue; feasible
+    tasks behind it still schedule (no FIFO head-of-line blocking)."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+
+    @ray_tpu.remote(num_cpus=0, resources={"TPU": 8})
+    def needs_tpus():
+        return 1
+
+    @ray_tpu.remote
+    def plain():
+        return 42
+
+    stuck = needs_tpus.remote()  # queues forever (no TPU node ever joins)
+    assert ray_tpu.get(plain.remote(), timeout=60) == 42
+    ready, pending = ray_tpu.wait([stuck], num_returns=1, timeout=1)
+    assert not ready and pending
+
+
+def test_autoscaler_launches_whole_pod_slice(shutdown_only):
+    """A TPU pod-slice node type scales in whole slices: one demand unit
+    launches every host of the slice as a gang, and idle scale-down removes
+    the gang together (reference: TPU pod worker groups)."""
+    import time
+
+    import ray_tpu
+    from ray_tpu.autoscaler import Autoscaler, AutoscalerConfig, FakeNodeProvider
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    ray_tpu.init(address=cluster.address)
+    provider = FakeNodeProvider(
+        cluster,
+        node_types={
+            "tpu-slice": {
+                "resources": {"CPU": 1.0, "TPU": 4.0},
+                "min_workers": 0,
+                "max_workers": 2,
+                "workers_per_slice": 2,
+            }
+        },
+    )
+    scaler = Autoscaler(
+        provider, AutoscalerConfig(upscale_delay_s=0.1, idle_timeout_s=2.0)
+    )
+
+    @ray_tpu.remote(num_cpus=0, resources={"TPU": 4})
+    def tpu_task():
+        time.sleep(3)
+        return 1
+
+    ref = tpu_task.remote()
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and not provider.non_terminated_nodes():
+        scaler.update()
+        time.sleep(0.2)
+    # The whole 2-host slice came up at once.
+    assert len(provider.non_terminated_nodes()) == 2
+    assert ray_tpu.get(ref, timeout=60) == 1
+
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and provider.non_terminated_nodes():
+        scaler.update()
+        time.sleep(0.5)
+    assert not provider.non_terminated_nodes(), "slice was not reclaimed"
+    cluster.shutdown()

@@ -207,6 +207,7 @@ class Executor:
             and wire.get("num_returns") != -1
             and not renv.get("working_dir")
             and not renv.get("py_modules")
+            and not renv.get("pip")
         ):
             self._exec().submit(conn, msgid, "PushTask", wire)
             return
@@ -367,14 +368,19 @@ class Executor:
         track = self.running_tasks[task_id] = {"thread_id": None, "async_task": None}
         try:
             renv = wire.get("runtime_env") or {}
-            if renv.get("working_dir") or renv.get("py_modules"):
-                # Shared worker process: packages go on sys.path (idempotent)
-                # but the cwd is left alone; env vars are call-scoped below.
+            if renv.get("working_dir") or renv.get("py_modules") or renv.get("pip"):
+                # Shared worker process: packages and pip-env site-packages
+                # go on sys.path (idempotent) but the cwd is left alone; env
+                # vars are call-scoped below.
                 from ray_tpu.runtime_env.context import apply_runtime_env
 
                 await apply_runtime_env(
                     self.core,
-                    {k: renv[k] for k in ("working_dir", "py_modules") if k in renv},
+                    {
+                        k: renv[k]
+                        for k in ("working_dir", "py_modules", "pip")
+                        if k in renv
+                    },
                     chdir=False,
                 )
             fn = await self.get_function(wire["func_id"])

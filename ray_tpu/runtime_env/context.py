@@ -77,13 +77,40 @@ async def prepare(core, runtime_env: Optional[Dict[str, Any]]) -> Optional[Dict]
                 m if str(m).startswith("pkg_") else await _upload_dir(core, m)
             )
         env["py_modules"] = uploaded
-    if env.get("pip") or env.get("conda"):
-        logger.warning(
-            "runtime_env pip/conda requested but package installation is "
-            "disabled in this deployment; dependencies must be baked into "
-            "the image"
+    if env.get("pip"):
+        env["pip"] = _normalize_pip(env["pip"])
+    if env.get("conda"):
+        # Fail loudly at submission time rather than silently ignoring the
+        # request (conda env provisioning is not supported; use pip or bake
+        # dependencies into the image).
+        raise ValueError(
+            "runtime_env conda environments are not supported; use "
+            "runtime_env={'pip': [...]} or bake dependencies into the image"
         )
     return env
+
+
+def _normalize_pip(pip: Any) -> Dict[str, Any]:
+    """Driver-side pip-field normalization (reference: runtime_env/pip.py
+    accepts a list, a requirements path, or a dict)."""
+    if isinstance(pip, str):  # requirements.txt path, read driver-side
+        with open(os.path.expanduser(pip)) as f:
+            packages = [
+                ln.strip()
+                for ln in f
+                if ln.strip() and not ln.strip().startswith("#")
+            ]
+        return {"packages": packages}
+    if isinstance(pip, (list, tuple)):
+        return {"packages": [str(p) for p in pip]}
+    if isinstance(pip, dict):
+        out = {"packages": [str(p) for p in pip.get("packages") or []]}
+        if pip.get("pip_check") is not None:
+            out["pip_check"] = bool(pip["pip_check"])
+        if pip.get("pip_install_options"):
+            out["pip_install_options"] = [str(o) for o in pip["pip_install_options"]]
+        return out
+    raise ValueError(f"unsupported runtime_env pip spec: {pip!r}")
 
 
 async def _fetch_package(core, key: str) -> str:
@@ -127,6 +154,134 @@ async def apply_runtime_env(
         path = await _fetch_package(core, key)
         if path not in sys.path:
             sys.path.insert(0, path)
+    pip = runtime_env.get("pip")
+    if pip:
+        site = await ensure_pip_env(pip)
+        if site and site not in sys.path:
+            sys.path.insert(0, site)
+    if runtime_env.get("conda"):
+        raise RuntimeError(
+            "runtime_env conda environments are not supported on this worker"
+        )
+
+
+def _pip_env_key(spec: Dict[str, Any]) -> str:
+    import json
+
+    blob = json.dumps(spec, sort_keys=True).encode()
+    return hashlib.sha1(blob).hexdigest()[:20]
+
+
+def _site_packages(venv_dir: str) -> str:
+    return os.path.join(
+        venv_dir,
+        "lib",
+        f"python{sys.version_info.major}.{sys.version_info.minor}",
+        "site-packages",
+    )
+
+
+# A lock dir whose mtime is older than this is considered abandoned (its
+# installer died without cleanup); installers heartbeat the mtime during
+# long pip runs so live installs are never broken.
+_PIP_LOCK_STALE_S = 120.0
+
+
+async def ensure_pip_env(pip: Any) -> Optional[str]:
+    """Worker-side: build (or reuse) a venv for the pip spec; returns its
+    site-packages path, or None for an empty spec (reference:
+    runtime_env/pip.py PipProcessor — per-hash cached virtualenv with
+    system-site-packages so the image's baked-in deps stay importable).
+
+    Concurrency protocol: an atomic lock dir elects one installer; waiters
+    poll until the ready marker appears OR the lock vanishes (installer
+    failed — they then re-elect and surface the real install error
+    themselves). A lock whose heartbeat mtime goes stale (installer killed
+    mid-install) is broken and re-acquired. Failures raise — never silently
+    run without the requested packages."""
+    import asyncio
+    import time as _time
+
+    spec = _normalize_pip(pip)
+    if not spec.get("packages"):
+        return None
+    key = _pip_env_key(spec)
+    dest = os.path.join(EXTRACT_ROOT, "pip", key)
+    marker = os.path.join(dest, ".ready")
+    lock = dest + ".lock"
+    os.makedirs(os.path.dirname(dest), exist_ok=True)
+    while True:
+        if os.path.exists(marker):
+            return _site_packages(dest)
+        try:
+            os.mkdir(lock)  # atomic: we are the installer
+            break
+        except FileExistsError:
+            try:
+                if _time.time() - os.path.getmtime(lock) > _PIP_LOCK_STALE_S:
+                    # Installer died without cleanup; break the lock.
+                    os.rmdir(lock)
+                    continue
+            except OSError:
+                continue  # lock vanished between exists and stat: retry
+            await asyncio.sleep(0.25)
+    if os.path.exists(marker):  # raced a finishing installer for the lock
+        try:
+            os.rmdir(lock)
+        except OSError:
+            pass
+        return _site_packages(dest)
+
+    async def _run(cmd, what):
+        proc = await asyncio.create_subprocess_exec(
+            *cmd,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.STDOUT,
+        )
+        out, _ = await proc.communicate()
+        if proc.returncode != 0:
+            raise RuntimeError(f"{what} failed: {out.decode()[-2000:]}")
+
+    async def _heartbeat():
+        while True:
+            await asyncio.sleep(15)
+            try:
+                os.utime(lock)
+            except OSError:
+                return
+
+    hb = asyncio.ensure_future(_heartbeat())
+    try:
+        await _run(
+            [sys.executable, "-m", "venv", "--system-site-packages", dest],
+            "venv creation",
+        )
+        cmd = [
+            os.path.join(dest, "bin", "python"), "-m", "pip", "install",
+            "--disable-pip-version-check",
+        ]
+        cmd += spec.get("pip_install_options") or []
+        cmd += spec["packages"]
+        await _run(cmd, f"pip install of {spec['packages']}")
+        if spec.get("pip_check"):
+            await _run(
+                [os.path.join(dest, "bin", "python"), "-m", "pip", "check"],
+                "pip check",
+            )
+        with open(marker, "w") as f:
+            f.write("ok")
+        return _site_packages(dest)
+    except BaseException:
+        import shutil
+
+        shutil.rmtree(dest, ignore_errors=True)
+        raise
+    finally:
+        hb.cancel()
+        try:
+            os.rmdir(lock)
+        except OSError:
+            pass
 
 
 @contextlib.contextmanager

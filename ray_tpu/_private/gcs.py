@@ -51,6 +51,9 @@ class NodeInfo:
         # Health-check manager state (reference: gcs_health_check_manager.cc).
         self.health_misses = 0
         self.health_probe_inflight = False
+        # Last resource-report version accepted from this raylet (syncer
+        # staleness guard, reference: ray_syncer.h versioned messages).
+        self.report_version = -1
 
     def to_wire(self, include_conn=False) -> dict:
         return {
@@ -129,6 +132,10 @@ class GcsServer:
         self.jobs: Dict[str, dict] = {}
         self.placement_groups: Dict[str, PlacementGroupInfo] = {}
         self.task_events: List[dict] = []  # ring buffer of task state events
+        # Monotonic cluster-view version; every membership/resource change
+        # bumps it and broadcasts a delta (reference: ray_syncer.h:88
+        # bidirectional versioned sync streams).
+        self.view_version = 0
         self._pending_actor_queue: List[str] = []
         self._wake_scheduler = asyncio.Event()
         self._scheduler_task: Optional[asyncio.Task] = None
@@ -352,25 +359,51 @@ class GcsServer:
 
     # -- nodes --------------------------------------------------------------
 
+    def _bump_view(self, node: "NodeInfo") -> None:
+        """One cluster-view mutation: bump the version and broadcast the
+        delta so every raylet's local view converges without polling."""
+        self.view_version += 1
+        self._publish_msg(
+            "syncer:nodes", {"v": self.view_version, "node": node.to_wire()}
+        )
+
     async def _register_node(self, conn, p):
         info = NodeInfo(p["node_id"], p["addr"], p["resources"], p.get("labels"), conn)
         self.nodes[p["node_id"]] = info
         conn.context["node_id"] = p["node_id"]
         self._publish_msg("nodes", {"event": "added", "node": info.to_wire()})
+        self._bump_view(info)
         self._wake_scheduler.set()
         return {"ok": True, "session_name": self.session_name}
 
     async def _get_all_nodes(self, conn, p):
-        return {"nodes": [n.to_wire() for n in self.nodes.values()]}
+        return {
+            "nodes": [n.to_wire() for n in self.nodes.values()],
+            "v": self.view_version,
+        }
 
     async def _update_resources(self, conn, p):
         node = self.nodes.get(p["node_id"])
         if node is not None:
+            rv = p.get("version")
+            if rv is not None and rv <= node.report_version:
+                # Out-of-order/stale report (reference: syncer drops
+                # messages older than the last accepted version).
+                return {"ok": True, "stale": True}
+            if rv is not None:
+                node.report_version = rv
+            changed = node.available != p["available"] or (
+                p.get("total") and node.total != p["total"]
+            )
             node.available = p["available"]
             node.last_seen = time.monotonic()
             if p.get("total"):
                 node.total = p["total"]
-            self._wake_scheduler.set()
+            if changed:
+                # No-change heartbeats (idle 1s reports) must not fan out
+                # O(N^2) deltas across the cluster.
+                self._bump_view(node)
+                self._wake_scheduler.set()
         return {"ok": True}
 
     def _on_disconnect(self, conn: rpc.Connection) -> None:
@@ -390,6 +423,7 @@ class GcsServer:
         node.state = "DEAD"
         logger.warning("node %s died", node_id[:8])
         self._publish_msg("nodes", {"event": "removed", "node": node.to_wire()})
+        self._bump_view(node)
         # Fail/restart actors that lived there.
         for actor in list(self.actors.values()):
             if actor.node_id == node_id and actor.state in (ALIVE, PENDING_CREATION, RESTARTING):

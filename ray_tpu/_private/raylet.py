@@ -184,6 +184,14 @@ class Raylet:
         self._view_time = 0.0
         self._spread_rr = 0
         self._view_fetch = None
+        # Versioned delta-synced cluster view (reference: ray_syncer.h:88):
+        # the GCS broadcasts one delta per membership/resource change; a
+        # version gap (dropped under backpressure) forces a full resync.
+        self._view_map: Dict[str, dict] = {}
+        self._view_version = -1
+        # Monotonic version on our own resource reports so the GCS can drop
+        # stale/out-of-order updates.
+        self._report_version = 0
         self._tasks: List[asyncio.Task] = []
         self._register_handlers()
 
@@ -235,10 +243,14 @@ class Raylet:
                     "labels": self.labels,
                 },
             )
+            # Deltas missed during the outage are unrecoverable: force a
+            # snapshot resync before trusting the view again.
+            self._view_version = -1
             self._mark_dirty()
 
         self.gcs.on_reconnect(_register)
         await _register(self.gcs)
+        await self.gcs.subscribe("syncer:nodes", self._on_view_delta)
         self._tasks.append(rpc.spawn(self._resource_report_loop()))
         self._tasks.append(rpc.spawn(self._condemned_sweep_loop()))
         self._tasks.append(rpc.spawn(self._infeasible_retry_loop()))
@@ -384,12 +396,14 @@ class Raylet:
                 pass
             self._resources_dirty.clear()
             try:
+                self._report_version += 1
                 await self.gcs.call(
                     "UpdateResources",
                     {
                         "node_id": self.node_id,
                         "available": self.available.to_units(),
                         "total": self.total.to_units(),
+                        "version": self._report_version,
                     },
                 )
             except rpc.RpcError:
@@ -712,10 +726,35 @@ class Raylet:
                 if not req.fut.done():
                     req.fut.set_result({"spillback": target})
 
+    def _on_view_delta(self, msg: dict) -> None:
+        """One versioned cluster-view delta from the GCS (syncer push). In
+        sequence -> apply; any gap (drop under pubsub backpressure, missed
+        while reconnecting) -> full resync."""
+        v = msg.get("v", -1)
+        if self._view_version >= 0 and v == self._view_version + 1:
+            node = msg["node"]
+            if node.get("state") == "ALIVE":
+                self._view_map[node["node_id"]] = node
+            else:
+                self._view_map.pop(node["node_id"], None)
+            self._view_version = v
+            self._view = list(self._view_map.values())
+            self._view_time = time.monotonic()
+            return
+        if v <= self._view_version:
+            return  # stale replay
+        # Gap: resync from a snapshot.
+        if self._view_fetch is None:
+            self._view_fetch = rpc.spawn(self._fetch_view())
+
     async def _cluster_view(self) -> list:
-        """GCS node view cached briefly (the syncer keeps it ~1s fresh).
-        Concurrent refreshers share one fetch — a burst of policy decisions
-        must wait for the view, not act on a stale/empty one."""
+        """Delta-synced GCS node view (reference ray_syncer design): the
+        subscription keeps it current without polling; until the first
+        snapshot lands (or after a sync gap) fall back to a shared fetch —
+        a burst of policy decisions must wait for the view, not act on a
+        stale/empty one."""
+        if self._view_version >= 0:
+            return self._view
         now = time.monotonic()
         if now - self._view_time > 1.0:
             if self._view_fetch is None:
@@ -729,8 +768,12 @@ class Raylet:
     async def _fetch_view(self) -> None:
         try:
             reply = await self.gcs.call("GetAllNodes")
-            self._view = [n for n in reply["nodes"] if n["state"] == "ALIVE"]
+            alive = [n for n in reply["nodes"] if n["state"] == "ALIVE"]
+            self._view = alive
             self._view_time = time.monotonic()
+            if "v" in reply:
+                self._view_map = {n["node_id"]: n for n in alive}
+                self._view_version = reply["v"]
         except rpc.RpcError:
             pass
         finally:

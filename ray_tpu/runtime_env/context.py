@@ -157,8 +157,8 @@ async def apply_runtime_env(
     pip = runtime_env.get("pip")
     if pip:
         site = await ensure_pip_env(pip)
-        if site and site not in sys.path:
-            sys.path.insert(0, site)
+        if site:
+            _activate_pip_site(site)
     if runtime_env.get("conda"):
         raise RuntimeError(
             "runtime_env conda environments are not supported on this worker"
@@ -181,10 +181,34 @@ def _site_packages(venv_dir: str) -> str:
     )
 
 
-# A lock dir whose mtime is older than this is considered abandoned (its
-# installer died without cleanup); installers heartbeat the mtime during
-# long pip runs so live installs are never broken.
-_PIP_LOCK_STALE_S = 120.0
+# The pip site-packages dir currently active on this worker (shared task
+# workers run different envs over time; see _activate_pip_site).
+_active_pip_site: Optional[str] = None
+
+
+def _activate_pip_site(site: str) -> None:
+    """Switch this worker process to ``site``'s pip env. Sequential tasks
+    with different pip specs must each see exactly their own packages: the
+    previous env's path entry is removed and every module imported from it
+    is evicted from sys.modules, so the next import resolves against the
+    new env rather than the stale module cache (the silent-wrong-version
+    hazard of sharing workers across envs)."""
+    global _active_pip_site
+    if _active_pip_site == site:
+        return
+    old = _active_pip_site
+    if old is not None:
+        try:
+            sys.path.remove(old)
+        except ValueError:
+            pass
+        for name, mod in list(sys.modules.items()):
+            f = getattr(mod, "__file__", None)
+            if f and f.startswith(old + os.sep):
+                del sys.modules[name]
+    if site not in sys.path:
+        sys.path.insert(0, site)
+    _active_pip_site = site
 
 
 async def ensure_pip_env(pip: Any) -> Optional[str]:
@@ -193,14 +217,15 @@ async def ensure_pip_env(pip: Any) -> Optional[str]:
     runtime_env/pip.py PipProcessor — per-hash cached virtualenv with
     system-site-packages so the image's baked-in deps stay importable).
 
-    Concurrency protocol: an atomic lock dir elects one installer; waiters
-    poll until the ready marker appears OR the lock vanishes (installer
-    failed — they then re-elect and surface the real install error
-    themselves). A lock whose heartbeat mtime goes stale (installer killed
-    mid-install) is broken and re-acquired. Failures raise — never silently
-    run without the requested packages."""
+    Concurrency protocol: an exclusive flock on a sidecar lock file elects
+    one installer at a time; the kernel releases the lock if the holder
+    dies mid-install (no staleness heuristics, no TOCTOU). Whoever acquires
+    the lock re-checks the ready marker first, so waiters either reuse the
+    finished env or retry the install and surface the real error
+    themselves. Failures raise — never silently run without the requested
+    packages."""
     import asyncio
-    import time as _time
+    import fcntl
 
     spec = _normalize_pip(pip)
     if not spec.get("packages"):
@@ -208,80 +233,61 @@ async def ensure_pip_env(pip: Any) -> Optional[str]:
     key = _pip_env_key(spec)
     dest = os.path.join(EXTRACT_ROOT, "pip", key)
     marker = os.path.join(dest, ".ready")
-    lock = dest + ".lock"
+    if os.path.exists(marker):
+        return _site_packages(dest)
     os.makedirs(os.path.dirname(dest), exist_ok=True)
-    while True:
-        if os.path.exists(marker):
-            return _site_packages(dest)
-        try:
-            os.mkdir(lock)  # atomic: we are the installer
-            break
-        except FileExistsError:
-            try:
-                if _time.time() - os.path.getmtime(lock) > _PIP_LOCK_STALE_S:
-                    # Installer died without cleanup; break the lock.
-                    os.rmdir(lock)
-                    continue
-            except OSError:
-                continue  # lock vanished between exists and stat: retry
-            await asyncio.sleep(0.25)
-    if os.path.exists(marker):  # raced a finishing installer for the lock
-        try:
-            os.rmdir(lock)
-        except OSError:
-            pass
-        return _site_packages(dest)
-
-    async def _run(cmd, what):
-        proc = await asyncio.create_subprocess_exec(
-            *cmd,
-            stdout=asyncio.subprocess.PIPE,
-            stderr=asyncio.subprocess.STDOUT,
-        )
-        out, _ = await proc.communicate()
-        if proc.returncode != 0:
-            raise RuntimeError(f"{what} failed: {out.decode()[-2000:]}")
-
-    async def _heartbeat():
-        while True:
-            await asyncio.sleep(15)
-            try:
-                os.utime(lock)
-            except OSError:
-                return
-
-    hb = asyncio.ensure_future(_heartbeat())
+    lock_f = open(dest + ".flock", "a+")
     try:
-        await _run(
-            [sys.executable, "-m", "venv", "--system-site-packages", dest],
-            "venv creation",
+        await asyncio.get_running_loop().run_in_executor(
+            None, fcntl.flock, lock_f, fcntl.LOCK_EX
         )
-        cmd = [
-            os.path.join(dest, "bin", "python"), "-m", "pip", "install",
-            "--disable-pip-version-check",
-        ]
-        cmd += spec.get("pip_install_options") or []
-        cmd += spec["packages"]
-        await _run(cmd, f"pip install of {spec['packages']}")
-        if spec.get("pip_check"):
-            await _run(
-                [os.path.join(dest, "bin", "python"), "-m", "pip", "check"],
-                "pip check",
-            )
-        with open(marker, "w") as f:
-            f.write("ok")
-        return _site_packages(dest)
-    except BaseException:
-        import shutil
+        if os.path.exists(marker):  # another installer finished while we waited
+            return _site_packages(dest)
 
-        shutil.rmtree(dest, ignore_errors=True)
-        raise
-    finally:
-        hb.cancel()
+        async def _run(cmd, what):
+            proc = await asyncio.create_subprocess_exec(
+                *cmd,
+                stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.STDOUT,
+            )
+            out, _ = await proc.communicate()
+            if proc.returncode != 0:
+                raise RuntimeError(f"{what} failed: {out.decode()[-2000:]}")
+
         try:
-            os.rmdir(lock)
+            import shutil
+
+            shutil.rmtree(dest, ignore_errors=True)  # half-built leftovers
+            await _run(
+                [sys.executable, "-m", "venv", "--system-site-packages", dest],
+                "venv creation",
+            )
+            cmd = [
+                os.path.join(dest, "bin", "python"), "-m", "pip", "install",
+                "--disable-pip-version-check",
+            ]
+            cmd += spec.get("pip_install_options") or []
+            cmd += spec["packages"]
+            await _run(cmd, f"pip install of {spec['packages']}")
+            if spec.get("pip_check"):
+                await _run(
+                    [os.path.join(dest, "bin", "python"), "-m", "pip", "check"],
+                    "pip check",
+                )
+            with open(marker, "w") as f:
+                f.write("ok")
+            return _site_packages(dest)
+        except BaseException:
+            import shutil
+
+            shutil.rmtree(dest, ignore_errors=True)
+            raise
+    finally:
+        try:
+            fcntl.flock(lock_f, fcntl.LOCK_UN)
         except OSError:
             pass
+        lock_f.close()
 
 
 @contextlib.contextmanager

@@ -46,6 +46,39 @@ def test_wedged_node_detected_by_health_checks(monkeypatch):
         cluster.shutdown()
 
 
+def test_versioned_view_sync(monkeypatch):
+    """Raylets converge on the cluster view via versioned deltas (no
+    polling): joins, resource updates, and deaths all bump the version and
+    land in every raylet's local map (reference: ray_syncer.h streams)."""
+    cluster = Cluster(head_node_args={"num_cpus": 1, "num_tpus": 0})
+    head_raylet = cluster.head_node.raylet
+    cluster.connect()
+    try:
+        n2 = cluster.add_node(num_cpus=2)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if (
+                head_raylet._view_version >= 0
+                and n2.node_id in head_raylet._view_map
+            ):
+                break
+            time.sleep(0.1)
+        assert n2.node_id in head_raylet._view_map, "join delta never arrived"
+        v_after_join = head_raylet._view_version
+        assert v_after_join >= 0
+
+        cluster.remove_node(n2)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if n2.node_id not in head_raylet._view_map:
+                break
+            time.sleep(0.1)
+        assert n2.node_id not in head_raylet._view_map, "death delta never arrived"
+        assert head_raylet._view_version > v_after_join
+    finally:
+        cluster.shutdown()
+
+
 def test_slow_subscriber_backpressure(monkeypatch):
     """A subscriber that stops reading its socket must not stall the GCS:
     its queue bounds, oldest messages drop, and other RPCs stay fast."""

@@ -66,6 +66,35 @@ def read_json(paths, *, parallelism: int = 8) -> Dataset:
     return Dataset([Read(read_tasks=_src.json_read_tasks(paths))], parallelism)
 
 
+def read_text(
+    paths, *, encoding: str = "utf-8", drop_empty_lines: bool = True,
+    parallelism: int = 8,
+) -> Dataset:
+    """One row per line across the files (reference: ray.data.read_text)."""
+    return Dataset(
+        [Read(read_tasks=_src.text_read_tasks(paths, encoding, drop_empty_lines))],
+        parallelism,
+    )
+
+
+def read_binary_files(
+    paths, *, include_paths: bool = False, parallelism: int = 8
+) -> Dataset:
+    """One row per file holding its raw bytes (reference:
+    ray.data.read_binary_files)."""
+    return Dataset(
+        [Read(read_tasks=_src.binary_read_tasks(paths, include_paths))],
+        parallelism,
+    )
+
+
+def read_numpy(paths, *, column: str = "data", parallelism: int = 8) -> Dataset:
+    """.npy files, one block each (reference: ray.data.read_numpy)."""
+    return Dataset(
+        [Read(read_tasks=_src.numpy_read_tasks(paths, column))], parallelism
+    )
+
+
 __all__ = [
     "Dataset",
     "GroupedData",
@@ -79,4 +108,7 @@ __all__ = [
     "read_csv",
     "read_parquet",
     "read_json",
+    "read_text",
+    "read_binary_files",
+    "read_numpy",
 ]

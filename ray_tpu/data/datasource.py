@@ -122,6 +122,58 @@ def json_read_tasks(paths):
     return tasks
 
 
+def text_read_tasks(paths, encoding: str = "utf-8", drop_empty_lines: bool = True):
+    """One block per file; one row per line (reference: read_text)."""
+    files = _expand_paths(paths)
+    tasks = []
+    for f in files:
+
+        def task(f=f, encoding=encoding, drop=drop_empty_lines):
+            with open(f, encoding=encoding) as fh:
+                lines = fh.read().splitlines()
+            if drop:
+                lines = [ln for ln in lines if ln]
+            return pa.table({"text": lines})
+
+        tasks.append(task)
+    return tasks
+
+
+def binary_read_tasks(paths, include_paths: bool = False):
+    """One block per file; the file's bytes as one row (reference:
+    read_binary_files)."""
+    files = _expand_paths(paths)
+    tasks = []
+    for f in files:
+
+        def task(f=f, include_paths=include_paths):
+            with open(f, "rb") as fh:
+                data = fh.read()
+            cols = {"bytes": pa.array([data], type=pa.binary())}
+            if include_paths:
+                cols["path"] = pa.array([f])
+            return pa.table(cols)
+
+        tasks.append(task)
+    return tasks
+
+
+def numpy_read_tasks(paths, column: str = "data"):
+    """One block per .npy file (reference: read_numpy)."""
+    files = _expand_paths(paths)
+    tasks = []
+    for f in files:
+
+        def task(f=f, column=column):
+            import numpy as np
+
+            arr = np.load(f, allow_pickle=False)
+            return pa.table({column: list(arr)})
+
+        tasks.append(task)
+    return tasks
+
+
 # -- writers (run as remote tasks, one file per block) -----------------------
 
 

@@ -28,6 +28,7 @@ from ray_tpu.rllib.algorithms.impala import (
     IMPALAConfig,
 )
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.core.learner import Learner
 from ray_tpu.rllib.core.learner_group import LearnerGroup
 from ray_tpu.rllib.core.rl_module import RLModuleSpec
@@ -41,6 +42,8 @@ __all__ = [
     "AlgorithmConfig",
     "APPO",
     "APPOConfig",
+    "SAC",
+    "SACConfig",
     "DQN",
     "DQNConfig",
     "EnvRunnerGroup",

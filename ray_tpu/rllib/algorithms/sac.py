@@ -1,0 +1,187 @@
+"""SAC: soft actor-critic for continuous (Box) action spaces.
+
+Analog of rllib/algorithms/sac/ (sac.py, sac_learner, default_sac_rl_module):
+squashed-Gaussian actor, twin Q critics with polyak-averaged targets, and
+automatic entropy-temperature tuning against a target entropy of -act_dim.
+Off-policy: env runners explore stochastically into a uniform replay buffer;
+the learner runs jitted critic/actor/alpha updates.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.core.rl_module import RLModuleSpec, init_sac, sac_pi, sac_q
+from ray_tpu.rllib.utils.replay_buffer import ReplayBuffer
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=SAC)
+        self.lr = 3e-4
+        self.train_batch_size = 256
+        self.replay_buffer_capacity = 100_000
+        self.num_steps_sampled_before_learning_starts = 1500
+        self.tau = 0.005  # polyak target-update coefficient
+        self.target_entropy = None  # default: -act_dim
+        self.initial_alpha = 1.0
+        self.updates_per_iteration = 32
+        self.rollout_fragment_length = 4
+
+
+class SACLearner(Learner):
+    """One update = twin-critic TD step + actor step + alpha step, all in
+    the single jitted loss (losses are summed; their parameter sets are
+    disjoint, so gradients don't cross-contaminate — the standard single
+    -optimizer formulation)."""
+
+    def __init__(self, spec: RLModuleSpec, cfg: Dict[str, Any], **kw):
+        self.cfg = cfg
+        super().__init__(spec, **kw)
+        self.target_params = {"q1": self.params["q1"], "q2": self.params["q2"]}
+
+    def init_params(self, rng):
+        import jax.numpy as jnp
+
+        params = init_sac(rng, self.spec)
+        params["log_alpha"] = jnp.asarray(
+            jnp.log(self.cfg.get("initial_alpha", 1.0)), params["log_alpha"].dtype
+        )
+        return params
+
+    def loss_fn(self, params, batch):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        limit = self.spec.act_limit
+        alpha = jnp.exp(params["log_alpha"])
+
+        # -- critic loss (targets from the target twin-min + entropy bonus)
+        next_act, next_logp = sac_pi(
+            params, batch["next_obs"], batch["_rng_next"], limit
+        )
+        tq1, tq2 = sac_q(batch["_target_params"], batch["next_obs"], next_act)
+        target_v = jnp.minimum(tq1, tq2) - jax.lax.stop_gradient(alpha) * next_logp
+        target = batch["rewards"] + cfg["gamma"] * (1.0 - batch["dones"]) * target_v
+        target = jax.lax.stop_gradient(target)
+        q1, q2 = sac_q(params, batch["obs"], batch["actions"])
+        critic_loss = jnp.mean((q1 - target) ** 2) + jnp.mean((q2 - target) ** 2)
+
+        # -- actor loss (reparameterized; critics frozen via stop_gradient)
+        frozen_q = jax.lax.stop_gradient({"q1": params["q1"], "q2": params["q2"]})
+        act, logp = sac_pi(params, batch["obs"], batch["_rng_pi"], limit)
+        aq1, aq2 = sac_q(frozen_q, batch["obs"], act)
+        actor_loss = jnp.mean(
+            jax.lax.stop_gradient(alpha) * logp - jnp.minimum(aq1, aq2)
+        )
+
+        # -- temperature loss (drive entropy toward the target)
+        alpha_loss = -jnp.mean(
+            params["log_alpha"] * jax.lax.stop_gradient(logp + cfg["target_entropy"])
+        )
+
+        loss = critic_loss + actor_loss + alpha_loss
+        return loss, {
+            "critic_loss": critic_loss,
+            "actor_loss": actor_loss,
+            "alpha_loss": alpha_loss,
+            "alpha": alpha,
+            "q1_mean": jnp.mean(q1),
+            "entropy": -jnp.mean(logp),
+        }
+
+    def update_from_batch(self, batch):
+        batch = dict(batch)
+        batch["_target_params"] = self.target_params
+        batch["_rng_next"] = self._next_rng()
+        batch["_rng_pi"] = self._next_rng()
+        metrics = super().update_from_batch(batch)
+        self._polyak()
+        return metrics
+
+    def _polyak(self) -> None:
+        import jax
+
+        tau = self.cfg["tau"]
+        online = {"q1": self.params["q1"], "q2": self.params["q2"]}
+        self.target_params = jax.tree_util.tree_map(
+            lambda t, o: (1.0 - tau) * t + tau * o, self.target_params, online
+        )
+
+
+class SAC(Algorithm):
+    policy_kind = "sac"
+
+    def __init__(self, config: AlgorithmConfig):
+        super().__init__(config)
+        act_dim, _ = self.env_runner_group.get_act_info()
+        self.replay = ReplayBuffer(
+            config.replay_buffer_capacity,
+            self.obs_dim,
+            seed=config.seed,
+            act_dim=act_dim,
+        )
+
+    def _module_spec_dict(self) -> Dict[str, Any]:
+        m = self.config.model
+        return {"hidden": tuple(m.get("hidden", (256, 256)))}
+
+    def _learner_builder(self, obs_dim: int, num_actions: int) -> Callable[[], Any]:
+        cfg = self.config
+        act_dim, act_limit = self.env_runner_group.get_act_info()
+        if not act_dim:
+            raise ValueError("SAC requires a continuous (Box) action space")
+        spec = RLModuleSpec(
+            obs_dim=obs_dim,
+            num_actions=0,
+            hidden=tuple(cfg.model.get("hidden", (256, 256))),
+            act_dim=act_dim,
+            act_limit=act_limit,
+        )
+        target_entropy = (
+            cfg.target_entropy if cfg.target_entropy is not None else -float(act_dim)
+        )
+        loss_cfg = {
+            "gamma": cfg.gamma,
+            "tau": cfg.tau,
+            "target_entropy": target_entropy,
+            "initial_alpha": cfg.initial_alpha,
+        }
+        lr, grad_clip, seed = cfg.lr, cfg.grad_clip, cfg.seed
+
+        def build():
+            return SACLearner(spec, loss_cfg, lr=lr, grad_clip=grad_clip, seed=seed)
+
+        return build
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        learner = self.learner_group._local
+        assert learner is not None, "SAC requires num_learners=0 (local learner)"
+
+        warmup = (
+            self._env_steps_total < cfg.num_steps_sampled_before_learning_starts
+        )
+        batches = self.env_runner_group.sample(
+            cfg.rollout_fragment_length, random_actions=warmup
+        )
+        self._env_steps_total += sum(b["env_steps"] for b in batches)
+        for b in batches:
+            self.replay.add_batch(b)
+
+        metrics: Dict[str, float] = {}
+        if len(self.replay) >= cfg.num_steps_sampled_before_learning_starts:
+            for _ in range(cfg.updates_per_iteration):
+                metrics = learner.update_from_batch(
+                    self.replay.sample(cfg.train_batch_size)
+                )
+            self._sync_weights()
+        return {
+            **self._episode_metrics(batches),
+            **{k: float(v) for k, v in metrics.items()},
+            "replay_size": len(self.replay),
+        }

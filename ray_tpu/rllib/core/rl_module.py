@@ -29,6 +29,10 @@ class RLModuleSpec:
     # "shared" (one torso, two heads) or "separate" (independent pi/vf nets).
     vf_share_layers: bool = False
     dtype: Any = jnp.float32
+    # Continuous (Box) action spaces (SAC): dimensionality and symmetric
+    # bound; num_actions is 0 for continuous modules.
+    act_dim: int = 0
+    act_limit: float = 1.0
 
 
 def _init_mlp(rng, sizes: Sequence[int], dtype) -> list:
@@ -105,3 +109,52 @@ def sample_actions(rng, logits):
 
 def num_params(params) -> int:
     return int(sum(np.prod(x.shape) for x in jax.tree_util.tree_leaves(params)))
+
+
+# -- SAC: squashed-Gaussian actor + twin Q (reference: sac_rl_module /
+# sac_learner; continuous Box actions) --------------------------------------
+
+LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+
+def init_sac(rng, spec: RLModuleSpec) -> Dict[str, Any]:
+    """Actor (obs -> [mu, log_std]), twin critics (obs+act -> q), and the
+    learnable entropy temperature log_alpha."""
+    k1, k2, k3 = jax.random.split(rng, 3)
+    in_q = spec.obs_dim + spec.act_dim
+    return {
+        "pi": _init_mlp(k1, (spec.obs_dim, *spec.hidden, 2 * spec.act_dim), spec.dtype),
+        "q1": _init_mlp(k2, (in_q, *spec.hidden, 1), spec.dtype),
+        "q2": _init_mlp(k3, (in_q, *spec.hidden, 1), spec.dtype),
+        "log_alpha": jnp.zeros((), spec.dtype),
+    }
+
+
+def sac_pi(params, obs, rng, act_limit: float):
+    """Sample a squashed-Gaussian action; returns (action, logp) with the
+    tanh change-of-variables correction."""
+    mu_logstd = _mlp(params["pi"], obs)
+    mu, log_std = jnp.split(mu_logstd, 2, axis=-1)
+    log_std = jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+    std = jnp.exp(log_std)
+    eps = jax.random.normal(rng, mu.shape)
+    pre = mu + std * eps
+    # Gaussian logp minus tanh correction (numerically stable softplus form).
+    logp = (-0.5 * (eps**2) - log_std - 0.5 * jnp.log(2 * jnp.pi)).sum(-1)
+    logp -= (2.0 * (jnp.log(2.0) - pre - jax.nn.softplus(-2.0 * pre))).sum(-1)
+    # Jacobian of the final scaling by act_limit: without it the density is
+    # that of tanh(pre), biasing the alpha auto-tune by log(act_limit)/dim.
+    logp -= mu.shape[-1] * jnp.log(act_limit)
+    action = jnp.tanh(pre) * act_limit
+    return action, logp
+
+
+def sac_pi_deterministic(params, obs, act_limit: float):
+    mu_logstd = _mlp(params["pi"], obs)
+    mu, _ = jnp.split(mu_logstd, 2, axis=-1)
+    return jnp.tanh(mu) * act_limit
+
+
+def sac_q(params, obs, act) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    x = jnp.concatenate([obs, act], axis=-1)
+    return _mlp(params["q1"], x)[..., 0], _mlp(params["q2"], x)[..., 0]

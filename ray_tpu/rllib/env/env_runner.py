@@ -61,10 +61,32 @@ class SingleAgentEnvRunner:
         obs_space = self.envs.single_observation_space
         act_space = self.envs.single_action_space
         self.obs_dim = int(np.prod(obs_space.shape))
-        self.num_actions = int(act_space.n)
+        if hasattr(act_space, "n"):  # Discrete
+            self.num_actions = int(act_space.n)
+            self.act_dim = 0
+            self.act_low = self.act_high = None
+        else:  # Box (continuous, SAC)
+            self.num_actions = 0
+            self.act_dim = int(np.prod(act_space.shape))
+            self.act_low = np.asarray(act_space.low, dtype=np.float32).reshape(-1)
+            self.act_high = np.asarray(act_space.high, dtype=np.float32).reshape(-1)
+            if not (
+                np.all(np.isfinite(self.act_low))
+                and np.all(np.isfinite(self.act_high))
+                and np.allclose(-self.act_low, self.act_high)
+            ):
+                raise ValueError(
+                    "continuous policies require a bounded symmetric Box "
+                    f"action space (got low={self.act_low}, "
+                    f"high={self.act_high}); wrap the env with a "
+                    "RescaleAction-style wrapper"
+                )
         spec_kwargs = dict(module_spec_dict or {})
         spec_kwargs.setdefault("obs_dim", self.obs_dim)
         spec_kwargs.setdefault("num_actions", self.num_actions)
+        if self.act_dim:
+            spec_kwargs.setdefault("act_dim", self.act_dim)
+            spec_kwargs.setdefault("act_limit", float(np.max(np.abs(self.act_high))))
         self.spec = M.RLModuleSpec(**spec_kwargs)
 
         if policy_kind == "pi_vf":
@@ -83,6 +105,18 @@ class SingleAgentEnvRunner:
                 return M.forward_q(params, obs).argmax(axis=-1)
 
             self._greedy = jax.jit(_greedy)
+        elif policy_kind == "sac":
+            self.params = M.init_sac(self._next_rng(), self.spec)
+            limit = self.spec.act_limit
+
+            def _sac_step(params, rng, obs):
+                return M.sac_pi(params, obs, rng, limit)
+
+            self._sac_step = jax.jit(_sac_step)
+            # Deterministic (tanh-mean) policy for evaluation rollouts.
+            self._sac_greedy = jax.jit(
+                lambda params, obs: M.sac_pi_deterministic(params, obs, limit)
+            )
         else:
             raise ValueError(f"unknown policy_kind {policy_kind!r}")
 
@@ -113,14 +147,22 @@ class SingleAgentEnvRunner:
     # -- sampling ------------------------------------------------------------
 
     def sample(
-        self, num_steps: int, *, epsilon: float = 0.0, random_actions: bool = False
+        self,
+        num_steps: int,
+        *,
+        epsilon: float = 0.0,
+        random_actions: bool = False,
+        deterministic: bool = False,
     ) -> Dict[str, Any]:
         """Collect num_steps steps from every env. Time-major output."""
         from ray_tpu.rllib.core import rl_module as M
 
         T, N = num_steps, self.num_envs
         obs_buf = np.empty((T, N, self.obs_dim), dtype=np.float32)
-        act_buf = np.empty((T, N), dtype=np.int64)
+        if self.act_dim:
+            act_buf = np.empty((T, N, self.act_dim), dtype=np.float32)
+        else:
+            act_buf = np.empty((T, N), dtype=np.int64)
         rew_buf = np.empty((T, N), dtype=np.float32)
         # `done` = terminated only; truncation bootstraps instead of zeroing.
         term_buf = np.empty((T, N), dtype=np.bool_)
@@ -139,6 +181,21 @@ class SingleAgentEnvRunner:
                 actions = np.asarray(actions)
                 logp_buf[t] = np.asarray(logp)
                 val_buf[t] = np.asarray(value)
+            elif self.policy_kind == "sac":
+                if random_actions:
+                    # Warmup: uniform over the Box bounds (reference SAC's
+                    # initial exploration steps).
+                    actions = np.random.uniform(
+                        self.act_low[None, :], self.act_high[None, :],
+                        size=(N, self.act_dim),
+                    ).astype(np.float32)
+                elif deterministic:
+                    actions = np.asarray(self._sac_greedy(self.params, obs_flat))
+                else:
+                    acts, _ = self._sac_step(
+                        self.params, self._next_rng(), obs_flat
+                    )
+                    actions = np.asarray(acts)
             else:
                 if random_actions:
                     actions = np.random.randint(0, self.num_actions, size=N)
@@ -147,7 +204,12 @@ class SingleAgentEnvRunner:
                     explore = np.random.rand(N) < epsilon
                     randoms = np.random.randint(0, self.num_actions, size=N)
                     actions = np.where(explore, randoms, greedy)
-            next_obs, rewards, terminated, truncated, infos = self.envs.step(actions)
+            env_actions = (
+                actions.reshape((N,) + self.envs.single_action_space.shape)
+                if self.act_dim
+                else actions
+            )
+            next_obs, rewards, terminated, truncated, infos = self.envs.step(env_actions)
             act_buf[t] = actions
             rew_buf[t] = rewards
             term_buf[t] = terminated
@@ -209,6 +271,11 @@ class SingleAgentEnvRunner:
 
     def get_spaces(self) -> Tuple[int, int]:
         return self.obs_dim, self.num_actions
+
+    def get_act_info(self) -> Tuple[int, float]:
+        """(act_dim, act_limit) for continuous action spaces (SAC)."""
+        limit = float(np.max(np.abs(self.act_high))) if self.act_dim else 0.0
+        return self.act_dim, limit
 
     def stop(self) -> None:
         self.envs.close()

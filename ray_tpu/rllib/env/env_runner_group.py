@@ -86,6 +86,13 @@ class EnvRunnerGroup:
         ids = self._manager.healthy_actor_ids()
         return ray_tpu.get(self._manager.actors[ids[0]].get_spaces.remote())
 
+    def get_act_info(self):
+        """(act_dim, act_limit) for continuous action spaces (SAC)."""
+        if self._local is not None:
+            return self._local.get_act_info()
+        ids = self._manager.healthy_actor_ids()
+        return ray_tpu.get(self._manager.actors[ids[0]].get_act_info.remote())
+
     # -- sampling ------------------------------------------------------------
 
     def sample(self, num_steps: int, **kw) -> List[Dict[str, Any]]:

@@ -13,11 +13,17 @@ import numpy as np
 
 
 class ReplayBuffer:
-    def __init__(self, capacity: int, obs_dim: int, seed: int = 0):
+    def __init__(self, capacity: int, obs_dim: int, seed: int = 0, act_dim: int = 0):
+        """act_dim 0 -> discrete int actions; >0 -> continuous float vectors
+        (SAC)."""
         self.capacity = capacity
+        self.act_dim = act_dim
         self.obs = np.empty((capacity, obs_dim), dtype=np.float32)
         self.next_obs = np.empty((capacity, obs_dim), dtype=np.float32)
-        self.actions = np.empty((capacity,), dtype=np.int64)
+        if act_dim:
+            self.actions = np.empty((capacity, act_dim), dtype=np.float32)
+        else:
+            self.actions = np.empty((capacity,), dtype=np.int64)
         self.rewards = np.empty((capacity,), dtype=np.float32)
         self.dones = np.empty((capacity,), dtype=np.float32)
         self._size = 0
@@ -31,7 +37,10 @@ class ReplayBuffer:
         """batch: time-major [T, N, ...] arrays from an EnvRunner.sample()."""
         obs = batch["obs"].reshape(-1, batch["obs"].shape[-1])
         next_obs = batch["next_obs"].reshape(-1, batch["next_obs"].shape[-1])
-        actions = batch["actions"].reshape(-1)
+        if self.act_dim:
+            actions = batch["actions"].reshape(-1, self.act_dim)
+        else:
+            actions = batch["actions"].reshape(-1)
         rewards = batch["rewards"].reshape(-1)
         dones = batch["terminateds"].reshape(-1).astype(np.float32)
         n = len(obs)

@@ -118,6 +118,31 @@ def test_read_write_parquet_csv(ray_start_regular, tmp_path):
     assert rd.read_csv(csv_dir).count() == 20
 
 
+def test_read_text_binary_numpy(ray_start_regular, tmp_path):
+    """read_text / read_binary_files / read_numpy datasources (reference:
+    ray.data.read_text / read_binary_files / read_numpy)."""
+    import numpy as np
+
+    import ray_tpu.data as rd
+
+    (tmp_path / "a.txt").write_text("alpha\n\nbeta\n")
+    (tmp_path / "b.txt").write_text("gamma\n")
+    ds = rd.read_text([str(tmp_path / "a.txt"), str(tmp_path / "b.txt")])
+    texts = sorted(r["text"] for r in ds.take_all())
+    assert texts == ["alpha", "beta", "gamma"]
+
+    (tmp_path / "x.bin").write_bytes(b"\x00\x01payload")
+    ds = rd.read_binary_files(str(tmp_path / "x.bin"), include_paths=True)
+    rows = ds.take_all()
+    assert rows[0]["bytes"] == b"\x00\x01payload"
+    assert rows[0]["path"].endswith("x.bin")
+
+    np.save(tmp_path / "arr.npy", np.arange(6, dtype=np.int64))
+    ds = rd.read_numpy(str(tmp_path / "arr.npy"))
+    vals = [r["data"] for r in ds.take_all()]
+    assert vals == list(range(6))
+
+
 def test_streaming_split_covers_all_rows(ray_start_regular):
     ds = rd.range(40, parallelism=4)
     shards = ds.streaming_split(2)

@@ -23,7 +23,9 @@ from ray_tpu.serve.api import (
     deployment,
     get_app_handle,
     get_deployment_handle,
+    get_multiplexed_model_id,
     ingress,
+    multiplexed,
     run,
     shutdown,
     start,
@@ -45,7 +47,9 @@ __all__ = [
     "deployment",
     "get_app_handle",
     "get_deployment_handle",
+    "get_multiplexed_model_id",
     "ingress",
+    "multiplexed",
     "run",
     "shutdown",
     "start",

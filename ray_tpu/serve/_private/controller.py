@@ -124,6 +124,7 @@ class ServeController:
             (
                 self._http_options.get("host", "127.0.0.1"),
                 self._http_options.get("port", 8000),
+                self._http_options.get("grpc_port"),
             ),
             {},
             resources={"CPU": 0.0},
@@ -137,6 +138,8 @@ class ServeController:
         refs = await core.submit_actor_task(actor_id, "ready", (), {}, num_returns=1)
         bound = await core.get_objects(refs[0], timeout=None)
         self._http_options["port"] = bound["port"]
+        if bound.get("grpc_port") is not None:
+            self._http_options["grpc_port"] = bound["grpc_port"]
         logger.info("serve proxy listening on %s", bound)
 
     async def get_http_config(self) -> Dict[str, Any]:

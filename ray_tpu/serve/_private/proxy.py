@@ -53,9 +53,13 @@ def _to_response(result: Any) -> Tuple[int, bytes, str]:
 
 
 class ProxyActor:
-    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+    def __init__(
+        self, host: str = "127.0.0.1", port: int = 8000, grpc_port=None
+    ):
         self._host = host
         self._port = port
+        self._grpc_port = grpc_port
+        self._grpc_server = None
         self._route_table: Dict[str, Dict[str, str]] = {}
         self._router = None
         self._runner = None
@@ -103,7 +107,73 @@ class ProxyActor:
         await site.start()
         if self._port == 0:
             self._port = site._server.sockets[0].getsockname()[1]
-        return {"host": self._host, "port": self._port}
+        if self._grpc_port is not None:
+            await self._start_grpc()
+        return {
+            "host": self._host,
+            "port": self._port,
+            "grpc_port": self._grpc_port,
+        }
+
+    async def _start_grpc(self) -> None:
+        """gRPC ingress (reference: serve's gRPC proxy, grpc_util.py +
+        gRPCOptions): a generic bytes-in/bytes-out unary service —
+        /ray_tpu.serve.GenericService/Predict — routed by invocation
+        metadata: ``application`` selects the app (default: any),
+        ``method`` the handler method, ``multiplexed_model_id`` rides
+        through to the replica context."""
+        import grpc
+
+        async def predict(request: bytes, context) -> bytes:
+            md = {k: v for k, v in (context.invocation_metadata() or ())}
+            app = md.get("application")
+            target = None
+            for _, t in sorted(self._route_table.items()):
+                if app is None or t["app"] == app:
+                    target = t
+                    break
+            if target is None:
+                await context.abort(
+                    grpc.StatusCode.NOT_FOUND,
+                    f"no serve application {app!r}",
+                )
+            dep_id_str = f"{target['app']}#{target['ingress']}"
+            try:
+                result = await self._router.assign_request(
+                    dep_id_str,
+                    {
+                        "call_method": md.get("method", "__call__"),
+                        "multiplexed_model_id": md.get("multiplexed_model_id"),
+                    },
+                    (request,),
+                    {},
+                    timeout_s=60.0,
+                )
+            except TimeoutError as e:
+                await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+            except Exception as e:
+                await context.abort(
+                    grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}"
+                )
+            if isinstance(result, bytes):
+                return result
+            if isinstance(result, str):
+                return result.encode()
+            import cloudpickle
+
+            return cloudpickle.dumps(result)
+
+        handler = grpc.method_handlers_generic_handler(
+            "ray_tpu.serve.GenericService",
+            {"Predict": grpc.unary_unary_rpc_method_handler(predict)},
+        )
+        self._grpc_server = grpc.aio.server()
+        self._grpc_server.add_generic_rpc_handlers((handler,))
+        bound = self._grpc_server.add_insecure_port(
+            f"{self._host}:{self._grpc_port}"
+        )
+        await self._grpc_server.start()
+        self._grpc_port = bound
 
     def _set_route_table(self, table: Dict[str, Dict[str, str]]) -> None:
         self._route_table = table or {}
@@ -147,7 +217,15 @@ class ProxyActor:
         try:
             result = await self._router.assign_request(
                 dep_id_str,
-                {"call_method": "__call__", "is_http_request": True},
+                {
+                    "call_method": "__call__",
+                    "is_http_request": True,
+                    # Reference Serve convention: multiplexed model id rides
+                    # an HTTP header.
+                    "multiplexed_model_id": request.headers.get(
+                        "serve_multiplexed_model_id", ""
+                    ),
+                },
                 (http_req,),
                 {},
                 timeout_s=60.0,

@@ -66,13 +66,27 @@ class Replica:
         to max_ongoing_requests (actor max_concurrency)."""
         self._num_ongoing += 1
         self._total_served += 1
+        model_id = request_meta.get("multiplexed_model_id")
+        if model_id:
+            # Visible to @serve.multiplexed loaders via
+            # serve.get_multiplexed_model_id() (reference: replica context).
+            from ray_tpu.serve import api as serve_api
+
+            serve_api._multiplexed_model_id_ctx.set(model_id)
         try:
             method_name = request_meta.get("call_method", "__call__")
             method = getattr(self._user, method_name)
             if inspect.iscoroutinefunction(method):
                 return await method(*args, **kwargs)
             loop = asyncio.get_running_loop()
-            return await loop.run_in_executor(None, lambda: method(*args, **kwargs))
+            # copy_context: contextvars (multiplexed model id) must follow
+            # the call onto the executor thread.
+            import contextvars
+
+            ctx = contextvars.copy_context()
+            return await loop.run_in_executor(
+                None, lambda: ctx.run(method, *args, **kwargs)
+            )
         finally:
             self._num_ongoing -= 1
 

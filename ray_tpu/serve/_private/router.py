@@ -28,6 +28,8 @@ class _ReplicaSet:
         self.ongoing: Dict[str, int] = {}
         self.nonempty = asyncio.Event()
         self.slot_freed = asyncio.Event()
+        # model_id -> replica_id_str sticky routing for @serve.multiplexed.
+        self.model_affinity: Dict[str, str] = {}
 
     def update(self, infos: List[RunningReplicaInfo]) -> None:
         self.replicas = infos
@@ -40,6 +42,9 @@ class _ReplicaSet:
             if rid not in new_ids:
                 del self.handles[rid]
                 self.ongoing.pop(rid, None)
+        for mid, rid in list(self.model_affinity.items()):
+            if rid not in new_ids:
+                del self.model_affinity[mid]
         if infos:
             self.nonempty.set()
         else:
@@ -103,7 +108,9 @@ class Router:
 
     # -- scheduling ----------------------------------------------------------
 
-    def _pick_replica(self, rs: _ReplicaSet) -> Optional[RunningReplicaInfo]:
+    def _pick_replica(
+        self, rs: _ReplicaSet, model_id: Optional[str] = None
+    ) -> Optional[RunningReplicaInfo]:
         candidates = [
             r
             for r in rs.replicas
@@ -111,8 +118,27 @@ class Router:
         ]
         if not candidates:
             return None
+        if model_id:
+            # Multiplexed-model affinity (reference: multiplexed routing):
+            # keep one model's requests on the replica that already loaded
+            # it, so per-replica model caches actually hit.
+            preferred = rs.model_affinity.get(model_id)
+            if preferred is not None:
+                for r in candidates:
+                    if r.replica_id_str == preferred:
+                        return r
+                if any(r.replica_id_str == preferred for r in rs.replicas):
+                    # Pinned replica is alive but momentarily full: wait for
+                    # a slot instead of rebinding (a rebind cold-loads the
+                    # model elsewhere and thrashes both replicas' caches).
+                    return None
         sampled = random.sample(candidates, min(2, len(candidates)))
-        return min(sampled, key=lambda r: rs.ongoing.get(r.replica_id_str, 0))
+        pick = min(sampled, key=lambda r: rs.ongoing.get(r.replica_id_str, 0))
+        if model_id:
+            rs.model_affinity[model_id] = pick.replica_id_str
+            while len(rs.model_affinity) > 256:
+                rs.model_affinity.pop(next(iter(rs.model_affinity)))
+        return pick
 
     async def assign_request(
         self,
@@ -136,7 +162,9 @@ class Router:
                     raise TimeoutError(
                         f"no replicas of {deployment_id_str} available"
                     ) from None
-            replica = self._pick_replica(rs)
+            replica = self._pick_replica(
+                rs, request_meta.get("multiplexed_model_id")
+            )
             if replica is not None:
                 break
             # All replicas at max_ongoing_requests: wait for a slot.

@@ -282,6 +282,75 @@ def get_app_handle(name: str = DEFAULT_APP_NAME) -> DeploymentHandle:
     return DeploymentHandle(ing, name)
 
 
+# -- model multiplexing (reference: serve/api.py @serve.multiplexed +
+# get_multiplexed_model_id) ---------------------------------------------------
+
+import contextvars as _contextvars
+
+_multiplexed_model_id_ctx: "_contextvars.ContextVar[str]" = _contextvars.ContextVar(
+    "serve_multiplexed_model_id", default=""
+)
+
+
+def get_multiplexed_model_id() -> str:
+    """Inside a replica: the model id of the in-flight multiplexed request
+    (from the gRPC/HTTP ``multiplexed_model_id`` metadata)."""
+    return _multiplexed_model_id_ctx.get()
+
+
+def multiplexed(func=None, *, max_num_models_per_replica: int = 3):
+    """Decorate an async per-model loader on a deployment: loads are cached
+    per model id with LRU eviction at ``max_num_models_per_replica``; the
+    router keeps a model's requests sticky to the replica that loaded it."""
+    import asyncio as _asyncio
+    import collections as _collections
+    import functools as _functools
+
+    def deco(fn):
+        cache: "_collections.OrderedDict[str, Any]" = _collections.OrderedDict()
+        locks: Dict[str, Any] = {}
+
+        @_functools.wraps(fn)
+        async def wrapper(self_or_id, model_id=None):
+            # Supports both bound-method (self, model_id) and free (model_id).
+            if model_id is None:
+                target_id = self_or_id
+                call = lambda: fn(target_id)  # noqa: E731
+            else:
+                target_id = model_id
+                call = lambda: fn(self_or_id, target_id)  # noqa: E731
+            if target_id in cache:
+                cache.move_to_end(target_id)
+                return cache[target_id]
+            lock = locks.setdefault(target_id, _asyncio.Lock())
+            async with lock:
+                if target_id in cache:
+                    cache.move_to_end(target_id)
+                    return cache[target_id]
+                model = call()
+                if _asyncio.iscoroutine(model):
+                    model = await model
+                cache[target_id] = model
+                while len(cache) > max_num_models_per_replica:
+                    evicted_id, evicted = cache.popitem(last=False)
+                    locks.pop(evicted_id, None)
+                    # Release eagerly (reference evicts with explicit
+                    # deletion so TPU/GPU memory frees before the next load).
+                    del_fn = getattr(evicted, "__del__", None)
+                    if del_fn is not None:
+                        try:
+                            del_fn()
+                        except Exception:
+                            pass
+                return model
+
+        return wrapper
+
+    if func is not None:
+        return deco(func)
+    return deco
+
+
 def shutdown() -> None:
     """Tear down all Serve actors."""
     global _controller_handle

@@ -78,15 +78,27 @@ class DeploymentHandle:
         app_name: str = "default",
         *,
         method_name: str = "__call__",
+        multiplexed_model_id: str = "",
     ):
         self.deployment_id = DeploymentID(deployment_name, app_name)
         self._method_name = method_name
+        self._multiplexed_model_id = multiplexed_model_id
 
-    def options(self, *, method_name: Optional[str] = None) -> "DeploymentHandle":
+    def options(
+        self,
+        *,
+        method_name: Optional[str] = None,
+        multiplexed_model_id: Optional[str] = None,
+    ) -> "DeploymentHandle":
         return DeploymentHandle(
             self.deployment_id.name,
             self.deployment_id.app_name,
             method_name=method_name or self._method_name,
+            multiplexed_model_id=(
+                multiplexed_model_id
+                if multiplexed_model_id is not None
+                else self._multiplexed_model_id
+            ),
         )
 
     def __getattr__(self, name: str) -> "DeploymentHandle":
@@ -113,7 +125,11 @@ class DeploymentHandle:
                 rkwargs[k] = v
             return await router.assign_request(
                 str(self.deployment_id),
-                {"call_method": meta.call_method, "request_id": meta.request_id},
+                {
+                    "call_method": meta.call_method,
+                    "request_id": meta.request_id,
+                    "multiplexed_model_id": self._multiplexed_model_id,
+                },
                 tuple(rargs),
                 rkwargs,
             )
@@ -124,12 +140,24 @@ class DeploymentHandle:
     def __reduce__(self):
         return (
             _rebuild_handle,
-            (self.deployment_id.name, self.deployment_id.app_name, self._method_name),
+            (
+                self.deployment_id.name,
+                self.deployment_id.app_name,
+                self._method_name,
+                self._multiplexed_model_id,
+            ),
         )
 
     def __repr__(self):
         return f"DeploymentHandle({self.deployment_id})"
 
 
-def _rebuild_handle(name: str, app_name: str, method_name: str) -> DeploymentHandle:
-    return DeploymentHandle(name, app_name, method_name=method_name)
+def _rebuild_handle(
+    name: str, app_name: str, method_name: str, multiplexed_model_id: str = ""
+) -> DeploymentHandle:
+    return DeploymentHandle(
+        name,
+        app_name,
+        method_name=method_name,
+        multiplexed_model_id=multiplexed_model_id,
+    )

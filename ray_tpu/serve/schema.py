@@ -67,10 +67,15 @@ class DeploymentConfig:
 
 @dataclass
 class HTTPOptions:
-    """Proxy config (reference: serve/config.py HTTPOptions)."""
+    """Proxy config (reference: serve/config.py HTTPOptions + gRPCOptions).
+
+    ``grpc_port`` enables the gRPC ingress alongside HTTP: a generic
+    bytes-in/bytes-out service routed by metadata (0 = ephemeral port,
+    None = disabled)."""
 
     host: str = "127.0.0.1"
     port: int = 8000
+    grpc_port: Optional[int] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return asdict(self)

@@ -117,6 +117,77 @@ def test_http_ingress(serve_cluster):
         _http_get(f"{base}/nope")
 
 
+def test_grpc_ingress(serve_cluster):
+    """gRPC proxy: generic bytes service routed by metadata (reference:
+    Serve gRPC ingress, gRPCOptions + grpc proxy)."""
+    serve = serve_cluster
+    serve.start(http_options={"host": "127.0.0.1", "port": 0, "grpc_port": 0})
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, payload: bytes):
+            return b"echo:" + payload
+
+        def shout(self, payload: bytes):
+            return payload.upper()
+
+    serve.run(Echo.bind(), name="gapp", route_prefix="/gapp")
+    import ray_tpu
+
+    controller = ray_tpu.get_actor("SERVE_CONTROLLER", namespace="serve")
+    cfg = ray_tpu.get(controller.get_http_config.remote())
+    assert cfg.get("grpc_port"), cfg
+
+    import grpc
+
+    chan = grpc.insecure_channel(f"127.0.0.1:{cfg['grpc_port']}")
+    predict = chan.unary_unary("/ray_tpu.serve.GenericService/Predict")
+    assert (
+        predict(b"hi", metadata=(("application", "gapp"),), timeout=30)
+        == b"echo:hi"
+    )
+    assert (
+        predict(
+            b"hi",
+            metadata=(("application", "gapp"), ("method", "shout")),
+            timeout=30,
+        )
+        == b"HI"
+    )
+    with pytest.raises(grpc.RpcError):
+        predict(b"x", metadata=(("application", "nope"),), timeout=10)
+    chan.close()
+
+
+def test_multiplexed_model_routing(serve_cluster):
+    """@serve.multiplexed caches per-model loads with LRU and the router
+    keeps a model's requests sticky to its replica."""
+    serve = serve_cluster
+
+    @serve.deployment(num_replicas=2)
+    class MM:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def load(self, model_id: str):
+            self.loads.append(model_id)
+            return f"model-{model_id}"
+
+        async def __call__(self, payload):
+            mid = serve.get_multiplexed_model_id()
+            model = await self.load(mid)
+            return {"model": model, "loads": len(self.loads)}
+
+    serve.run(MM.bind(), name="mm", route_prefix="/mm")
+    handle = serve.get_app_handle("mm")
+    r1 = handle.options(multiplexed_model_id="a").remote("x").result(timeout_s=60)
+    assert r1["model"] == "model-a"
+    # Same model id again: cache hit on the SAME replica (loads unchanged).
+    r2 = handle.options(multiplexed_model_id="a").remote("x").result(timeout_s=60)
+    assert r2["model"] == "model-a" and r2["loads"] == r1["loads"]
+
+
 def test_redeploy_and_delete(serve_cluster):
     serve = serve_cluster
 

@@ -80,6 +80,10 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     # Task-event ring: max buffered owner-side task events between 1 Hz GCS
     # flushes; oldest drop first (reference: task_events_max_num_... knobs).
     "task_events_max_buffer": 10000,
+    # Worker-side per-task profile events (deserialize/execute/store phase
+    # timings in the chrome timeline). Off by default like the reference's
+    # RAY_PROFILING — it adds one GCS event per task.
+    "task_profile_events": False,
     # Push manager: max chunks in flight across ALL destination pushes from
     # one node (reference: push_manager.h max_chunks_in_flight). With 8 MiB
     # chunks the default bounds broadcast buffering at ~64 MiB.

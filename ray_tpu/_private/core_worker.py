@@ -1006,8 +1006,15 @@ class CoreWorker:
     async def _flush_task_events(self) -> None:
         if not self._task_events:
             return
-        events = list(self._task_events)
-        self._task_events.clear()
+        # Drain with popleft: producers append from other threads (worker
+        # exec thread records PROFILE events), so list()+clear() would drop
+        # anything appended between the snapshot and the clear.
+        events = []
+        try:
+            while True:
+                events.append(self._task_events.popleft())
+        except IndexError:
+            pass
         # Expand the hot-path tuples into wire dicts at flush time (the
         # constant per-process fields are added once here, not per event).
         out = []

@@ -17,6 +17,7 @@ import logging
 import os
 import sys
 import threading
+import time
 import traceback
 from collections import OrderedDict
 from typing import Any, Dict, Optional
@@ -218,6 +219,8 @@ class Executor:
         payload. Slow aspects (ref args, plasma-resident args/returns) hop to
         the event loop via run_on_loop."""
         core = self.core
+        profile = config.task_profile_events
+        t0 = time.time()
         exec_t = self._exec_thread
         actor_method = wire.get("actor_method")
         if actor_method is not None:
@@ -236,6 +239,7 @@ class Executor:
                 (args, kwargs), _ = serialization.deserialize(wire["args_blob"])
         else:
             args, kwargs = exec_t.run_on_loop(self.load_args(wire))
+        t_args = time.time()
         # -- execute
         renv = wire.get("runtime_env") or {}
         env_vars = renv.get("env_vars")
@@ -252,28 +256,46 @@ class Executor:
             result = exec_t.run_on_loop(fn(*args, **kwargs))
         else:
             result = fn(*args, **kwargs)
+        t_exec = time.time()
         # -- returns
         num_returns = wire["num_returns"]
         if num_returns == 0:
-            return {"returns": []}
-        if num_returns == -1:
-            import inspect as _inspect
-
-            if _inspect.isgenerator(result):
-                dynamic = []
-                for item in result:
-                    dynamic.extend(self._store_one_sync(self._dyn_oid(wire, len(dynamic)), item))
-                return {"dynamic": dynamic}
-            num_returns = 1
-        values = [result] if num_returns == 1 else list(result)
-        if num_returns != 1 and len(values) != num_returns:
-            raise ValueError(
-                f"task declared num_returns={num_returns} but returned {len(values)}"
+            reply = {"returns": []}
+        elif num_returns == -1 and inspect.isgenerator(result):
+            dynamic = []
+            for item in result:
+                dynamic.extend(
+                    self._store_one_sync(self._dyn_oid(wire, len(dynamic)), item)
+                )
+            reply = {"dynamic": dynamic}
+        else:
+            if num_returns == -1:
+                num_returns = 1
+            values = [result] if num_returns == 1 else list(result)
+            if num_returns != 1 and len(values) != num_returns:
+                raise ValueError(
+                    f"task declared num_returns={num_returns} but returned "
+                    f"{len(values)}"
+                )
+            out = []
+            for oid, value in zip(wire["return_ids"], values):
+                out.extend(self._store_one_sync(oid, value))
+            reply = {"returns": out}
+        if profile:
+            # Per-task phase spans (reference: worker profile events in the
+            # chrome timeline, RAY_PROFILING + profiling.py).
+            core.record_task_event(
+                wire["task_id"],
+                wire["name"],
+                "PROFILE",
+                start=t0,
+                phases={
+                    "deserialize_args": t_args - t0,
+                    "execute": t_exec - t_args,
+                    "store_returns": time.time() - t_exec,
+                },
             )
-        out = []
-        for oid, value in zip(wire["return_ids"], values):
-            out.extend(self._store_one_sync(oid, value))
-        return {"returns": out}
+        return reply
 
     def _store_one_sync(self, oid: str, value) -> list:
         serialized = serialization.serialize(value)
@@ -383,8 +405,11 @@ class Executor:
                     },
                     chdir=False,
                 )
+            profile = config.task_profile_events
+            t0 = time.time()
             fn = await self.get_function(wire["func_id"])
             args, kwargs = await self.load_args(wire)
+            t_args = time.time()
             from ray_tpu.runtime_env.context import scoped_env_vars
 
             with scoped_env_vars(renv.get("env_vars")):
@@ -443,8 +468,13 @@ class Executor:
                         {"task_id": wire["task_id"], "index": idx, "ret": ret[0]},
                     )
                     idx += 1
+                if profile:
+                    self._record_profile(wire, t0, t_args, t_args)
                 return {"dynamic_count": idx}
+            t_exec = time.time()
             returns = await self.store_returns(wire, result)
+            if profile:
+                self._record_profile(wire, t0, t_args, t_exec)
             return {"returns": returns}
         except asyncio.CancelledError:
             from ray_tpu._private.common import TaskCancelledError
@@ -455,6 +485,21 @@ class Executor:
             return {"error": self._error_payload(e)}
         finally:
             self.running_tasks.pop(task_id, None)
+
+    def _record_profile(self, wire: dict, t0: float, t_args: float, t_exec: float) -> None:
+        """One PROFILE task event with phase durations (reference:
+        RAY_PROFILING worker profile events)."""
+        self.core.record_task_event(
+            wire["task_id"],
+            wire.get("name", "task"),
+            "PROFILE",
+            start=t0,
+            phases={
+                "deserialize_args": t_args - t0,
+                "execute": t_exec - t_args,
+                "store_returns": time.time() - t_exec,
+            },
+        )
 
     @staticmethod
     def _dyn_oid(wire: dict, index: int) -> str:

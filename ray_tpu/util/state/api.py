@@ -57,6 +57,8 @@ def list_tasks(filters=None, limit: int = 10000, job_id: Optional[str] = None) -
     latest: Dict[str, dict] = {}
     first_ts: Dict[str, float] = {}
     for e in events:
+        if e.get("state") == "PROFILE":
+            continue  # phase timings, not a lifecycle state (worker clock)
         tid = e["task_id"]
         first_ts.setdefault(tid, e["time"])
         cur = latest.get(tid)
@@ -221,6 +223,26 @@ def timeline(filename: Optional[str] = None) -> List[dict]:
     out: List[dict] = []
     for e in sorted(events, key=lambda x: x["time"]):
         tid = e["task_id"]
+        if e["state"] == "PROFILE":
+            # Worker-side phase spans (deserialize/execute/store): one X
+            # event per phase, laid back-to-back from the recorded start
+            # (reference: profile events in ray.timeline).
+            ts = e.get("start", e["time"]) * 1e6
+            for phase, dur_s in (e.get("phases") or {}).items():
+                out.append(
+                    {
+                        "name": f"{e.get('name') or 'task'}::{phase}",
+                        "cat": "profile",
+                        "ph": "X",
+                        "ts": ts,
+                        "dur": max(0.0, dur_s * 1e6),
+                        "pid": e.get("node_id", "node"),
+                        "tid": e.get("worker_id", "worker"),
+                        "args": {"task_id": tid},
+                    }
+                )
+                ts += dur_s * 1e6
+            continue
         if e["state"] == "RUNNING":
             spans[tid] = e
         elif e["state"] in ("FINISHED", "FAILED") and tid in spans:

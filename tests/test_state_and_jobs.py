@@ -71,6 +71,30 @@ def test_timeline(ray_start_regular, tmp_path):
     assert json.loads(out.read_text())
 
 
+def test_timeline_profile_events(shutdown_only, monkeypatch):
+    """With profiling on, worker-side phase spans (deserialize/execute/
+    store) appear in the chrome timeline (reference: RAY_PROFILING)."""
+    monkeypatch.setenv("RAY_TPU_TASK_PROFILE_EVENTS", "1")
+    import ray_tpu
+    from ray_tpu.util.state import timeline
+
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+
+    @ray_tpu.remote
+    def work():
+        time.sleep(0.03)
+        return 1
+
+    ray_tpu.get([work.remote() for _ in range(2)])
+    time.sleep(1.5)
+    events = timeline()
+    phases = [e for e in events if e["cat"] == "profile"]
+    names = {e["name"] for e in phases}
+    assert "work::execute" in names, names
+    ex = [e for e in phases if e["name"] == "work::execute"]
+    assert all(e["dur"] >= 0.02 * 1e6 for e in ex)
+
+
 def test_job_submission(ray_start_regular):
     from ray_tpu.job import JobStatus, JobSubmissionClient
 

@@ -28,6 +28,7 @@ from ray_tpu.rllib.algorithms.impala import (
     IMPALAConfig,
 )
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.algorithms.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.core.learner import Learner
 from ray_tpu.rllib.core.learner_group import LearnerGroup
@@ -42,6 +43,8 @@ __all__ = [
     "AlgorithmConfig",
     "APPO",
     "APPOConfig",
+    "BC",
+    "BCConfig",
     "SAC",
     "SACConfig",
     "DQN",

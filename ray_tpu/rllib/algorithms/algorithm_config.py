@@ -32,6 +32,8 @@ class AlgorithmConfig:
         self.num_learners: int = 0
         self.num_cpus_per_learner: float = 1.0
         self.num_tpus_per_learner: float = 0.0
+        # offline_data()
+        self.offline_input = None
         # debugging()
         self.seed: int = 0
         # fault_tolerance()
@@ -62,6 +64,14 @@ class AlgorithmConfig:
             self.rollout_fragment_length = rollout_fragment_length
         if sample_timeout_s is not None:
             self.sample_timeout_s = sample_timeout_s
+        return self
+
+    def offline_data(self, *, input_=None):
+        """Offline training input (reference: AlgorithmConfig.offline_data):
+        a ray_tpu.data Dataset (or list of row dicts) of {obs, actions}
+        transitions consumed instead of env rollouts."""
+        if input_ is not None:
+            self.offline_input = input_
         return self
 
     def training(self, **kwargs):

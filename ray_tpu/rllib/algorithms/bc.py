@@ -1,0 +1,120 @@
+"""BC: behavior cloning from offline data.
+
+Analog of rllib/algorithms/bc/ (bc.py + the offline-data pipeline,
+offline/offline_data.py): supervised imitation of logged (obs, action)
+transitions from a ray_tpu.data Dataset — no environment interaction during
+training (the env is only used for action/observation spaces and optional
+evaluation rollouts).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.core.rl_module import RLModuleSpec, forward_pi_vf, init_pi_vf
+
+
+class BCConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=BC)
+        self.lr = 1e-3
+        self.train_batch_size = 256
+        self.updates_per_iteration = 32
+
+
+class BCLearner(Learner):
+    def init_params(self, rng):
+        return init_pi_vf(rng, self.spec)
+
+    def loss_fn(self, params, batch):
+        import jax
+        import jax.numpy as jnp
+
+        logits, _ = forward_pi_vf(params, batch["obs"])
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(logp, batch["actions"][:, None], axis=-1)[:, 0]
+        loss = jnp.mean(nll)
+        acc = jnp.mean(
+            (jnp.argmax(logits, axis=-1) == batch["actions"]).astype(jnp.float32)
+        )
+        return loss, {"bc_loss": loss, "action_accuracy": acc}
+
+
+class BC(Algorithm):
+    policy_kind = "pi_vf"
+
+    def __init__(self, config: AlgorithmConfig):
+        if config.offline_input is None:
+            raise ValueError(
+                "BC requires offline data: config.offline_data(input_=dataset)"
+            )
+        super().__init__(config)
+        self._rows = self._materialize(config.offline_input)
+        if not self._rows:
+            raise ValueError("offline dataset is empty")
+        self._obs = np.asarray(
+            [r["obs"] for r in self._rows], dtype=np.float32
+        ).reshape(len(self._rows), -1)
+        acts = np.asarray([r["actions"] for r in self._rows])
+        if not np.issubdtype(acts.dtype, np.integer):
+            if not np.allclose(acts, np.round(acts)):
+                raise ValueError(
+                    "BC requires discrete integer actions; got continuous "
+                    f"values (dtype {acts.dtype}) — this environment/dataset "
+                    "combination needs a continuous imitation learner"
+                )
+            acts = np.round(acts)
+        self._acts = acts.astype(np.int64)
+        if self._acts.min() < 0 or self._acts.max() >= self.num_actions:
+            raise ValueError(
+                f"offline actions outside [0, {self.num_actions}): "
+                f"min={self._acts.min()}, max={self._acts.max()} — dataset "
+                "logged from a different action space?"
+            )
+        self._rng = np.random.RandomState(config.seed)
+
+    @staticmethod
+    def _materialize(input_) -> List[dict]:
+        if hasattr(input_, "take_all"):  # ray_tpu.data Dataset
+            return input_.take_all()
+        return list(input_)
+
+    def _learner_builder(self, obs_dim: int, num_actions: int) -> Callable[[], Any]:
+        cfg = self.config
+        spec = RLModuleSpec(
+            obs_dim=obs_dim,
+            num_actions=num_actions,
+            hidden=tuple(cfg.model.get("hidden", (64, 64))),
+        )
+        lr, grad_clip, seed = cfg.lr, cfg.grad_clip, cfg.seed
+
+        def build():
+            return BCLearner(spec, lr=lr, grad_clip=grad_clip, seed=seed)
+
+        return build
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        metrics: Dict[str, float] = {}
+        for _ in range(cfg.updates_per_iteration):
+            idx = self._rng.randint(0, len(self._obs), size=cfg.train_batch_size)
+            # Public group API: a plain supervised batch shards across
+            # remote learners (grad averaging) or runs locally.
+            metrics = self.learner_group.update_from_batch(
+                {"obs": self._obs[idx], "actions": self._acts[idx]}
+            )
+        self._sync_weights()
+        return {
+            **{k: float(v) for k, v in metrics.items()},
+            "num_offline_rows": len(self._rows),
+        }
+
+    def evaluate(self, num_steps: int = 256) -> Dict[str, Any]:
+        """Greedy evaluation rollout against the configured env."""
+        batches = self.env_runner_group.sample(num_steps)
+        return self._episode_metrics(batches)

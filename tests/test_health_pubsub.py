@@ -33,15 +33,28 @@ def test_wedged_node_detected_by_health_checks(monkeypatch):
 
         wedged.server._handlers["Ping"] = hang
 
+        # Watch for the death EVENT: after being marked DEAD the GCS drops
+        # the link and the (still-connected but wedged) raylet re-registers,
+        # so polling instantaneous state can miss the DEAD window.
+        w = worker_mod.global_worker
+        removed = []
+
+        async def subscribe():
+            core = w.core
+            await core.gcs.subscribe(
+                "nodes",
+                lambda msg: removed.append(msg["node"]["node_id"])
+                if msg.get("event") == "removed"
+                else None,
+            )
+
+        w.run_async(subscribe(), timeout=30)
         deadline = time.monotonic() + 30
-        dead = False
-        while time.monotonic() < deadline:
-            states = {n["node_id"]: n["state"] for n in ray_tpu.nodes()}
-            if states.get(wedged.node_id) == "DEAD":
-                dead = True
-                break
+        while time.monotonic() < deadline and wedged.node_id not in removed:
             time.sleep(0.25)
-        assert dead, "wedged raylet was never marked DEAD by health checks"
+        assert wedged.node_id in removed, (
+            "wedged raylet was never marked DEAD by health checks"
+        )
     finally:
         cluster.shutdown()
 

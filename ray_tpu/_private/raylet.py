@@ -1530,7 +1530,21 @@ class Raylet:
             del self.push_assembly[p["oid"]]
             return
         data = p["data"]
-        base = st["offset"] + p["offset"]
+        off = p["offset"]
+        if off != st["recv"] or off + len(data) > st["size"]:
+            # Out-of-order, duplicated, or over-long chunk. Writing it would
+            # either punch a hole (sealing on byte count would then expose
+            # uninitialized shm) or run past the span into a neighboring
+            # object. The source sends strictly in order, so any deviation
+            # means a corrupt/stale stream: abort the whole assembly and let
+            # the next pull re-transfer from scratch.
+            logger.warning(
+                "aborting push assembly of %s: chunk offset %d (expected %d, size %d)",
+                p["oid"][:12], off, st["recv"], st["size"],
+            )
+            self._abort_push_assembly(p["oid"])
+            return
+        base = st["offset"] + off
         self.arena.view[base : base + len(data)] = data
         st["recv"] += len(data)
         st["last"] = time.monotonic()

@@ -162,8 +162,14 @@ class Autoscaler:
 
     @staticmethod
     def _covers(spec: dict, demand: Dict[str, int], unit: int) -> bool:
+        """True when ONE host of this type could grant a lease with this
+        demand shape. Every lease is granted by a single raylet — a gang
+        workload expresses slice-wide placement through the TPU-{pod}-head
+        resource plus *per-host* chip counts on each member lease — so
+        per-host resources are never scaled by slice size. Scaling (the old
+        behavior) judged e.g. TPU:8 coverable by 4-chip hosts and churned
+        slice launches that could never grant the lease."""
         have = spec.get("resources", {})
-        slice_n = int(spec.get("workers_per_slice", 1))
         for r, units in demand.items():
             if r.startswith("node:"):
                 continue
@@ -174,8 +180,7 @@ class Autoscaler:
                 if spec.get("tpu_pod_slice") == pod or f"TPU-{pod}-head" in have:
                     continue
                 return False
-            scale = slice_n if r == "TPU" else 1
-            if have.get(r, 0.0) * scale * unit < units:
+            if have.get(r, 0.0) * unit < units:
                 return False
         return True
 

@@ -85,7 +85,9 @@ def cmd_start(args) -> None:
             from ray_tpu.util.client.server import ClientServer
 
             client_srv = ClientServer(
-                node.gcs_addr, port=args.client_server_port
+                node.gcs_addr,
+                host=args.client_server_host,
+                port=args.client_server_port,
             )
             chost, cport = await client_srv.start()
         _write_state(address, dash_addr)
@@ -98,6 +100,11 @@ def cmd_start(args) -> None:
                 "remote drivers: "
                 f"ray_tpu.init(address='ray-tpu://{chost}:{cport}')"
             )
+            if chost in ("127.0.0.1", "localhost"):
+                print(
+                    "  (bound to loopback; pass --client-server-host 0.0.0.0 "
+                    "and firewall the port to accept off-host drivers)"
+                )
         stop_event = asyncio.Event()
         loop = asyncio.get_running_loop()
         for sig in (signal.SIGINT, signal.SIGTERM):
@@ -239,6 +246,11 @@ def build_parser() -> argparse.ArgumentParser:
     # Remote-driver proxy (reference: Ray Client, default port 10001).
     # 0 = ephemeral port, negative = disabled.
     sp.add_argument("--client-server-port", type=int, default=10001)
+    # The client protocol executes pickled code with no authentication, so
+    # bind loopback by default; exposing it (0.0.0.0) is an explicit opt-in
+    # and the port must then be firewalled (matches reference Ray Client
+    # guidance).
+    sp.add_argument("--client-server-host", default="127.0.0.1")
     sp.set_defaults(fn=cmd_start)
 
     sp = sub.add_parser("stop", help="stop the head started on this machine")

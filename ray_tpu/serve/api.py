@@ -26,6 +26,10 @@ from ray_tpu.serve.schema import AutoscalingConfig, DeploymentConfig, HTTPOption
 
 _controller_handle = None
 
+# Per-class no-op-__del__ subclasses used by @multiplexed eviction; cached so
+# repeated evictions of the same model class reuse one type object.
+_neutered_classes: Dict[type, type] = {}
+
 
 @dataclass
 class Application:
@@ -341,6 +345,30 @@ def multiplexed(func=None, *, max_num_models_per_replica: int = 3):
                         try:
                             del_fn()
                         except Exception:
+                            pass
+                        # Neutralize so GC doesn't run the destructor a
+                        # second time (double resource release — reference:
+                        # serve/multiplex.py:245-252 replaces __del__ after
+                        # the explicit call; it uses an instance setattr,
+                        # which CPython ignores for dunders, so swap in a
+                        # per-instance subclass with a no-op __del__).
+                        try:
+                            cls = type(evicted)
+                            neutered = _neutered_classes.get(cls)
+                            if neutered is None:
+                                neutered = type(
+                                    cls.__name__,
+                                    (cls,),
+                                    {"__del__": lambda _s: None,
+                                     "__qualname__": cls.__qualname__,
+                                     "__module__": cls.__module__},
+                                )
+                                _neutered_classes[cls] = neutered
+                            evicted.__class__ = neutered
+                        except Exception:
+                            # __slots__/extension types (TypeError) or a
+                            # model class's __init_subclass__ hook rejecting
+                            # the subclass: accept the destructor rerun.
                             pass
                 return model
 

@@ -70,7 +70,7 @@ class ClientServer:
     ``ClientServer(gcs_addr).start(port)``; clients connect with
     ``ray_tpu.init(address="ray-tpu://host:port")``."""
 
-    def __init__(self, gcs_addr: Tuple[str, int], host: str = "0.0.0.0", port: int = 0):
+    def __init__(self, gcs_addr: Tuple[str, int], host: str = "127.0.0.1", port: int = 0):
         self.gcs_addr = tuple(gcs_addr)
         self.server = rpc.Server(host, port)
         self.sessions: Dict[int, Session] = {}
@@ -359,7 +359,7 @@ class ClientServer:
         return {"nodes": reply["nodes"]}
 
 
-async def serve(gcs_addr, host: str = "0.0.0.0", port: int = 10001) -> ClientServer:
+async def serve(gcs_addr, host: str = "127.0.0.1", port: int = 10001) -> ClientServer:
     srv = ClientServer(gcs_addr, host, port)
     await srv.start()
     return srv

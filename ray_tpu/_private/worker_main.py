@@ -566,6 +566,20 @@ class Executor:
             )
         if max_c > 1 and not cgroups:
             self.pool = concurrent.futures.ThreadPoolExecutor(max_workers=max_c)
+        # Run the constructor in the background and reply to the raylet NOW.
+        # The raylet's lease grant (and through it the GCS actor scheduler)
+        # must not block on user __init__: constructors may legitimately
+        # rendezvous with actors that haven't been placed yet (collective
+        # group bootstrap), and serializing placement behind them deadlocks.
+        # Readiness/failure flows to the GCS via ReportActorReady, which is
+        # what gates task submission (reference: GcsActorScheduler pushes the
+        # creation task asynchronously and tracks readiness separately).
+        self._creation_task = asyncio.get_running_loop().create_task(
+            self._run_actor_creation(wire)
+        )
+        return {"ok": True}
+
+    async def _run_actor_creation(self, wire) -> None:
         try:
             if wire.get("runtime_env"):
                 # Actors own their process: permanent application (env vars,
@@ -585,26 +599,44 @@ class Executor:
                     type(self.actor_instance), callable
                 )
             )
-            await self.core.gcs.call(
-                "ReportActorReady",
+            await self._report_actor_ready(
                 {
                     "actor_id": wire["actor_id"],
                     "addr": list(self.core.addr),
                     "worker_id": self.core.worker_id,
                     "node_id": self.core.node_id,
-                },
+                }
             )
-            return {"ok": True}
         except BaseException as e:
             logger.exception("actor creation failed")
-            await self.core.gcs.call(
-                "ReportActorReady",
+            await self._report_actor_ready(
                 {
                     "actor_id": wire["actor_id"],
                     "error": f"{type(e).__name__}: {e}\n{traceback.format_exc()}",
-                },
+                }
             )
-            return {"ok": False}
+
+    async def _report_actor_ready(self, payload: dict) -> None:
+        """Deliver the readiness/failure report, retrying through GCS blips.
+        This is the ONLY signal that moves the actor out of PENDING_CREATION
+        (the creation task is otherwise unobserved), so if it cannot be
+        delivered the worker exits: the raylet's worker-death report then
+        fails/restarts the actor instead of leaving callers blocked forever."""
+        for attempt in range(5):
+            try:
+                await self.core.gcs.call("ReportActorReady", payload)
+                return
+            except Exception:
+                logger.exception(
+                    "ReportActorReady attempt %d/5 failed", attempt + 1
+                )
+                await asyncio.sleep(min(2.0**attempt, 10.0))
+        logger.error(
+            "could not report actor %s readiness; exiting so the raylet "
+            "surfaces the failure",
+            payload.get("actor_id", "?")[:8],
+        )
+        os._exit(1)
 
     async def handle_push_actor_task(self, conn, p):
         wire = p["spec"]

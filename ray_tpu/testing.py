@@ -20,6 +20,9 @@ def cpu_mesh_worker_env(num_devices: int = 8) -> Dict[str, str]:
         "PALLAS_AXON_POOL_IPS": "",  # falsy -> TPU plugin registration skipped
         "JAX_PLATFORMS": "cpu",
         "XLA_FLAGS": f"--xla_force_host_platform_device_count={num_devices}",
+        # The force flag is ignored when jax.distributed initializes the
+        # multi-process CPU client; this knob covers that path too.
+        "JAX_NUM_CPU_DEVICES": str(num_devices),
     }
 
 

@@ -84,8 +84,11 @@ class TrainWorker:
         """Execute the train loop; returns a traceback string on failure."""
         assert self.session is not None, "setup_session must run first"
         s = self.session
-        fn = cloudpickle.loads(fn_blob)
         try:
+            # Deserialize inside the guard: an unloadable blob (missing
+            # module, version skew) must still set `finished`, or the
+            # driver's poll loop waits forever for a rank that never ran.
+            fn = cloudpickle.loads(fn_blob)
             if s.loop_config is not None and _takes_config(fn):
                 fn(s.loop_config)
             else:

@@ -388,3 +388,10 @@ def get_rank(group_name: str = "default") -> int:
 
 def get_collective_group_size(group_name: str = "default") -> int:
     return _manager.get(group_name).world_size
+
+
+def get_group_mesh(group_name: str = "default"):
+    """The xla group's global jax.sharding.Mesh (axes ("world", "local")).
+    None on the store backend — the group there is a rendezvous actor, not a
+    device mesh."""
+    return _manager.get(group_name).mesh

@@ -1,26 +1,39 @@
-"""Flagship benchmark: ResNet-50 training on one TPU chip.
+"""Flagship benchmark: ResNet-50 + GPT-2 transformer training on one TPU chip.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N,
-   "mfu": ..., "e2e_images_per_sec": ..., ...}
+   "mfu": ..., "e2e_images_per_sec": ..., "transformer_tokens_per_sec": ...}
 
-Two phases, each in its own subprocess (the axon TPU tunnel admits one
+Three phases, each in its own subprocess (the axon TPU tunnel admits one
 process at a time, and the e2e phase needs the chip free for its train
 worker):
 
-1. **step** — raw jitted train-step throughput (synthetic resident data),
-   reporting MFU. FLOPs come from XLA's own compiled cost analysis
-   (multiply-add = 2 flops — the same convention as the chip's quoted peak),
-   peak from a device-kind table. ResNet-50/b128/bf16 on v5e is
-   HBM-bandwidth-bound (~0.4 GB moved per image -> ~51 GB per 128-image
-   step vs 819 GB/s peak), so MFU plateaus near 30% — the bytes, not the
-   MXU, are the wall.
-2. **e2e** — ingest -> train through the framework, mirroring the measured
+1. **step** — raw jitted ResNet train-step throughput (synthetic resident
+   data). MFU uses XLA's compiled cost analysis (multiply-add = 2 flops,
+   the same convention as the chip's quoted peak). Measured: ~30% MFU at
+   batch 128. NOTE on why: XLA's "bytes accessed" (51 GB/step) is an
+   upper bound that counts every buffer touch, not post-fusion HBM
+   traffic, so it cannot be used for a roofline bound (it would imply
+   <=2048 img/s, below what we measure). The honest statement is the
+   measurement itself: ~30% MFU, consistent with public ResNet-on-TPU
+   results where small convolution shapes underfill the MXU.
+2. **transformer** — the flagship decoder transformer (models/transformer.py)
+   at GPT-2-small scale (124M params, vocab 50304, seq 1024, batch 32,
+   remat): one jitted train step, MFU computed from ANALYTIC useful flops
+   (6ND + attention term, the PaLM/scaling-book convention — XLA cost
+   analysis cannot see through pallas kernels). The pallas flash backward
+   + chunked LM-head CE are what make batch 32 fit and the step MXU-bound.
+3. **e2e** — ingest -> train through the framework, mirroring the measured
    reference workload (doc/source/train/benchmarks.rst:36: Train ResNet e2e
    with Ray Data ingest, 40.7 images/s on one GPU worker): a
-   ray_tpu.data pipeline (parallel synth-decode tasks -> shm object store ->
-   streaming_split) feeds a 1-worker JaxTrainer that runs the same train
-   step per batch.
+   ray_tpu.data pipeline (parallel synth-decode tasks -> columnar tensor
+   blocks in the shm object store -> streaming_split) feeds a 1-worker
+   JaxTrainer that runs the same train step per batch. Timed window covers
+   the whole warm pipeline (execution + iteration + h2d + step), excluding
+   only process bring-up and jit compilation. On this CI host the bound is
+   the single CPU core (decode tasks, serialization, tunnel h2d, and the
+   driver all share it); the data plane itself sustains ~1.2k img/s warm
+   ingest-only and ~90k img/s iteration over materialized blocks.
 
 Baseline: the reference's headline Train-ResNet e2e number, 40.7 images/s
 (BASELINE.md). vs_baseline compares the matching e2e phase.
@@ -127,6 +140,68 @@ def phase_step() -> dict:
     }
 
 
+def phase_transformer() -> dict:
+    """Flagship decoder-transformer train step at GPT-2-small scale."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ray_tpu.models import TransformerConfig, make_train_step
+    from ray_tpu.models.transformer import flops_per_token
+    from ray_tpu.parallel import make_mesh
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if on_tpu:
+        cfg = TransformerConfig(
+            vocab_size=50304, d_model=768, n_layers=12, n_heads=12,
+            max_seq_len=1024, dtype=jnp.bfloat16, remat=True,
+        )
+        B, S, steps = 32, 1024, 40
+    else:  # probe/CI shapes
+        cfg = TransformerConfig(
+            vocab_size=512, d_model=128, n_layers=2, n_heads=4,
+            max_seq_len=128, dtype=jnp.float32,
+        )
+        B, S, steps = 4, 64, 3
+
+    mesh = make_mesh({"data": 1}, devices=[dev])
+    init_state, step, shardings = make_train_step(cfg, mesh, optax.adamw(1e-3))
+    state = init_state(jax.random.PRNGKey(0))
+    raw = jax.random.randint(
+        jax.random.PRNGKey(1), (B, S + 1), 0, cfg.vocab_size
+    )
+    batch = {
+        "tokens": jax.device_put(raw[:, :-1], shardings["tokens"]),
+        "targets": jax.device_put(raw[:, 1:], shardings["tokens"]),
+    }
+    state, m = step(state, batch)  # compile
+    jax.block_until_ready(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = step(state, batch)
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = B * S * steps / dt
+    n_params = sum(
+        int(np.prod(x.shape))
+        for x in jax.tree_util.tree_leaves(state["params"])
+    )
+    useful = flops_per_token(cfg, S)
+    peak = _peak_for(dev.device_kind)
+    return {
+        "transformer_tokens_per_sec": round(tokens_per_sec, 0),
+        "transformer_mfu": round(useful * tokens_per_sec / peak, 4),
+        "transformer_params_m": round(n_params / 1e6, 1),
+        "transformer_batch": B,
+        "transformer_seq": S,
+    }
+
+
 def phase_e2e() -> dict:
     """Ingest -> train e2e: ray_tpu.data pipeline feeding a JaxTrainer."""
     import numpy as np
@@ -138,25 +213,26 @@ def phase_e2e() -> dict:
     from ray_tpu.train.jax import JaxTrainer
 
     probe = os.environ.get("RAY_TPU_BENCH_PROBE") == "1"
-    n_blocks = 4 if probe else 16
-    rows_per_block = 16 if probe else 128
+    n_blocks = 4 if probe else 8
+    rows_per_block = 16 if probe else 256
     size = 64 if probe else 224
-    batch = 8 if probe else 128
+    batch = 8 if probe else 256
 
-    def synth_block(row) -> list:
-        # Stands in for read+decode: produces raw uint8 image rows. One
-        # vectorized draw per block — the pipeline should be measuring the
-        # framework's data plane, not numpy's per-row RNG overhead.
-        seed = int(row["id"]) if isinstance(row, dict) else int(row)
+    def synth_batch(batch) -> dict:
+        # Stands in for read+decode: produces raw uint8 image rows as ONE
+        # columnar block — the (N, H*W*C) image array becomes a contiguous
+        # Arrow tensor column that moves through the object store as a
+        # single zero-copy buffer (no per-row bytes objects anywhere).
+        seed = int(np.asarray(batch["id"]).reshape(-1)[0])
         rng = np.random.default_rng(seed)
-        block = rng.integers(
-            0, 255, (rows_per_block, size * size * 3), dtype=np.uint8
-        )
-        labels = rng.integers(0, 1000, rows_per_block)
-        return [
-            {"image": block[i].tobytes(), "label": int(labels[i])}
-            for i in range(rows_per_block)
-        ]
+        # rng.bytes is the cheapest generator that still writes every byte
+        # (the decode stand-in must produce real per-image data, not a view
+        # of one shared buffer).
+        images = np.frombuffer(
+            rng.bytes(rows_per_block * size * size * 3), dtype=np.uint8
+        ).reshape(rows_per_block, size * size * 3)
+        labels = rng.integers(0, 1000, rows_per_block).astype(np.int64)
+        return {"image": images, "label": labels}
 
     def train_fn(config):
         import time
@@ -190,22 +266,29 @@ def phase_e2e() -> dict:
             params = optax.apply_updates(new_params, updates)
             return params, opt, loss
 
+        # Compile outside the timed window with a synthetic batch, so the
+        # measurement covers the FULL pipeline — execution (decode tasks ->
+        # shm blocks), iteration, h2d transfer, and the train step — but not
+        # one-time jit compilation.
+        warm = np.zeros((batch, size, size, 3), dtype=np.uint8)
+        warm_labels = np.zeros((batch,), dtype=np.int32)
+        params, opt, loss = step(params, opt, jnp.asarray(warm), jnp.asarray(warm_labels))
+        jax.block_until_ready(loss)
+
         shard = train.get_dataset_shard("train")
         n = 0
-        t0 = None
-        for raw in shard.iter_batches(batch_size=batch, batch_format="numpy"):
-            imgs = np.stack(
-                [np.frombuffer(b, dtype=np.uint8) for b in raw["image"]]
-            ).reshape(-1, size, size, 3)
+        t0 = time.perf_counter()
+        for raw in shard.iter_batches(
+            batch_size=batch, batch_format="numpy", prefetch_batches=2
+        ):
+            # Tensor column -> (B, H*W*C) uint8 view; reshape is free and
+            # jax's async dispatch overlaps the host->device copy of batch
+            # k+1 with the device compute of batch k.
+            imgs = np.asarray(raw["image"]).reshape(-1, size, size, 3)
             labels = np.asarray(raw["label"], dtype=np.int32)
             params, opt, loss = step(params, opt, jnp.asarray(imgs), jnp.asarray(labels))
-            if t0 is None:
-                # Start the clock after the first step (compile time out).
-                jax.block_until_ready(loss)
-                t0 = time.perf_counter()
-                continue
             n += len(imgs)
-        if t0 is None:
+        if n == 0:
             raise RuntimeError("dataset shard yielded no batches")
         jax.block_until_ready(loss)
         dt = time.perf_counter() - t0
@@ -213,7 +296,19 @@ def phase_e2e() -> dict:
 
     ray_tpu.init(num_cpus=4, num_tpus=0)
     try:
-        ds = rd.range(n_blocks, parallelism=4).flat_map(synth_block)
+        # Warm the worker pool (spawn + import cost) with a throwaway
+        # pipeline so the measured window is steady-state ingest, not
+        # process bring-up — the reference's e2e methodology also measures
+        # warm epochs (doc/source/train/benchmarks.rst: multi-epoch runs).
+        warm = rd.range(4, parallelism=4).map_batches(
+            lambda b: {"x": np.zeros((2, 8), dtype=np.uint8)}, batch_size=1
+        )
+        for _ in warm.iter_batches(batch_size=None):
+            pass
+
+        ds = rd.range(n_blocks, parallelism=n_blocks).map_batches(
+            synth_batch, batch_size=1
+        )
         result = JaxTrainer(
             train_fn,
             train_loop_config={"size": size, "batch": batch},
@@ -248,11 +343,19 @@ def main():
     if "--phase" in sys.argv:
         idx = sys.argv.index("--phase")
         phase = sys.argv[idx + 1] if idx + 1 < len(sys.argv) else ""
-        if phase not in ("step", "e2e"):
-            raise SystemExit(f"unknown --phase {phase!r}; expected 'step' or 'e2e'")
-        print(json.dumps(phase_step() if phase == "step" else phase_e2e()))
+        phases = {"step": phase_step, "e2e": phase_e2e,
+                  "transformer": phase_transformer}
+        if phase not in phases:
+            raise SystemExit(
+                f"unknown --phase {phase!r}; expected one of {sorted(phases)}"
+            )
+        print(json.dumps(phases[phase]()))
         return
     step = _run_phase("step")
+    try:
+        tf = _run_phase("transformer")
+    except Exception as e:
+        tf = {"transformer_tokens_per_sec": 0.0, "transformer_error": str(e)[:500]}
     try:
         e2e = _run_phase("e2e")
     except Exception as e:  # e2e must not mask the headline number
@@ -267,6 +370,7 @@ def main():
             (e2e.get("e2e_images_per_sec") or 0.0) / BASELINE_IMAGES_PER_SEC, 2
         ),
         **{k: v for k, v in step.items() if k != "step_images_per_sec"},
+        **tf,
         **e2e,
     }
     print(json.dumps(out))

@@ -10,6 +10,12 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence
 import numpy as np
 import pyarrow as pa
 
+from ray_tpu.data.tensor_extension import (
+    ArrowTensorArray,
+    is_tensor_type,
+    tensor_column_to_numpy,
+)
+
 # A batch/table column name used when the data is just values, not mappings
 # (reference: ray.data uses __value__ the same way via TENSOR_COLUMN_NAME).
 VALUE_COL = "__value__"
@@ -32,8 +38,22 @@ def rows_to_block(rows: Sequence[Any]) -> pa.Table:
 
 def _to_arrow_array(values: List[Any]):
     if values and isinstance(values[0], np.ndarray):
-        flat = [np.asarray(v) for v in values]
-        return pa.array([v.tolist() for v in flat])
+        first = values[0]
+        if (
+            first.dtype != object
+            and first.ndim >= 1
+            and all(
+                isinstance(v, np.ndarray)
+                and v.shape == first.shape
+                and v.dtype == first.dtype
+                for v in values
+            )
+        ):
+            # Uniform ndarray rows -> ONE contiguous tensor column
+            # (zero-copy through serialization and back to numpy), not
+            # per-row Arrow lists.
+            return ArrowTensorArray.from_numpy(np.stack(values))
+        return pa.array([np.asarray(v).tolist() for v in values])
     try:
         return pa.array(values)
     except (pa.ArrowInvalid, pa.ArrowTypeError, pa.ArrowNotImplementedError):
@@ -44,7 +64,14 @@ def _to_arrow_array(values: List[Any]):
 
 def block_to_rows(block: pa.Table) -> List[Any]:
     cols = block.column_names
-    pydict = block.to_pydict()
+    pydict = {}
+    for c in cols:
+        col = block.column(c)
+        if is_tensor_type(col.type):
+            stacked = tensor_column_to_numpy(col)
+            pydict[c] = [stacked[i] for i in range(len(stacked))]
+        else:
+            pydict[c] = col.to_pylist()
     if cols == [VALUE_COL]:
         return pydict[VALUE_COL]
     return [dict(zip(cols, vals)) for vals in zip(*(pydict[c] for c in cols))]
@@ -61,6 +88,9 @@ def block_to_batch(block: pa.Table, batch_format: str = "numpy"):
         out = {}
         for name in block.column_names:
             col = block.column(name)
+            if is_tensor_type(col.type):
+                out[name] = tensor_column_to_numpy(col)
+                continue
             try:
                 out[name] = col.to_numpy(zero_copy_only=False)
             except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
@@ -74,7 +104,14 @@ def batch_to_block(batch: Any) -> pa.Table:
     if isinstance(batch, pa.Table):
         return batch
     if isinstance(batch, dict):
-        return pa.table({k: _to_arrow_array(_as_list(v)) for k, v in batch.items()})
+        cols = {}
+        for k, v in batch.items():
+            if isinstance(v, np.ndarray) and v.ndim >= 2 and v.dtype != object:
+                # Columnar fast path: a stacked array IS the tensor column.
+                cols[k] = ArrowTensorArray.from_numpy(v)
+            else:
+                cols[k] = _to_arrow_array(_as_list(v))
+        return pa.table(cols)
     try:
         import pandas as pd
 
@@ -100,14 +137,38 @@ def empty_block() -> pa.Table:
     return pa.table({})
 
 
+def _detensorize(block: pa.Table) -> pa.Table:
+    """Replace tensor-extension columns with plain list<...> arrays (used
+    when blocks with mismatched tensor shapes/encodings must concatenate)."""
+    cols = {}
+    changed = False
+    for name in block.column_names:
+        col = block.column(name)
+        if is_tensor_type(col.type):
+            stacked = tensor_column_to_numpy(col)
+            cols[name] = pa.array([row.tolist() for row in stacked])
+            changed = True
+        else:
+            cols[name] = col
+    return pa.table(cols) if changed else block
+
+
 def concat_blocks(blocks: List[pa.Table]) -> pa.Table:
     blocks = [b for b in blocks if b.num_rows > 0]
     if not blocks:
         return empty_block()
     # Unify trivially-divergent schemas (e.g. int vs float) via promote.
+    # ArrowTypeError subclasses TypeError, so it must be caught first —
+    # it signals genuinely incompatible columns (e.g. one block's rows were
+    # uniform ndarrays -> tensor column, another's were ragged -> list
+    # column, or two tensor columns with different element shapes); those
+    # concatenate after downgrading tensor columns to plain lists.
     try:
         return pa.concat_tables(blocks, promote_options="permissive")
-    except TypeError:  # older pyarrow
+    except pa.ArrowTypeError:
+        blocks = [_detensorize(b) for b in blocks]
+        return pa.concat_tables(blocks, promote_options="permissive")
+    except TypeError:  # older pyarrow signature
         return pa.concat_tables(blocks, promote=True)
 
 

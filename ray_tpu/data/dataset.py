@@ -214,10 +214,14 @@ class Dataset:
         batch_size: Optional[int] = 256,
         batch_format: str = "numpy",
         drop_last: bool = False,
+        prefetch_batches: int = 1,
     ) -> Iterator[Any]:
-        yield from batches_from_blocks(
+        from ray_tpu.data.iterator import prefetch_iterator
+
+        it = batches_from_blocks(
             self.iter_blocks(), batch_size, batch_format, drop_last
         )
+        yield from prefetch_iterator(it, prefetch_batches)
 
     def to_pandas(self):
         return B.concat_blocks(list(self.iter_blocks())).to_pandas()

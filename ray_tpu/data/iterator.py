@@ -44,6 +44,66 @@ def batches_from_blocks(
         yield B.block_to_batch(B.concat_blocks(buf), batch_format)
 
 
+def prefetch_iterator(it: Iterator[Any], n: int) -> Iterator[Any]:
+    """Run `it` in a background thread, keeping up to `n` items ready.
+    Overlaps batch assembly (block fetch + slice + format conversion) with
+    the consumer's compute — the reference's prefetch_batches semantics
+    (python/ray/data/iterator.py iter_batches)."""
+    if n <= 0:
+        yield from it
+        return
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=n)
+    _END = object()
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        # Bounded put that gives up when the consumer abandoned the
+        # iterator — otherwise the fill thread would block on a full queue
+        # forever, pinning the buffered batches and the upstream iterator.
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def fill():
+        try:
+            for item in it:
+                if not _put(item):
+                    break
+            else:
+                _put(_END)
+        except BaseException as e:  # surfaced on the consumer side
+            _put(e)
+        finally:
+            if stop.is_set():
+                # Run upstream generators' finally-blocks promptly.
+                close = getattr(it, "close", None)
+                if close is not None:
+                    try:
+                        close()
+                    except Exception:
+                        pass
+
+    t = threading.Thread(target=fill, daemon=True, name="batch-prefetch")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+
+
 class _SplitCoordinator:
     """Actor owning one dataset execution, serving blocks to N splits.
 
@@ -76,7 +136,8 @@ class _SplitCoordinator:
         self.positions[split_idx] = 0
 
     def next_block(self, split_idx: int):
-        """Next block (as a table) for this split, or None when exhausted."""
+        """Next block (as a table) for this split, or None when exhausted.
+        Kept for compatibility; split_refs is the fast path."""
         self._ensure()
         pos = self.positions.get(split_idx, 0)
         idx = pos * self.n + split_idx
@@ -84,6 +145,15 @@ class _SplitCoordinator:
             return None
         self.positions[split_idx] = pos + 1
         return ray_tpu.get(self.refs[idx])
+
+    def split_refs(self, split_idx: int) -> List[Any]:
+        """This split's block refs (round-robin assignment). The consumer
+        fetches blocks straight from the object store — the data plane never
+        flows through this actor (the old per-block next_block path
+        re-serialized every block through the actor reply: two copies plus
+        an actor round-trip per block)."""
+        self._ensure()
+        return self.refs[split_idx :: self.n]
 
 
 class DataIterator:
@@ -95,12 +165,11 @@ class DataIterator:
         self._idx = split_idx
 
     def _blocks(self) -> Iterator[pa.Table]:
-        ray_tpu.get(self._coord.start_epoch.remote(self._idx))
-        while True:
-            blk = ray_tpu.get(self._coord.next_block.remote(self._idx))
-            if blk is None:
-                return
-            yield blk
+        refs = ray_tpu.get(self._coord.split_refs.remote(self._idx))
+        for ref in refs:
+            # Direct object-store fetch: zero-copy shm view for local
+            # blocks, chunked pull for remote ones.
+            yield ray_tpu.get(ref)
 
     def iter_batches(
         self,
@@ -108,10 +177,12 @@ class DataIterator:
         batch_size: Optional[int] = 256,
         batch_format: str = "numpy",
         drop_last: bool = False,
+        prefetch_batches: int = 1,
     ) -> Iterator[Any]:
-        yield from batches_from_blocks(
+        it = batches_from_blocks(
             self._blocks(), batch_size, batch_format, drop_last
         )
+        yield from prefetch_iterator(it, prefetch_batches)
 
     def iter_rows(self) -> Iterator[Any]:
         for blk in self._blocks():

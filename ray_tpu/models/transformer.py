@@ -27,7 +27,11 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ray_tpu.ops.flash_attention import mha
-from ray_tpu.ops.fused import fused_rmsnorm, softmax_cross_entropy
+from ray_tpu.ops.fused import (
+    fused_rmsnorm,
+    lm_head_cross_entropy,
+    softmax_cross_entropy,
+)
 from ray_tpu.parallel import mesh as mesh_lib
 from ray_tpu.parallel.ring_attention import ring_attention
 
@@ -188,10 +192,10 @@ def _block(x, blk, positions, cfg: TransformerConfig,
     return x
 
 
-def transformer_apply(params, tokens, cfg: TransformerConfig,
-                      positions=None, seq_axis: Optional[str] = None,
-                      seq_size: int = 1):
-    """Forward: [B, T] int32 tokens -> [B, T, vocab] logits (f32).
+def transformer_hidden(params, tokens, cfg: TransformerConfig,
+                       positions=None, seq_axis: Optional[str] = None,
+                       seq_size: int = 1):
+    """Forward through the blocks: [B, T] tokens -> [B, T, d] normed hidden.
 
     When called under shard_map with the sequence sharded, pass seq_axis and
     positions holding GLOBAL positions so RoPE and causal masks are correct.
@@ -209,21 +213,36 @@ def transformer_apply(params, tokens, cfg: TransformerConfig,
         return blk_fn(x, blk, positions), None
 
     x, _ = jax.lax.scan(scan_body, x, params["blocks"])
-    x = fused_rmsnorm(x, params["final_norm"], eps=cfg.norm_eps)
-    unembed = (
-        params["embed"].T if cfg.tied_embeddings else params["unembed"]
+    return fused_rmsnorm(x, params["final_norm"], eps=cfg.norm_eps)
+
+
+def _unembed(params, cfg: TransformerConfig):
+    return params["embed"].T if cfg.tied_embeddings else params["unembed"]
+
+
+def transformer_apply(params, tokens, cfg: TransformerConfig,
+                      positions=None, seq_axis: Optional[str] = None,
+                      seq_size: int = 1):
+    """Forward: [B, T] int32 tokens -> [B, T, vocab] logits (f32)."""
+    x = transformer_hidden(
+        params, tokens, cfg, positions=positions, seq_axis=seq_axis,
+        seq_size=seq_size,
     )
-    return (x @ unembed.astype(cfg.dtype)).astype(jnp.float32)
+    return (x @ _unembed(params, cfg).astype(cfg.dtype)).astype(jnp.float32)
 
 
 def transformer_loss(params, batch, cfg: TransformerConfig, **kw):
-    """Next-token CE. batch: {'tokens': [B, T+1] or ('tokens','targets')}."""
+    """Next-token CE. batch: {'tokens': [B, T+1] or ('tokens','targets')}.
+
+    Uses the chunked LM-head CE (ops/fused.py lm_head_cross_entropy): the
+    [B*T, V] f32 logits are never materialized, which at GPT-2 vocab sizes
+    is the difference between HBM-bound and MXU-bound training steps."""
     if "targets" in batch:
         tokens, targets = batch["tokens"], batch["targets"]
     else:
         tokens, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
-    logits = transformer_apply(params, tokens, cfg, **kw)
-    loss, _ = softmax_cross_entropy(logits, targets)
+    hidden = transformer_hidden(params, tokens, cfg, **kw)
+    loss, _ = lm_head_cross_entropy(hidden, _unembed(params, cfg), targets)
     return loss
 
 

@@ -99,7 +99,37 @@ def _attn_fwd_kernel(
         o_ref[0] = (acc_ref[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
-def _flash_fwd(q, k, v, *, causal, scale, block_q, block_k, interpret):
+def _attn_fwd_kernel_lse(
+    q_ref, k_ref, v_ref,
+    o_ref, lse_ref,
+    acc_ref, m_ref, l_ref,
+    *, block_q: int, block_k: int, num_k: int, scale: float, causal: bool,
+    seq_q: int, seq_k: int,
+):
+    """Forward that additionally writes LSE = m + log(l) per q row — the
+    residual the tiled backward needs to re-derive tile softmax without
+    another online-max pass."""
+    from jax.experimental import pallas as pl
+
+    _attn_fwd_kernel(
+        q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+        block_q=block_q, block_k=block_k, num_k=num_k, scale=scale,
+        causal=causal, seq_q=seq_q, seq_k=seq_k,
+    )
+    ki = pl.program_id(2)
+
+    @pl.when(ki == num_k - 1)
+    def _flush_lse():
+        # Per-q-row scalars must live on sublanes; the block's minor dim
+        # must be 128-divisible OR equal the array dim, so an 8-wide
+        # replicated minor axis is the cheapest legal layout (16x less HBM
+        # than jax's own 128-wide l/m residuals).
+        lse = m_ref[...] + jnp.log(jnp.maximum(l_ref[...], 1e-30))
+        lse_ref[0] = lse[:, :8]
+
+
+def _flash_fwd(q, k, v, *, causal, scale, block_q, block_k, interpret,
+               with_lse: bool = False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -111,7 +141,7 @@ def _flash_fwd(q, k, v, *, causal, scale, block_q, block_k, interpret):
     num_k = pl.cdiv(S, block_k)
 
     kernel = functools.partial(
-        _attn_fwd_kernel,
+        _attn_fwd_kernel_lse if with_lse else _attn_fwd_kernel,
         block_q=block_q,
         block_k=block_k,
         num_k=num_k,
@@ -120,6 +150,17 @@ def _flash_fwd(q, k, v, *, causal, scale, block_q, block_k, interpret):
         seq_q=T,
         seq_k=S,
     )
+    out_shape = jax.ShapeDtypeStruct((BH, T, D), q.dtype)
+    out_specs = pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0))
+    if with_lse:
+        out_shape = [
+            out_shape,
+            jax.ShapeDtypeStruct((BH, T, 8), jnp.float32),
+        ]
+        out_specs = [
+            out_specs,
+            pl.BlockSpec((1, block_q, 8), lambda bh, qi, ki: (bh, qi, 0)),
+        ]
     return pl.pallas_call(
         kernel,
         grid=(BH, num_q, num_k),
@@ -128,8 +169,8 @@ def _flash_fwd(q, k, v, *, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -153,27 +194,257 @@ def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
 
 
 def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    o = _flash_fwd(
+    o, lse = _flash_fwd(
         q, k, v, causal=causal, scale=scale,
-        block_q=block_q, block_k=block_k, interpret=interpret,
+        block_q=block_q, block_k=block_k, interpret=interpret, with_lse=True,
     )
-    return o, (q, k, v)
+    return o, (q, k, v, o, lse)
 
 
 def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, res, do):
-    # Recompute-based backward: exact softmax gradient via XLA (fused by the
-    # compiler); the forward kernel already avoided materializing T×S in HBM
-    # for the residual-free path.
-    q, k, v = res
-
-    def ref(q, k, v):
-        return _xla_attention_bhtd(q, k, v, causal=causal, scale=scale)
-
-    _, vjp = jax.vjp(ref, q, k, v)
-    return vjp(do)
+    """Tiled FlashAttention-2 backward: two pallas kernels (dq; dk/dv), each
+    re-deriving its softmax tile from (q, k, lse) — nothing O(T·S) ever
+    touches HBM (the previous recompute path materialized full f32 score
+    matrices through XLA, which both OOMed large batches and made the step
+    bandwidth-bound)."""
+    q, k, v, o, lse = res
+    BH, T, _ = q.shape
+    delta = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(-1)
+    # Same sublane-aligned [BH, T, 8] layout as lse.
+    delta = jnp.broadcast_to(delta[..., None], (BH, T, 8))
+    dq = _flash_bwd_dq(
+        q, k, v, do, lse, delta, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    dk, dv = _flash_bwd_dkv(
+        q, k, v, do, lse, delta, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki, *,
+              block_q, block_k, scale, causal, seq_q, seq_k):
+    """Shared per-tile computation of both backward kernels: load + sanitize
+    padded rows + re-derive the softmax tile. Returns (q, k, v, do, p, ds).
+
+    Sanitizing at load matters: pallas pads partial blocks with arbitrary
+    (possibly NaN) data, and a NaN anywhere in a dot input poisons the whole
+    contraction even where the weight is 0."""
+    q = q_ref[0].astype(jnp.float32)  # [bq, D]
+    k = k_ref[0].astype(jnp.float32)  # [bk, D]
+    v = v_ref[0].astype(jnp.float32)  # [bk, D]
+    do = do_ref[0].astype(jnp.float32)  # [bq, D]
+    lse = lse_ref[0][:, :1]  # [bq, 1] (lane-replicated input)
+    delta = delta_ref[0][:, :1]  # [bq, 1]
+    if seq_q % block_q:
+        qrow = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, 1), 0
+        )
+        qvalid = qrow < seq_q
+        q = jnp.where(qvalid, q, 0.0)
+        do = jnp.where(qvalid, do, 0.0)
+        lse = jnp.where(qvalid, lse, 0.0)
+        delta = jnp.where(qvalid, delta, 0.0)
+    if seq_k % block_k:
+        krow = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, 1), 0
+        )
+        kvalid = krow < seq_k
+        k = jnp.where(kvalid, k, 0.0)
+        v = jnp.where(kvalid, v, 0.0)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [bq, bk]
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    mask = (k_pos < seq_k) & (q_pos < seq_q)
+    if causal:
+        mask &= q_pos >= k_pos
+    p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # [bq, bk]
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [bq, bk]
+    # Explicit where: p=0 times a NaN dp entry would still poison the dot.
+    ds = jnp.where(mask, p * (dp - delta) * scale, 0.0)  # [bq, bk]
+    return q, k, v, do, p, ds
+
+
+def _attn_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dq_ref,
+    acc_ref,
+    *, block_q: int, block_k: int, num_k: int, scale: float, causal: bool,
+    seq_q: int, seq_k: int,
+):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    def _body():
+        _, k, _, _, _, ds = _bwd_tile(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
+            block_q=block_q, block_k=block_k, scale=scale, causal=causal,
+            seq_q=seq_q, seq_k=seq_k,
+        )
+        acc_ref[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, D]
+
+    if causal:
+        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+        def _():
+            _body()
+    else:
+        _body()
+
+    @pl.when(ki == num_k - 1)
+    def _flush():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _attn_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref,
+    dk_acc_ref, dv_acc_ref,
+    *, block_q: int, block_k: int, num_q: int, scale: float, causal: bool,
+    seq_q: int, seq_k: int,
+):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    def _body():
+        q, _, _, do, p, ds = _bwd_tile(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
+            block_q=block_q, block_k=block_k, scale=scale, causal=causal,
+            seq_q=seq_q, seq_k=seq_k,
+        )
+        dv_acc_ref[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bk, D]
+        dk_acc_ref[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bk, D]
+
+    if causal:
+        # Only q blocks at/below the diagonal see this k block.
+        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+        def _():
+            _body()
+    else:
+        _body()
+
+    @pl.when(qi == num_q - 1)
+    def _flush():
+        dk_ref[0] = dk_acc_ref[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq(q, k, v, do, lse, delta, *, causal, scale,
+                  block_q, block_k, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    BH, T, D = q.shape
+    S = k.shape[1]
+    block_q = min(block_q, T)
+    block_k = min(block_k, S)
+    num_q = pl.cdiv(T, block_q)
+    num_k = pl.cdiv(S, block_k)
+    kernel = functools.partial(
+        _attn_bwd_dq_kernel,
+        block_q=block_q, block_k=block_k, num_k=num_k, scale=scale,
+        causal=causal, seq_q=T, seq_k=S,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(BH, num_q, num_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 8), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 8), lambda bh, qi, ki: (bh, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, T, D), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+
+def _flash_bwd_dkv(q, k, v, do, lse, delta, *, causal, scale,
+                   block_q, block_k, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    BH, T, D = q.shape
+    S = k.shape[1]
+    block_q = min(block_q, T)
+    block_k = min(block_k, S)
+    num_q = pl.cdiv(T, block_q)
+    num_k = pl.cdiv(S, block_k)
+    kernel = functools.partial(
+        _attn_bwd_dkv_kernel,
+        block_q=block_q, block_k=block_k, num_q=num_q, scale=scale,
+        causal=causal, seq_q=T, seq_k=S,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(BH, num_k, num_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 8), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 8), lambda bh, ki, qi: (bh, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), jnp.float32),
+            jax.ShapeDtypeStruct((BH, S, D), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
 
 
 def _xla_attention_bhtd(q, k, v, *, causal, scale):

@@ -37,3 +37,55 @@ def softmax_cross_entropy(logits, labels, *, ignore_index: int = -100):
     mask = (labels != ignore_index).astype(jnp.float32)
     n = jnp.maximum(mask.sum(), 1.0)
     return (per_tok * mask).sum() / n, n
+
+
+def lm_head_cross_entropy(
+    hidden,
+    unembed,
+    targets,
+    *,
+    chunk_tokens: int = 2048,
+    ignore_index: int = -100,
+):
+    """Fused LM-head + token CE that never materializes [B*T, V] logits.
+
+    `hidden` [B, T, d] (compute dtype) is scanned in token chunks; each chunk
+    computes its logits (one [chunk, d] @ [d, V] matmul), reduces to
+    logsumexp - label_logit in f32, and is rematerialized in the backward
+    pass. Peak logits memory drops from B*T*V*4 bytes (gigabytes at GPT-2
+    vocab) to chunk_tokens*V*4, which is what lets large-vocab models train
+    at large batch on one chip. Returns (mean_loss, valid_token_count).
+    """
+    B, T, d = hidden.shape
+    n = B * T
+    h = hidden.reshape(n, d)
+    t = targets.reshape(n)
+    pad = (-n) % chunk_tokens
+    if pad:
+        h = jnp.concatenate([h, jnp.zeros((pad, d), h.dtype)], axis=0)
+        t = jnp.concatenate(
+            [t, jnp.full((pad,), ignore_index, t.dtype)], axis=0
+        )
+    chunks = h.shape[0] // chunk_tokens
+    h = h.reshape(chunks, chunk_tokens, d)
+    t = t.reshape(chunks, chunk_tokens)
+
+    @jax.checkpoint
+    def chunk_loss(hc, tc):
+        logits = (hc @ unembed.astype(hc.dtype)).astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        safe = jnp.where(tc == ignore_index, 0, tc)
+        picked = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]
+        mask = (tc != ignore_index).astype(jnp.float32)
+        return ((lse - picked) * mask).sum(), mask.sum()
+
+    def body(carry, xs):
+        loss_sum, count = carry
+        ls, ns = chunk_loss(*xs)
+        return (loss_sum + ls, count + ns), None
+
+    (loss_sum, count), _ = jax.lax.scan(
+        body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)), (h, t)
+    )
+    count = jnp.maximum(count, 1.0)
+    return loss_sum / count, count

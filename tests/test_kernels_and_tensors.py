@@ -1,0 +1,197 @@
+"""Regression tests for the pallas flash-attention backward, the chunked
+LM-head cross entropy, and the Arrow tensor-column extension (all on the CPU
+interpreter / CPU arrays — gradient parity against XLA reference math)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.testing import force_cpu_mesh
+
+force_cpu_mesh(8)  # before first backend use, like every jax-facing test
+
+import jax  # noqa: E402
+
+jax.config.update("jax_default_matmul_precision", "highest")
+import jax.numpy as jnp  # noqa: E402
+
+from ray_tpu.ops.flash_attention import (  # noqa: E402
+    _xla_attention_bhtd,
+    flash_attention,
+)
+from ray_tpu.ops.fused import (  # noqa: E402
+    lm_head_cross_entropy,
+    softmax_cross_entropy,
+)
+
+
+def _ref_mha(q, k, v, causal):
+    import math
+
+    B, T, H, D = q.shape
+    qf = q.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    of = _xla_attention_bhtd(
+        qf, kf, vf, causal=causal, scale=1.0 / math.sqrt(D)
+    )
+    return of.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("seq", [128, 192])  # 192 exercises block padding
+def test_flash_backward_matches_xla(causal, seq):
+    q = jax.random.normal(jax.random.PRNGKey(1), (2, seq, 2, 64), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(2), (2, seq, 2, 64), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(3), (2, seq, 2, 64), jnp.float32)
+
+    def f(q, k, v):
+        return flash_attention(q, k, v, causal=causal, interpret=True).sum()
+
+    def g(q, k, v):
+        return _ref_mha(q, k, v, causal).sum()
+
+    gf = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    gg = jax.grad(g, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gg):
+        np.testing.assert_allclose(a, b, atol=2e-4)
+
+
+def test_lm_head_ce_matches_dense():
+    B, T, d, V = 2, 96, 32, 257  # deliberately non-multiples of the chunk
+    hidden = jax.random.normal(jax.random.PRNGKey(0), (B, T, d), jnp.float32)
+    unembed = jax.random.normal(jax.random.PRNGKey(1), (d, V), jnp.float32)
+    targets = jax.random.randint(jax.random.PRNGKey(2), (B, T), 0, V)
+
+    def chunked(h, w):
+        loss, _ = lm_head_cross_entropy(h, w, targets, chunk_tokens=64)
+        return loss
+
+    def dense(h, w):
+        logits = (h @ w).astype(jnp.float32)
+        loss, _ = softmax_cross_entropy(logits, targets)
+        return loss
+
+    lc = chunked(hidden, unembed)
+    ld = dense(hidden, unembed)
+    np.testing.assert_allclose(lc, ld, rtol=1e-5)
+    gc = jax.grad(chunked, argnums=(0, 1))(hidden, unembed)
+    gd = jax.grad(dense, argnums=(0, 1))(hidden, unembed)
+    for a, b in zip(gc, gd):
+        np.testing.assert_allclose(a, b, atol=1e-4)
+
+
+def test_lm_head_ce_ignore_index():
+    hidden = jax.random.normal(jax.random.PRNGKey(0), (1, 8, 16), jnp.float32)
+    unembed = jax.random.normal(jax.random.PRNGKey(1), (16, 33), jnp.float32)
+    targets = np.random.RandomState(0).randint(0, 33, (1, 8))
+    targets[0, :4] = -100  # masked positions
+    loss, n = lm_head_cross_entropy(
+        hidden, unembed, jnp.asarray(targets), chunk_tokens=4
+    )
+    assert float(n) == 4.0
+    logits = np.asarray(hidden[0] @ unembed, dtype=np.float64)
+    lse = np.log(np.exp(logits).sum(-1))
+    per = lse - logits[np.arange(8), np.where(targets[0] < 0, 0, targets[0])]
+    expect = per[4:].mean()
+    np.testing.assert_allclose(float(loss), expect, rtol=1e-5)
+
+
+def test_tensor_column_roundtrip_through_blocks():
+    import pyarrow as pa
+
+    from ray_tpu.data import block as B
+    from ray_tpu.data.tensor_extension import (
+        is_tensor_type,
+        tensor_column_to_numpy,
+    )
+
+    imgs = np.random.randint(0, 255, (16, 48), dtype=np.uint8)
+    labels = np.arange(16, dtype=np.int64)
+    blk = B.batch_to_block({"image": imgs, "label": labels})
+    assert is_tensor_type(blk.schema.field("image").type)
+
+    # numpy batch view is the stacked array (zero-copy reshape)
+    batch = B.block_to_batch(blk, "numpy")
+    np.testing.assert_array_equal(batch["image"], imgs)
+
+    # slicing and concat preserve tensor semantics
+    merged = B.concat_blocks([B.slice_block(blk, 0, 4), B.slice_block(blk, 4, 16)])
+    np.testing.assert_array_equal(
+        tensor_column_to_numpy(merged.column("image")), imgs
+    )
+
+    # rows come back as per-row ndarrays
+    rows = B.block_to_rows(blk)
+    assert isinstance(rows[0]["image"], np.ndarray)
+    np.testing.assert_array_equal(rows[3]["image"], imgs[3])
+
+    # rows_to_block stacks uniform ndarray rows back into a tensor column
+    blk2 = B.rows_to_block(rows)
+    assert is_tensor_type(blk2.schema.field("image").type)
+    np.testing.assert_array_equal(
+        B.block_to_batch(blk2, "numpy")["image"], imgs
+    )
+
+
+def test_tensor_column_through_object_store(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.data import block as B
+
+    imgs = np.random.randint(0, 255, (32, 1024), dtype=np.uint8)
+    blk = B.batch_to_block({"image": imgs})
+    ref = ray_tpu.put(blk)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(
+        B.block_to_batch(out, "numpy")["image"], imgs
+    )
+
+
+def test_concat_mixed_tensor_and_ragged_blocks():
+    """Blocks whose ndarray rows differ in shape across blocks must still
+    concatenate (tensor columns downgrade to plain lists)."""
+    from ray_tpu.data import block as B
+
+    uniform = B.rows_to_block(
+        [{"x": np.arange(4, dtype=np.int64)} for _ in range(3)]
+    )
+    other_shape = B.rows_to_block(
+        [{"x": np.arange(6, dtype=np.int64)} for _ in range(2)]
+    )
+    ragged = B.rows_to_block(
+        [{"x": np.arange(3, dtype=np.int64)}, {"x": np.arange(5, dtype=np.int64)}]
+    )
+    out = B.concat_blocks([uniform, other_shape, ragged])
+    rows = B.block_to_rows(out)
+    assert len(rows) == 7
+    assert list(rows[0]["x"]) == [0, 1, 2, 3]
+    assert list(rows[4]["x"]) == [0, 1, 2, 3, 4, 5]
+    assert list(rows[6]["x"]) == [0, 1, 2, 3, 4]
+
+
+def test_prefetch_iterator_early_exit_stops_producer():
+    import threading
+    import time
+
+    from ray_tpu.data.iterator import prefetch_iterator
+
+    cleaned = threading.Event()
+
+    def gen():
+        try:
+            for i in range(1000):
+                yield i
+        finally:
+            cleaned.set()
+
+    it = prefetch_iterator(gen(), 2)
+    assert next(it) == 0
+    it.close()  # consumer abandons mid-stream
+    # Fill thread must notice and run the generator's finally block.
+    deadline = time.monotonic() + 5
+    while not cleaned.is_set() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert cleaned.is_set(), "producer thread leaked after early exit"
+    assert not any(
+        t.name == "batch-prefetch" and t.is_alive()
+        for t in threading.enumerate()
+    )

@@ -86,6 +86,10 @@ class RunConfig:
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
     verbose: int = 1
+    # Stop criteria for trials: {"metric": threshold} — a trial stops once
+    # any listed metric reaches its threshold (reference: the `stop` dict of
+    # tune.RunConfig; how class Trainables are bounded).
+    stop: Optional[Dict[str, Any]] = None
 
     def resolved_storage_path(self) -> str:
         return self.storage_path or os.path.join(

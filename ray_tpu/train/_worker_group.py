@@ -47,6 +47,7 @@ class TrainWorker:
         dataset_shards: Dict[str, Any],
         loop_config: Dict[str, Any],
         collective_group: Optional[str],
+        start_iteration: int = 0,
     ) -> None:
         s = _TrainSession(
             world_rank=world_rank,
@@ -61,6 +62,7 @@ class TrainWorker:
         )
         if latest_checkpoint_path:
             s.latest_checkpoint = Checkpoint(latest_checkpoint_path)
+        s.iteration = start_iteration
         self.session = s
         _session._set_session(s)
 

@@ -6,9 +6,12 @@ from typing import Any, Dict, Optional
 from ray_tpu.tune.schedulers import (
     AsyncHyperBandScheduler,
     FIFOScheduler,
+    HyperBandScheduler,
     MedianStoppingRule,
+    PopulationBasedTraining,
     TrialScheduler,
 )
+from ray_tpu.tune.trainable import Trainable
 from ray_tpu.tune.search import (
     BasicVariantGenerator,
     choice,
@@ -79,5 +82,8 @@ __all__ = [
     "FIFOScheduler",
     "AsyncHyperBandScheduler",
     "ASHAScheduler",
+    "HyperBandScheduler",
+    "PopulationBasedTraining",
     "MedianStoppingRule",
+    "Trainable",
 ]

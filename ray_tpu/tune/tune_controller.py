@@ -21,7 +21,9 @@ import ray_tpu
 from ray_tpu.train._session import TrialInfo
 from ray_tpu.tune import schedulers as sched_mod
 
-PENDING, RUNNING, TERMINATED, ERROR = "PENDING", "RUNNING", "TERMINATED", "ERROR"
+PENDING, RUNNING, PAUSED, TERMINATED, ERROR = (
+    "PENDING", "RUNNING", "PAUSED", "TERMINATED", "ERROR",
+)
 
 
 @dataclass
@@ -33,6 +35,7 @@ class Trial:
     checkpoint_path: Optional[str] = None
     error: Optional[str] = None
     early_stopped: bool = False
+    num_perturbations: int = 0  # PBT exploit/explore restarts
     actor: Any = None
     run_ref: Any = None
 
@@ -75,6 +78,13 @@ class TuneController:
         self.max_concurrent = max_concurrent or len(trials) or 1
         self.resources = resources_per_trial or {"CPU": 1.0}
         self.poll_timeout = poll_timeout
+        self.stop_criteria: Optional[Dict[str, Any]] = None
+        # PBT's explore step reads donor configs/checkpoints through this.
+        by_id = {t.trial_id: t for t in trials}
+        if hasattr(self.scheduler, "set_trial_state_reader"):
+            self.scheduler.set_trial_state_reader(by_id.get)
+        for t in trials:
+            self.scheduler.on_trial_add(t.trial_id)
 
     # -- trial actor management ---------------------------------------------
 
@@ -109,6 +119,10 @@ class TuneController:
                 dataset_shards={},
                 loop_config=trial.config,
                 collective_group=None,
+                # Resumed/exploited trials continue the checkpoint-dir
+                # numbering where their history left off, so post-resume
+                # checkpoints never overwrite pre-pause directories.
+                start_iteration=len(trial.history),
             )
         )
         trial.run_ref = trial.actor.run.remote(self.trainable_blob)
@@ -126,12 +140,73 @@ class TuneController:
 
     # -- the loop ------------------------------------------------------------
 
+    def _apply_decision(self, trial: Trial, decision) -> bool:
+        """Enforce a scheduler decision; True if the trial stopped running."""
+        if isinstance(decision, tuple) and decision[0] == sched_mod.EXPLOIT:
+            # PBT exploit/explore: adopt the donor's checkpoint + a mutated
+            # config and restart the trial in place (history continues).
+            _, new_config, donor_ckpt = decision
+            trial.config = dict(new_config)
+            trial.checkpoint_path = donor_ckpt
+            trial.num_perturbations += 1
+            self._stop_trial(trial, PENDING)
+            return True
+        if decision == sched_mod.PAUSE:
+            # The trial resumes from its latest reported checkpoint when the
+            # scheduler releases it (synchronous rung barrier).
+            self._stop_trial(trial, PAUSED)
+            return True
+        if decision in (sched_mod.STOP, sched_mod.COMPLETE):
+            # COMPLETE = budget exhausted (normal end); STOP = killed by the
+            # scheduler's selection — only the latter is "early stopped".
+            trial.early_stopped = decision == sched_mod.STOP
+            self._stop_trial(trial, TERMINATED)
+            self.scheduler.on_trial_complete(trial.trial_id, trial.last_result)
+            return True
+        return False
+
+    def _drain_scheduler_actions(self) -> None:
+        if not hasattr(self.scheduler, "pop_actions"):
+            return
+        by_id = {t.trial_id: t for t in self.trials}
+        for trial_id, action in self.scheduler.pop_actions():
+            trial = by_id.get(trial_id)
+            if trial is None or trial.status in (TERMINATED, ERROR):
+                continue
+            if action == sched_mod.RESUME:
+                if trial.status == PAUSED:
+                    trial.status = PENDING
+            elif action == sched_mod.STOP:
+                trial.early_stopped = True
+                self._stop_trial(trial, TERMINATED)
+                self.scheduler.on_trial_complete(
+                    trial.trial_id, trial.last_result
+                )
+
+    def _hit_stop_criteria(self, metrics: Dict[str, Any]) -> bool:
+        if not self.stop_criteria:
+            return False
+        for key, threshold in self.stop_criteria.items():
+            val = metrics.get(key)
+            if val is not None and float(val) >= float(threshold):
+                return True
+        return False
+
     def run(self, result_cb: Optional[Callable[[Trial, Dict], None]] = None):
         while True:
+            self._drain_scheduler_actions()
             running = [t for t in self.trials if t.status == RUNNING]
             pending = [t for t in self.trials if t.status == PENDING]
+            paused = [t for t in self.trials if t.status == PAUSED]
             if not running and not pending:
-                break
+                if not paused:
+                    break
+                # Every live trial is paused and the scheduler produced no
+                # actions: a dead cohort member can cause this. Resuming
+                # everyone beats deadlocking the experiment.
+                for t in paused:
+                    t.status = PENDING
+                continue
             # Fill free slots.
             for t in pending[: max(0, self.max_concurrent - len(running))]:
                 self._start_trial(t)
@@ -158,15 +233,16 @@ class TuneController:
                             trial.checkpoint_path = r["checkpoint_path"]
                         if result_cb:
                             result_cb(trial, metrics)
-                        decision = self.scheduler.on_trial_result(
-                            trial.trial_id, metrics
-                        )
-                        if decision == sched_mod.STOP:
-                            trial.early_stopped = True
+                        if self._hit_stop_criteria(metrics):
                             self._stop_trial(trial, TERMINATED)
                             self.scheduler.on_trial_complete(
                                 trial.trial_id, trial.last_result
                             )
+                            break
+                        decision = self.scheduler.on_trial_result(
+                            trial.trial_id, metrics
+                        )
+                        if self._apply_decision(trial, decision):
                             break
                 elif rep.get("done"):
                     if rep.get("error"):

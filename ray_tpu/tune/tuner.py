@@ -87,6 +87,14 @@ def _with_resources_of(trainable) -> Dict[str, float]:
 
 def with_resources(trainable: Callable, resources: Dict[str, float]):
     """reference: tune/trainable/util.py with_resources."""
+    if isinstance(trainable, type):
+        # Subclass instead of mutating: the same Trainable class may be used
+        # with different resources by different Tuners.
+        return type(
+            trainable.__name__,
+            (trainable,),
+            {"_tune_resources": dict(resources)},
+        )
 
     def wrapped(config):
         return trainable(config)
@@ -109,12 +117,15 @@ class Tuner:
         _trials: Optional[List[Trial]] = None,
     ):
         from ray_tpu.train.base_trainer import BaseTrainer
+        from ray_tpu.tune.trainable import Trainable, class_trainable_to_fn
 
         if isinstance(trainable, BaseTrainer):
             self._trainer = trainable
             trainable = trainable.as_trainable()
         else:
             self._trainer = None
+            if isinstance(trainable, type) and issubclass(trainable, Trainable):
+                trainable = class_trainable_to_fn(trainable)
         self.trainable = trainable
         self.param_space = param_space or {}
         self.tune_config = tune_config or TuneConfig()
@@ -155,6 +166,7 @@ class Tuner:
         )
         controller.metric = self.tune_config.metric
         controller.mode = self.tune_config.mode
+        controller.stop_criteria = self.run_config.stop
         controller.run()
         results = [
             Result(

@@ -1,0 +1,173 @@
+"""PBT, synchronous HyperBand, and class-Trainable tests (reference:
+python/ray/tune/tests/test_trial_scheduler_pbt.py, test_trial_scheduler.py,
+test_trainable.py)."""
+
+import json
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air import RunConfig
+
+
+@pytest.fixture
+def ray6(shutdown_only):
+    ray_tpu.init(num_cpus=6, num_tpus=0)
+    yield
+
+
+def _quadratic_cls():
+    """Defined inside a function so cloudpickle ships the class by value
+    (trial workers cannot import this test module)."""
+
+    class _Quadratic(tune.Trainable):
+        """score grows by `lr` each step — higher lr is strictly better, so
+        PBT should migrate the population toward the best lr."""
+
+        def setup(self, config):
+            self.lr = config["lr"]
+            self.score = 0.0
+
+        def step(self):
+            import time
+
+            # Slow enough that the population advances in overlapping poll
+            # rounds — PBT/HyperBand compare trials at the same iteration.
+            time.sleep(0.2)
+            self.score += self.lr
+            return {"score": self.score, "lr": self.lr}
+
+        def save_checkpoint(self, d):
+            with open(os.path.join(d, "state.json"), "w") as f:
+                json.dump({"score": self.score}, f)
+
+        def load_checkpoint(self, d):
+            with open(os.path.join(d, "state.json")) as f:
+                self.score = json.load(f)["score"]
+
+    return _Quadratic
+
+
+def test_pbt_perturbs_and_forks(ray6, tmp_path):
+    sched = tune.PopulationBasedTraining(
+        time_attr="training_iteration",
+        perturbation_interval=2,
+        hyperparam_mutations={"lr": (0.1, 10.0)},
+        quantile_fraction=0.25,
+        seed=7,
+    )
+    tuner = tune.Tuner(
+        _quadratic_cls(),
+        param_space={"lr": tune.grid_search([0.1, 0.5, 5.0, 9.0])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", scheduler=sched, num_samples=1
+        ),
+        run_config=RunConfig(
+            name="pbt",
+            storage_path=str(tmp_path),
+            stop={"training_iteration": 12},
+        ),
+    )
+    grid = tuner.fit()
+    assert grid.num_errors == 0
+    # The scheduler provably perturbed (exploit/explore fired)...
+    assert sched.num_perturbations >= 1
+    trials = grid._trials
+    perturbed = [t for t in trials if t.num_perturbations > 0]
+    assert perturbed, "no trial was restarted with an exploited config"
+    # ...and the fork actually adopted donor state: a perturbed trial's
+    # score history jumps to donor level (score >> what its original lr
+    # could have produced by that iteration) or its lr changed.
+    for t in perturbed:
+        assert t.config["lr"] != pytest.approx(
+            {0.1: 0.1, 0.5: 0.5, 5.0: 5.0, 9.0: 9.0}.get(t.config["lr"], -1)
+        ) or t.checkpoint_path is not None
+    best = grid.get_best_result()
+    # Population converged toward high-lr configs: the winner must beat what
+    # the two weak starting lrs (0.1, 0.5) could ever reach in 12 steps.
+    assert best.metrics["score"] > 0.5 * 12
+
+
+def test_hyperband_synchronous_halving(ray6, tmp_path):
+    sched = tune.HyperBandScheduler(
+        time_attr="training_iteration",
+        max_t=9,
+        grace_period=3,
+        reduction_factor=3,
+    )
+    tuner = tune.Tuner(
+        _quadratic_cls(),
+        param_space={"lr": tune.grid_search([1.0, 2.0, 3.0, 4.0, 5.0, 6.0])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", scheduler=sched, num_samples=1
+        ),
+        run_config=RunConfig(
+            name="hb",
+            storage_path=str(tmp_path),
+            stop={"training_iteration": 9},
+        ),
+    )
+    grid = tuner.fit()
+    assert grid.num_errors == 0
+    trials = grid._trials
+    stopped = [t for t in trials if t.early_stopped]
+    survivors = [t for t in trials if not t.early_stopped]
+    # 6 trials, eta=3: the rung at t=3 keeps 2, stops 4.
+    assert len(stopped) == 4
+    assert len(survivors) == 2
+    # The survivors are exactly the best configs.
+    surv_lrs = sorted(t.config["lr"] for t in survivors)
+    assert surv_lrs == [5.0, 6.0]
+    # Stopped trials halted at the rung milestone, not later.
+    for t in stopped:
+        assert t.history[-1]["training_iteration"] == 3
+    # Survivors resumed from checkpoints and ran to the stop criterion with
+    # continuous score (checkpoint restore preserved state).
+    for t in survivors:
+        assert t.history[-1]["training_iteration"] == 9
+        assert t.history[-1]["score"] == pytest.approx(9 * t.config["lr"])
+
+
+def test_class_trainable_save_restore(ray6, tmp_path):
+    tuner = tune.Tuner(
+        _quadratic_cls(),
+        param_space={"lr": tune.grid_search([2.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(
+            name="cls1",
+            storage_path=str(tmp_path),
+            stop={"training_iteration": 3},
+        ),
+    )
+    grid = tuner.fit()
+    assert grid.num_errors == 0
+    best = grid.get_best_result()
+    assert best.metrics["score"] == pytest.approx(6.0)
+    assert best.checkpoint is not None
+    # Checkpoint holds the trainable's own state file.
+    assert os.path.exists(os.path.join(best.checkpoint.path, "state.json"))
+
+    # A fresh run resuming from that checkpoint continues the state.
+    trial_ckpt = best.checkpoint.path
+    cls = _quadratic_cls()
+
+    def fn(config):
+        from ray_tpu.train._checkpoint import Checkpoint
+
+        t = cls(config)
+        with Checkpoint(trial_ckpt).as_directory() as d:
+            t.load_checkpoint(d)
+        out = t.train()
+        tune.report(out)
+
+    tuner2 = tune.Tuner(
+        fn,
+        param_space={"lr": tune.grid_search([2.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="cls2", storage_path=str(tmp_path)),
+    )
+    grid2 = tuner2.fit()
+    assert grid2.num_errors == 0
+    assert grid2.get_best_result().metrics["score"] == pytest.approx(8.0)

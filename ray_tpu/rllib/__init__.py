@@ -28,6 +28,8 @@ from ray_tpu.rllib.algorithms.impala import (
     IMPALAConfig,
 )
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.env.multi_agent_env import MultiAgentEnv
+from ray_tpu.rllib.env.multi_agent_env_runner import MultiAgentEnvRunner
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.core.learner import Learner
@@ -55,6 +57,8 @@ __all__ = [
     "IMPALAConfig",
     "Learner",
     "LearnerGroup",
+    "MultiAgentEnv",
+    "MultiAgentEnvRunner",
     "PPO",
     "PPOConfig",
     "ReplayBuffer",

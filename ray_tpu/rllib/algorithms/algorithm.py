@@ -24,6 +24,7 @@ from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
 class Algorithm:
     # Subclasses set these.
     policy_kind = "pi_vf"
+    supports_multi_agent = False
 
     def __init__(self, config: AlgorithmConfig):
         self.config = config
@@ -32,6 +33,24 @@ class Algorithm:
         self._start_time = time.time()
         self._weights_version = 0
 
+        self.multi_agent = bool(config.policies)
+        if self.multi_agent and not type(self).supports_multi_agent:
+            raise ValueError(
+                f"{type(self).__name__} does not implement multi-agent "
+                "training; use PPO or drop .multi_agent() from the config"
+            )
+        extra = None
+        runner_cls = None
+        if self.multi_agent:
+            from ray_tpu.rllib.env.multi_agent_env_runner import (
+                MultiAgentEnvRunner,
+            )
+
+            runner_cls = MultiAgentEnvRunner
+            extra = {
+                "policies": list(config.policies),
+                "policy_mapping_fn": config.policy_mapping_fn,
+            }
         self.env_runner_group = EnvRunnerGroup(
             env=config.env,
             env_config=config.env_config,
@@ -42,16 +61,35 @@ class Algorithm:
             seed=config.seed,
             restart_failed=config.restart_failed_env_runners,
             sample_timeout_s=config.sample_timeout_s,
+            runner_cls=runner_cls,
+            extra_ctor_kwargs=extra,
         )
-        obs_dim, num_actions = self.env_runner_group.get_spaces()
-        self.obs_dim, self.num_actions = obs_dim, num_actions
-
-        self.learner_group = LearnerGroup(
-            self._learner_builder(obs_dim, num_actions),
-            num_learners=config.num_learners,
-            num_cpus_per_learner=config.num_cpus_per_learner,
-            num_tpus_per_learner=config.num_tpus_per_learner,
-        )
+        if self.multi_agent:
+            # {policy_id: (obs_dim, num_actions)} -> one learner group per
+            # policy (the reference's MultiRLModule, split by module so
+            # policies with different spaces stay independent jit programs).
+            spaces = self.env_runner_group.get_spaces()
+            self.policy_spaces = spaces
+            self.learner_groups: Dict[str, LearnerGroup] = {
+                pid: LearnerGroup(
+                    self._learner_builder(od, na),
+                    num_learners=config.num_learners,
+                    num_cpus_per_learner=config.num_cpus_per_learner,
+                    num_tpus_per_learner=config.num_tpus_per_learner,
+                )
+                for pid, (od, na) in spaces.items()
+            }
+            self.learner_group = None
+            self.obs_dim = self.num_actions = None
+        else:
+            obs_dim, num_actions = self.env_runner_group.get_spaces()
+            self.obs_dim, self.num_actions = obs_dim, num_actions
+            self.learner_group = LearnerGroup(
+                self._learner_builder(obs_dim, num_actions),
+                num_learners=config.num_learners,
+                num_cpus_per_learner=config.num_cpus_per_learner,
+                num_tpus_per_learner=config.num_tpus_per_learner,
+            )
         self._sync_weights()
 
     # -- subclass hooks ------------------------------------------------------
@@ -87,9 +125,13 @@ class Algorithm:
 
     def _sync_weights(self) -> None:
         self._weights_version += 1
-        self.env_runner_group.sync_weights(
-            self.learner_group.get_weights(), self._weights_version
-        )
+        if self.multi_agent:
+            weights = {
+                pid: lg.get_weights() for pid, lg in self.learner_groups.items()
+            }
+        else:
+            weights = self.learner_group.get_weights()
+        self.env_runner_group.sync_weights(weights, self._weights_version)
 
     def _episode_metrics(self, batches: List[Dict[str, Any]]) -> Dict[str, float]:
         stats = []
@@ -114,27 +156,46 @@ class Algorithm:
     def save(self, checkpoint_dir: str) -> str:
         os.makedirs(checkpoint_dir, exist_ok=True)
         path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
+        if self.multi_agent:
+            learner_state = {
+                pid: lg.get_state() for pid, lg in self.learner_groups.items()
+            }
+        else:
+            learner_state = self.learner_group.get_state()
         state = {
-            "learner": self.learner_group.get_state(),
+            "learner": learner_state,
+            "multi_agent": self.multi_agent,
             "iteration": self.iteration,
             "env_steps": self._env_steps_total,
             "config": self.config.to_dict(),
         }
         with open(path, "wb") as f:
-            pickle.dump(state, f)
+            # cloudpickle: multi-agent configs hold callables (env factory,
+            # policy_mapping_fn), often lambdas/closures plain pickle rejects.
+            import cloudpickle
+
+            cloudpickle.dump(state, f)
         return checkpoint_dir
 
     def restore(self, checkpoint_dir: str) -> None:
         with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"), "rb") as f:
             state = pickle.load(f)
-        self.learner_group.set_state(state["learner"])
+        if self.multi_agent:
+            for pid, lg in self.learner_groups.items():
+                lg.set_state(state["learner"][pid])
+        else:
+            self.learner_group.set_state(state["learner"])
         self.iteration = state["iteration"]
         self._env_steps_total = state["env_steps"]
         self._sync_weights()
 
     def stop(self) -> None:
         self.env_runner_group.stop()
-        self.learner_group.shutdown()
+        if self.multi_agent:
+            for lg in self.learner_groups.values():
+                lg.shutdown()
+        else:
+            self.learner_group.shutdown()
 
     # -- Tune integration ----------------------------------------------------
 

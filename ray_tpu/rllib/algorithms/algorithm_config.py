@@ -36,6 +36,11 @@ class AlgorithmConfig:
         self.offline_input = None
         # debugging()
         self.seed: int = 0
+
+        # Multi-agent (reference: AlgorithmConfig.multi_agent): None/empty ->
+        # single-agent mode.
+        self.policies = None
+        self.policy_mapping_fn = None
         # fault_tolerance()
         self.restart_failed_env_runners: bool = True
 
@@ -101,6 +106,15 @@ class AlgorithmConfig:
             self.seed = seed
         return self
 
+    def multi_agent(self, *, policies=None, policy_mapping_fn=None):
+        """Declare per-policy modules + the agent->policy mapping
+        (reference: AlgorithmConfig.multi_agent)."""
+        if policies is not None:
+            self.policies = list(policies)
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
+        return self
+
     def fault_tolerance(self, *, restart_failed_env_runners: Optional[bool] = None):
         if restart_failed_env_runners is not None:
             self.restart_failed_env_runners = restart_failed_env_runners
@@ -114,6 +128,10 @@ class AlgorithmConfig:
     def validate(self) -> None:
         if self.env is None:
             raise ValueError("config.environment(env=...) is required")
+        if self.policies and self.policy_mapping_fn is None:
+            raise ValueError(
+                "multi_agent(policies=...) also requires policy_mapping_fn"
+            )
 
     def build_algo(self):
         self.validate()

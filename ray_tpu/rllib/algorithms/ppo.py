@@ -84,6 +84,7 @@ class PPOLearner(Learner):
 
 class PPO(Algorithm):
     policy_kind = "pi_vf"
+    supports_multi_agent = True
 
     def _learner_builder(self, obs_dim: int, num_actions: int) -> Callable[[], Any]:
         cfg = self.config
@@ -106,18 +107,9 @@ class PPO(Algorithm):
 
         return build
 
-    def training_step(self) -> Dict[str, Any]:
+    def _flatten_with_gae(self, policy_batches, obs_dim: int) -> Dict[str, np.ndarray]:
+        """GAE per runner batch, then flatten to one train batch."""
         cfg = self.config
-        n_runners = max(1, cfg.num_env_runners)
-        steps_per_runner = max(
-            1,
-            cfg.train_batch_size
-            // (n_runners * cfg.num_envs_per_env_runner),
-        )
-        batches = self.env_runner_group.sample(steps_per_runner)
-        self._env_steps_total += sum(b["env_steps"] for b in batches)
-
-        # GAE per runner batch, then flatten to one train batch.
         flat: Dict[str, list] = {
             k: []
             for k in (
@@ -129,7 +121,7 @@ class PPO(Algorithm):
                 "values_old",
             )
         }
-        for b in batches:
+        for b in policy_batches:
             adv, ret = gae_advantages(
                 b["rewards"],
                 b["values"],
@@ -140,7 +132,7 @@ class PPO(Algorithm):
                 cfg.lambda_,
                 boundary_values=b.get("boundary_values"),
             )
-            flat["obs"].append(b["obs"].reshape(-1, self.obs_dim))
+            flat["obs"].append(b["obs"].reshape(-1, obs_dim))
             flat["actions"].append(b["actions"].reshape(-1))
             flat["logp_old"].append(b["logp"].reshape(-1))
             flat["advantages"].append(adv.reshape(-1))
@@ -149,18 +141,55 @@ class PPO(Algorithm):
         train_batch = {k: np.concatenate(v) for k, v in flat.items()}
         adv = train_batch["advantages"]
         train_batch["advantages"] = (adv - adv.mean()) / (adv.std() + 1e-8)
+        return train_batch
 
-        # Minibatched multi-epoch SGD.
+    def _sgd_epochs(self, train_batch, learner_group, rng) -> Dict[str, float]:
+        """Minibatched multi-epoch SGD on one learner group."""
+        cfg = self.config
         size = len(train_batch["obs"])
         mb = min(cfg.minibatch_size, size)
-        rng = np.random.RandomState(cfg.seed + self.iteration)
         last_metrics: Dict[str, float] = {}
         for _ in range(cfg.num_epochs):
             perm = rng.permutation(size)
             for start in range(0, size - mb + 1, mb):
                 idx = perm[start : start + mb]
                 minibatch = {k: v[idx] for k, v in train_batch.items()}
-                last_metrics = self.learner_group.update_from_batch(minibatch)
+                last_metrics = learner_group.update_from_batch(minibatch)
+        return last_metrics
 
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        n_runners = max(1, cfg.num_env_runners)
+        # Multi-agent runners step ONE env each (num_envs_per_env_runner is
+        # not vectorized there), so the per-runner step count must not be
+        # divided by it or the train batch silently shrinks.
+        envs_per_runner = 1 if self.multi_agent else cfg.num_envs_per_env_runner
+        steps_per_runner = max(
+            1, cfg.train_batch_size // (n_runners * envs_per_runner)
+        )
+        batches = self.env_runner_group.sample(steps_per_runner)
+        self._env_steps_total += sum(b["env_steps"] for b in batches)
+        rng = np.random.RandomState(cfg.seed + self.iteration)
+
+        if self.multi_agent:
+            # Per-policy update: each policy gets its own GAE + SGD epochs
+            # on its own learner group (reference: one Learner.update over a
+            # MultiRLModule; here independent jit programs per policy).
+            metrics: Dict[str, Any] = {}
+            for pid, lg in self.learner_groups.items():
+                pbatches = [
+                    b["policies"][pid] for b in batches if pid in b["policies"]
+                ]
+                if not pbatches:
+                    continue
+                obs_dim = self.policy_spaces[pid][0]
+                train_batch = self._flatten_with_gae(pbatches, obs_dim)
+                for k, v in self._sgd_epochs(train_batch, lg, rng).items():
+                    metrics[f"{pid}/{k}"] = v
+            self._sync_weights()
+            return {**self._episode_metrics(batches), **metrics}
+
+        train_batch = self._flatten_with_gae(batches, self.obs_dim)
+        last_metrics = self._sgd_epochs(train_batch, self.learner_group, rng)
         self._sync_weights()
         return {**self._episode_metrics(batches), **last_metrics}

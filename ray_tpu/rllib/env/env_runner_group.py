@@ -31,7 +31,11 @@ class EnvRunnerGroup:
         seed: int,
         restart_failed: bool = True,
         sample_timeout_s: float = 60.0,
+        runner_cls=None,
+        extra_ctor_kwargs: Optional[Dict[str, Any]] = None,
     ):
+        self._runner_cls = runner_cls or SingleAgentEnvRunner
+        self._extra_kwargs = dict(extra_ctor_kwargs or {})
         self._ctor_kwargs = dict(
             env=env,
             env_config=env_config,
@@ -51,9 +55,9 @@ class EnvRunnerGroup:
             actors = [self._make_remote(i) for i in range(num_env_runners)]
             self._manager = FaultTolerantActorManager(actors)
 
-    def _make_local(self, index: int) -> SingleAgentEnvRunner:
+    def _make_local(self, index: int):
         k = self._ctor_kwargs
-        return SingleAgentEnvRunner(
+        return self._runner_cls(
             k["env"],
             num_envs=k["num_envs_per_env_runner"],
             policy_kind=k["policy_kind"],
@@ -61,11 +65,12 @@ class EnvRunnerGroup:
             seed=k["seed"],
             worker_index=index,
             env_config=k["env_config"],
+            **self._extra_kwargs,
         )
 
     def _make_remote(self, index: int):
         k = self._ctor_kwargs
-        cls = ray_tpu.remote(SingleAgentEnvRunner)
+        cls = ray_tpu.remote(self._runner_cls)
         return cls.options(num_cpus=1).remote(
             k["env"],
             num_envs=k["num_envs_per_env_runner"],
@@ -74,6 +79,7 @@ class EnvRunnerGroup:
             seed=k["seed"],
             worker_index=index,
             env_config=k["env_config"],
+            **self._extra_kwargs,
         )
 
     @property

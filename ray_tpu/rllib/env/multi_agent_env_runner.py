@@ -1,0 +1,250 @@
+"""MultiAgentEnvRunner: experience collection from a MultiAgentEnv with
+per-policy modules (reference: rllib/env/multi_agent_env_runner.py +
+core/rl_module/multi_rl_module.py MultiRLModule).
+
+Agents are mapped to policies by policy_mapping_fn; each policy owns its own
+pi_vf module and performs ONE batched jitted forward per step over all of
+its agents (the MultiRLModule idea, jax-style: group by module, batch the
+group). Sample output is a per-policy dict of single-agent-shaped time-major
+batches, so the per-policy learner path (GAE, minibatch SGD) is identical to
+the single-agent one — agents of a policy occupy the "env" axis.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+
+class MultiAgentEnvRunner:
+    def __init__(
+        self,
+        env_factory: Callable[[Dict[str, Any]], Any],
+        *,
+        policies,
+        policy_mapping_fn: Callable[[Any], str],
+        module_spec_dict: Optional[Dict[str, Any]] = None,
+        seed: int = 0,
+        worker_index: int = 0,
+        env_config: Optional[Dict[str, Any]] = None,
+        num_envs: int = 1,  # accepted for group-API parity; one env per runner
+        policy_kind: str = "pi_vf",
+    ):
+        import jax
+
+        if policy_kind != "pi_vf":
+            raise ValueError(
+                "MultiAgentEnvRunner currently supports actor-critic "
+                f"(pi_vf) policies only, got {policy_kind!r}"
+            )
+        self._jax = jax
+        if isinstance(env_factory, str):
+            raise ValueError(
+                "multi-agent envs are passed as factory callables "
+                "(config.environment(env=lambda cfg: MyMultiAgentEnv(cfg)))"
+            )
+        self.env = env_factory(env_config or {})
+        self.worker_index = worker_index
+        self.rng = jax.random.PRNGKey(seed * 10007 + worker_index + 17)
+
+        from ray_tpu.rllib.core import rl_module as M
+
+        self.policy_ids = list(policies)
+        self.mapping = policy_mapping_fn
+        self.agents = list(self.env.agents)
+        # Stable per-policy agent grouping (the batch axis of each policy).
+        self.agents_of: Dict[str, list] = {pid: [] for pid in self.policy_ids}
+        for aid in self.agents:
+            pid = self.mapping(aid)
+            if pid not in self.agents_of:
+                raise ValueError(
+                    f"policy_mapping_fn({aid!r}) -> {pid!r} not in {self.policy_ids}"
+                )
+            self.agents_of[pid].append(aid)
+
+        empty = [p for p, aids in self.agents_of.items() if not aids]
+        if empty:
+            raise ValueError(
+                f"policies {empty} have no agents mapped to them — check "
+                "policy_mapping_fn (every configured policy must own at "
+                "least one agent)"
+            )
+        self.specs: Dict[str, Any] = {}
+        self.params: Dict[str, Any] = {}
+        self._policy_step: Dict[str, Any] = {}
+        for pid, aids in self.agents_of.items():
+            if not aids:
+                continue
+            spaces = [self.env.observation_spaces[a] for a in aids]
+            acts = [self.env.action_spaces[a] for a in aids]
+            obs_dims = {int(np.prod(s.shape)) for s in spaces}
+            n_actions = {int(a.n) for a in acts}
+            if len(obs_dims) != 1 or len(n_actions) != 1:
+                raise ValueError(
+                    f"agents of policy {pid!r} must share obs/action spaces"
+                )
+            spec_kwargs = dict(module_spec_dict or {})
+            spec_kwargs.setdefault("obs_dim", obs_dims.pop())
+            spec_kwargs.setdefault("num_actions", n_actions.pop())
+            spec = M.RLModuleSpec(**spec_kwargs)
+            self.specs[pid] = spec
+            self.params[pid] = M.init_pi_vf(self._next_rng(), spec)
+
+            def _step(params, rng, obs):
+                logits, value = M.forward_pi_vf(params, obs)
+                actions, logp = M.sample_actions(rng, logits)
+                return actions, logp, value
+
+            self._policy_step[pid] = jax.jit(_step)
+
+        self._obs, _ = self.env.reset(seed=seed * 7919 + worker_index)
+        # Per-agent liveness: an individually-terminated agent may drop out
+        # of subsequent obs dicts while the episode continues; its slot then
+        # replays its last obs with zero reward and terminated=True (the
+        # GAE mask zeroes any contribution).
+        self._last_obs = dict(self._obs)
+        self._agent_done = {a: False for a in self.agents}
+        self._episode_return = 0.0
+        self._episode_len = 0
+        self._completed: collections.deque = collections.deque(maxlen=100)
+        self._weights_version = 0
+
+    def ping(self):
+        return "pong"
+
+    def _next_rng(self):
+        self.rng, k = self._jax.random.split(self.rng)
+        return k
+
+    # -- weight sync ---------------------------------------------------------
+
+    def set_weights(self, weights: Dict[str, Any], version: int = 0) -> None:
+        import jax.numpy as jnp
+
+        for pid, w in weights.items():
+            if pid in self.params:
+                self.params[pid] = self._jax.tree_util.tree_map(jnp.asarray, w)
+        self._weights_version = version
+
+    def get_weights_version(self) -> int:
+        return self._weights_version
+
+    # -- sampling ------------------------------------------------------------
+
+    def _obs_mat(self, pid: str) -> np.ndarray:
+        return np.stack(
+            [np.asarray(self._last_obs[a], dtype=np.float32).reshape(-1)
+             for a in self.agents_of[pid]]
+        )
+
+    def sample(self, num_steps: int, **_ignored) -> Dict[str, Any]:
+        """num_steps env steps. Returns {"policies": {pid: batch}, ...} where
+        each batch is single-agent-shaped: [T, n_agents_of_policy, ...]."""
+        T = num_steps
+        pids = [p for p in self.policy_ids if self.agents_of[p]]
+        buf: Dict[str, Dict[str, np.ndarray]] = {}
+        for pid in pids:
+            n = len(self.agents_of[pid])
+            d = self.specs[pid].obs_dim
+            buf[pid] = {
+                "obs": np.empty((T, n, d), np.float32),
+                "actions": np.empty((T, n), np.int64),
+                "rewards": np.empty((T, n), np.float32),
+                "terminateds": np.empty((T, n), np.bool_),
+                "truncateds": np.empty((T, n), np.bool_),
+                "next_obs": np.empty((T, n, d), np.float32),
+                "logp": np.zeros((T, n), np.float32),
+                "values": np.zeros((T, n), np.float32),
+            }
+
+        env_steps = 0
+        for t in range(T):
+            action_dict: Dict[Any, Any] = {}
+            for pid in pids:
+                obs_mat = self._obs_mat(pid)
+                buf[pid]["obs"][t] = obs_mat
+                actions, logp, value = self._policy_step[pid](
+                    self.params[pid], self._next_rng(), obs_mat
+                )
+                actions = np.asarray(actions)
+                buf[pid]["actions"][t] = actions
+                buf[pid]["logp"][t] = np.asarray(logp)
+                buf[pid]["values"][t] = np.asarray(value)
+                for i, aid in enumerate(self.agents_of[pid]):
+                    if not self._agent_done[aid]:
+                        action_dict[aid] = int(actions[i])
+            next_obs, rewards, terms, truncs, _infos = self.env.step(action_dict)
+            env_steps += 1
+            all_term = bool(terms.get("__all__", False))
+            all_trunc = bool(truncs.get("__all__", False))
+            for pid in pids:
+                for i, aid in enumerate(self.agents_of[pid]):
+                    done_before = self._agent_done[aid]
+                    buf[pid]["rewards"][t, i] = (
+                        0.0 if done_before else float(rewards.get(aid, 0.0))
+                    )
+                    buf[pid]["terminateds"][t, i] = bool(
+                        done_before or terms.get(aid, all_term)
+                    )
+                    buf[pid]["truncateds"][t, i] = bool(
+                        truncs.get(aid, all_trunc)
+                    )
+                    buf[pid]["next_obs"][t, i] = np.asarray(
+                        next_obs.get(aid, self._last_obs[aid]),
+                        dtype=np.float32,
+                    ).reshape(-1)
+            self._episode_return += float(sum(rewards.values()))
+            self._episode_len += 1
+            if all_term or all_trunc:
+                self._completed.append(
+                    (self._episode_return, self._episode_len)
+                )
+                self._episode_return, self._episode_len = 0.0, 0
+                self._obs, _ = self.env.reset()
+                self._last_obs = dict(self._obs)
+                self._agent_done = {a: False for a in self.agents}
+            else:
+                self._obs = next_obs
+                for aid in self.agents:
+                    if aid in next_obs:
+                        self._last_obs[aid] = next_obs[aid]
+                    if terms.get(aid) or truncs.get(aid):
+                        self._agent_done[aid] = True
+
+        out_policies: Dict[str, Dict[str, Any]] = {}
+        for pid in pids:
+            b = dict(buf[pid])
+            # Bootstrap V(current obs) for the step after the batch end.
+            _, _, bootstrap = self._policy_step[pid](
+                self.params[pid], self._next_rng(), self._obs_mat(pid)
+            )
+            b["bootstrap_value"] = np.asarray(bootstrap)
+            # V(next_obs) at truncation boundaries (GAE bootstraps there).
+            boundary = np.zeros_like(b["values"])
+            ts, is_ = np.nonzero(b["truncateds"] & ~b["terminateds"])
+            if len(ts):
+                _, _, v_fin = self._policy_step[pid](
+                    self.params[pid], self._next_rng(), b["next_obs"][ts, is_]
+                )
+                boundary[ts, is_] = np.asarray(v_fin)
+            b["boundary_values"] = boundary
+            out_policies[pid] = b
+        return {
+            "policies": out_policies,
+            "episode_stats": list(self._completed),
+            "weights_version": self._weights_version,
+            "env_steps": env_steps,
+        }
+
+    # -- introspection -------------------------------------------------------
+
+    def get_spaces(self) -> Dict[str, Any]:
+        return {
+            pid: (spec.obs_dim, spec.num_actions)
+            for pid, spec in self.specs.items()
+        }
+
+    def stop(self) -> None:
+        self.env.close()

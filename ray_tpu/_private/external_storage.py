@@ -86,8 +86,73 @@ class FileSystemStorage(ExternalStorage):
         shutil.rmtree(self.base, ignore_errors=True)
 
 
+class UriStorage(ExternalStorage):
+    """Spill to any pyarrow.fs URI — s3://bucket/prefix, gs://bucket/prefix,
+    or file:///path (reference: external_storage.py:72 ExternalStorageURI /
+    the smart_open-based remote backends). Credentials/endpoints resolve the
+    standard way (AWS_* env incl. AWS_ENDPOINT_URL, GCE metadata), so the
+    same config works against real object stores and the mock-S3 test
+    server. A namespace subdir keeps raylets sharing a bucket apart."""
+
+    def __init__(self, uri: str, namespace: str = ""):
+        import pyarrow.fs as pafs
+
+        self.uri = uri.rstrip("/")
+        self.fs, base = pafs.FileSystem.from_uri(self.uri)
+        self.base = base.rstrip("/")
+        if namespace:
+            self.base = f"{self.base}/{namespace}"
+        self._ensured = False
+        self._lock = threading.Lock()
+
+    def _ensure(self) -> None:
+        # Object stores don't need directories, but local/NFS through the
+        # same API do; create_dir is a no-op where prefixes are virtual.
+        if not self._ensured:
+            with self._lock:
+                if self._ensured:
+                    return
+                # Latch only on success: a transient create failure must be
+                # retried by the next spill, not permanently swallowed.
+                self.fs.create_dir(self.base, recursive=True)
+                self._ensured = True
+
+    def spill(self, oid: str, data: memoryview) -> str:
+        self._ensure()
+        key = f"{self.base}/{oid}-{os.urandom(4).hex()}"
+        with self.fs.open_output_stream(key) as f:
+            f.write(data)
+        return "uri://" + key
+
+    def restore(self, uri: str, dest: memoryview) -> int:
+        key = uri[len("uri://") :]
+        n = 0
+        with self.fs.open_input_stream(key) as f:
+            view = dest
+            while n < len(view):
+                chunk = f.read(len(view) - n)
+                if not chunk:
+                    break
+                view[n : n + len(chunk)] = chunk
+                n += len(chunk)
+        return n
+
+    def delete(self, uri: str) -> None:
+        try:
+            self.fs.delete_file(uri[len("uri://") :])
+        except Exception:
+            pass
+
+    def destroy(self) -> None:
+        try:
+            self.fs.delete_dir_contents(self.base, missing_dir_ok=True)
+        except Exception:
+            pass
+
+
 _REGISTRY: Dict[str, Callable[[dict], ExternalStorage]] = {
     "filesystem": lambda params: FileSystemStorage(**params),
+    "uri": lambda params: UriStorage(**params),
 }
 
 
@@ -138,4 +203,6 @@ def create_storage(
                 params["directory_path"], namespace
             )
         params.setdefault("directory_path", default_dir)
+    elif typ == "uri":
+        params.setdefault("namespace", namespace)
     return factory(params)

@@ -50,24 +50,35 @@ class PPOLearner(Learner):
         logits, values = forward_pi_vf(params, batch["obs"])
         logp_all = jax.nn.log_softmax(logits)
         logp = jnp.take_along_axis(logp_all, batch["actions"][:, None], axis=-1)[:, 0]
+        # Optional row mask (multi-agent: padded rows of individually-
+        # terminated agents must not produce gradients).
+        mask = batch.get("mask")
+        if mask is None:
+            wmean = jnp.mean
+        else:
+            denom = jnp.maximum(mask.sum(), 1.0)
+
+            def wmean(x):
+                return (x * mask).sum() / denom
+
         ratio = jnp.exp(logp - batch["logp_old"])
         adv = batch["advantages"]
         surr1 = ratio * adv
         surr2 = jnp.clip(ratio, 1 - c["clip_param"], 1 + c["clip_param"]) * adv
-        policy_loss = -jnp.mean(jnp.minimum(surr1, surr2))
+        policy_loss = -wmean(jnp.minimum(surr1, surr2))
 
         vf_err = values - batch["value_targets"]
         vf_clipped = batch["values_old"] + jnp.clip(
             values - batch["values_old"], -c["vf_clip_param"], c["vf_clip_param"]
         )
         vf_err_clipped = vf_clipped - batch["value_targets"]
-        vf_loss = 0.5 * jnp.mean(
+        vf_loss = 0.5 * wmean(
             jnp.maximum(jnp.square(vf_err), jnp.square(vf_err_clipped))
         )
 
         probs = jax.nn.softmax(logits)
-        entropy = -jnp.mean(jnp.sum(probs * logp_all, axis=-1))
-        kl = jnp.mean(batch["logp_old"] - logp)
+        entropy = -wmean(jnp.sum(probs * logp_all, axis=-1))
+        kl = wmean(batch["logp_old"] - logp)
 
         loss = (
             policy_loss
@@ -110,17 +121,12 @@ class PPO(Algorithm):
     def _flatten_with_gae(self, policy_batches, obs_dim: int) -> Dict[str, np.ndarray]:
         """GAE per runner batch, then flatten to one train batch."""
         cfg = self.config
-        flat: Dict[str, list] = {
-            k: []
-            for k in (
-                "obs",
-                "actions",
-                "logp_old",
-                "advantages",
-                "value_targets",
-                "values_old",
-            )
-        }
+        has_mask = any("mask" in b for b in policy_batches)
+        keys = [
+            "obs", "actions", "logp_old", "advantages",
+            "value_targets", "values_old",
+        ] + (["mask"] if has_mask else [])
+        flat: Dict[str, list] = {k: [] for k in keys}
         for b in policy_batches:
             adv, ret = gae_advantages(
                 b["rewards"],
@@ -138,9 +144,23 @@ class PPO(Algorithm):
             flat["advantages"].append(adv.reshape(-1))
             flat["value_targets"].append(ret.reshape(-1))
             flat["values_old"].append(b["values"].reshape(-1))
+            if has_mask:
+                flat["mask"].append(
+                    b.get(
+                        "mask", np.ones_like(b["values"], np.float32)
+                    ).reshape(-1)
+                )
         train_batch = {k: np.concatenate(v) for k, v in flat.items()}
         adv = train_batch["advantages"]
-        train_batch["advantages"] = (adv - adv.mean()) / (adv.std() + 1e-8)
+        if has_mask:
+            # Masked normalization: padded rows must not skew the stats.
+            m = train_batch["mask"]
+            n = max(float(m.sum()), 1.0)
+            mean = float((adv * m).sum() / n)
+            var = float(((adv - mean) ** 2 * m).sum() / n)
+            train_batch["advantages"] = (adv - mean) / (var**0.5 + 1e-8)
+        else:
+            train_batch["advantages"] = (adv - adv.mean()) / (adv.std() + 1e-8)
         return train_batch
 
     def _sgd_epochs(self, train_batch, learner_group, rng) -> Dict[str, float]:

@@ -157,6 +157,10 @@ class MultiAgentEnvRunner:
                 "next_obs": np.empty((T, n, d), np.float32),
                 "logp": np.zeros((T, n), np.float32),
                 "values": np.zeros((T, n), np.float32),
+                # 0.0 marks padded rows of individually-terminated agents;
+                # the PPO loss drops them (GAE alone does NOT zero a padded
+                # row's own delta, only its bootstrap).
+                "mask": np.ones((T, n), np.float32),
             }
 
         env_steps = 0
@@ -182,6 +186,7 @@ class MultiAgentEnvRunner:
             for pid in pids:
                 for i, aid in enumerate(self.agents_of[pid]):
                     done_before = self._agent_done[aid]
+                    buf[pid]["mask"][t, i] = 0.0 if done_before else 1.0
                     buf[pid]["rewards"][t, i] = (
                         0.0 if done_before else float(rewards.get(aid, 0.0))
                     )

@@ -1,0 +1,277 @@
+"""Minimal in-process S3 server for tests (reference:
+python/ray/tests/mock_s3_server.py — same role, implemented against the
+subset of the S3 REST API that pyarrow.fs.S3FileSystem uses: HeadBucket,
+HeadObject, GetObject (with Range), PutObject, DeleteObject, ListObjectsV2,
+CreateBucket, and single-shot multipart upload)."""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlparse
+
+
+class _S3State:
+    def __init__(self):
+        self.buckets: dict = {}  # bucket -> {key: bytes}
+        self.uploads: dict = {}  # upload_id -> {part_number: bytes}
+        self.lock = threading.Lock()
+        self._next_upload = 0
+
+
+def _xml(body: str) -> bytes:
+    return ('<?xml version="1.0" encoding="UTF-8"?>' + body).encode()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    state: _S3State = None  # type: ignore[assignment]
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _split(self):
+        parsed = urlparse(self.path)
+        parts = unquote(parsed.path).lstrip("/").split("/", 1)
+        bucket = parts[0]
+        key = parts[1] if len(parts) > 1 else ""
+        return bucket, key, parse_qs(parsed.query, keep_blank_values=True)
+
+    def _reply(self, code: int, body: bytes = b"", headers=None):
+        self.send_response(code)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def _read_body(self) -> bytes:
+        n = int(self.headers.get("Content-Length", 0) or 0)
+        if self.headers.get("Transfer-Encoding") == "chunked":
+            out = b""
+            while True:
+                size = int(self.rfile.readline().strip().split(b";")[0], 16)
+                if size == 0:
+                    self.rfile.readline()
+                    break
+                out += self.rfile.read(size)
+                self.rfile.readline()
+            raw = out
+        else:
+            raw = self.rfile.read(n)
+        if "aws-chunked" in (self.headers.get("Content-Encoding") or ""):
+            # SigV4 streaming payload: hex-size[;chunk-signature=..]\r\n data
+            # \r\n ... 0[;sig]\r\n trailers. Decode to the real object bytes.
+            out = b""
+            pos = 0
+            while pos < len(raw):
+                nl = raw.index(b"\r\n", pos)
+                size = int(raw[pos:nl].split(b";")[0], 16)
+                if size == 0:
+                    break
+                start = nl + 2
+                out += raw[start : start + size]
+                pos = start + size + 2  # skip trailing \r\n
+            return out
+        return raw
+
+    def _not_found(self, what="NoSuchKey"):
+        self._reply(
+            404, _xml(f"<Error><Code>{what}</Code></Error>"),
+            headers={"Content-Type": "application/xml"},
+        )
+
+    def do_HEAD(self):
+        bucket, key, _ = self._split()
+        with self.state.lock:
+            b = self.state.buckets.get(bucket)
+            if b is None:
+                return self._not_found("NoSuchBucket")
+            if not key:  # HeadBucket
+                return self._reply(200)
+            if key in b:
+                return self._head_object(b[key])
+            return self._not_found()
+
+    def _head_object(self, data: bytes):
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(data)))
+        self.send_header("ETag", '"mock"')
+        self.send_header("Last-Modified", "Thu, 01 Jan 1970 00:00:00 GMT")
+        self.send_header("Accept-Ranges", "bytes")
+        self.end_headers()
+
+    def do_GET(self):
+        bucket, key, q = self._split()
+        with self.state.lock:
+            b = self.state.buckets.get(bucket)
+            if b is None:
+                return self._not_found("NoSuchBucket")
+            if not key:  # ListObjectsV2
+                prefix = q.get("prefix", [""])[0]
+                delim = q.get("delimiter", [""])[0]
+                keys = sorted(k for k in b if k.startswith(prefix))
+                contents, prefixes = [], set()
+                for k in keys:
+                    if delim:
+                        rest = k[len(prefix):]
+                        if delim in rest:
+                            prefixes.add(prefix + rest.split(delim)[0] + delim)
+                            continue
+                    contents.append(k)
+                items = "".join(
+                    f"<Contents><Key>{k}</Key><Size>{len(b[k])}</Size>"
+                    "<LastModified>1970-01-01T00:00:00.000Z</LastModified>"
+                    '<ETag>"mock"</ETag></Contents>'
+                    for k in contents
+                )
+                cps = "".join(
+                    f"<CommonPrefixes><Prefix>{p}</Prefix></CommonPrefixes>"
+                    for p in sorted(prefixes)
+                )
+                body = _xml(
+                    "<ListBucketResult>"
+                    f"<Name>{bucket}</Name><Prefix>{prefix}</Prefix>"
+                    f"<KeyCount>{len(contents) + len(prefixes)}</KeyCount>"
+                    f"<IsTruncated>false</IsTruncated>{items}{cps}"
+                    "</ListBucketResult>"
+                )
+                return self._reply(
+                    200, body, headers={"Content-Type": "application/xml"}
+                )
+            data = b.get(key)
+            if data is None:
+                return self._not_found()
+            rng = self.headers.get("Range")
+            if rng and rng.startswith("bytes="):
+                lo_s, _, hi_s = rng[len("bytes="):].partition("-")
+                lo = int(lo_s)
+                hi = int(hi_s) if hi_s else len(data) - 1
+                part = data[lo : hi + 1]
+                return self._reply(
+                    206,
+                    part,
+                    headers={
+                        "Content-Range": f"bytes {lo}-{lo+len(part)-1}/{len(data)}",
+                        "ETag": '"mock"',
+                        "Accept-Ranges": "bytes",
+                    },
+                )
+            return self._reply(
+                200, data, headers={"ETag": '"mock"', "Accept-Ranges": "bytes"}
+            )
+
+    def do_PUT(self):
+        bucket, key, q = self._split()
+        body = self._read_body()
+        with self.state.lock:
+            if not key:  # CreateBucket
+                self.state.buckets.setdefault(bucket, {})
+                return self._reply(200)
+            b = self.state.buckets.setdefault(bucket, {})
+            if "partNumber" in q and "uploadId" in q:
+                uid = q["uploadId"][0]
+                self.state.uploads.setdefault(uid, {})[
+                    int(q["partNumber"][0])
+                ] = body
+                return self._reply(200, headers={"ETag": '"mock-part"'})
+            b[key] = body
+            return self._reply(200, headers={"ETag": '"mock"'})
+
+    def do_POST(self):
+        bucket, key, q = self._split()
+        body = self._read_body()
+        with self.state.lock:
+            if "uploads" in q:  # CreateMultipartUpload
+                self.state._next_upload += 1
+                uid = f"upload-{self.state._next_upload}"
+                self.state.uploads[uid] = {}
+                return self._reply(
+                    200,
+                    _xml(
+                        "<InitiateMultipartUploadResult>"
+                        f"<Bucket>{bucket}</Bucket><Key>{key}</Key>"
+                        f"<UploadId>{uid}</UploadId>"
+                        "</InitiateMultipartUploadResult>"
+                    ),
+                    headers={"Content-Type": "application/xml"},
+                )
+            if "uploadId" in q:  # CompleteMultipartUpload
+                uid = q["uploadId"][0]
+                parts = self.state.uploads.pop(uid, {})
+                data = b"".join(parts[i] for i in sorted(parts))
+                self.state.buckets.setdefault(bucket, {})[key] = data
+                return self._reply(
+                    200,
+                    _xml(
+                        "<CompleteMultipartUploadResult>"
+                        f"<Bucket>{bucket}</Bucket><Key>{key}</Key>"
+                        '<ETag>"mock"</ETag>'
+                        "</CompleteMultipartUploadResult>"
+                    ),
+                    headers={"Content-Type": "application/xml"},
+                )
+            if "delete" in q:  # DeleteObjects (batch)
+                import re
+
+                b = self.state.buckets.setdefault(bucket, {})
+                deleted = []
+                for m in re.finditer(rb"<Key>([^<]+)</Key>", body):
+                    k = unquote(m.group(1).decode())
+                    b.pop(k, None)
+                    deleted.append(k)
+                return self._reply(
+                    200,
+                    _xml(
+                        "<DeleteResult>"
+                        + "".join(
+                            f"<Deleted><Key>{k}</Key></Deleted>" for k in deleted
+                        )
+                        + "</DeleteResult>"
+                    ),
+                    headers={"Content-Type": "application/xml"},
+                )
+        self._reply(400)
+
+    def do_DELETE(self):
+        bucket, key, q = self._split()
+        with self.state.lock:
+            if "uploadId" in q:
+                self.state.uploads.pop(q["uploadId"][0], None)
+                return self._reply(204)
+            b = self.state.buckets.get(bucket)
+            if b is None:
+                return self._not_found("NoSuchBucket")
+            if not key:
+                self.state.buckets.pop(bucket, None)
+                return self._reply(204)
+            b.pop(key, None)
+            return self._reply(204)
+
+
+class MockS3Server:
+    """Start with `with MockS3Server() as srv:`; srv.endpoint is the
+    http://host:port to point S3 clients at."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        state = _S3State()
+        handler = type("BoundHandler", (_Handler,), {"state": state})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.state = state
+        self.endpoint = f"http://{host}:{self.httpd.server_address[1]}"
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    def create_bucket(self, name: str) -> None:
+        with self.state.lock:
+            self.state.buckets.setdefault(name, {})

@@ -1,0 +1,132 @@
+"""Remote-storage URIs for object spilling and train checkpoints, against an
+in-process mock S3 server (reference pattern:
+python/ray/tests/mock_s3_server.py + test_object_spilling remote-storage
+cases + train/_internal/storage.py pyarrow.fs persistence)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+from mock_s3_server import MockS3Server  # noqa: E402
+
+import ray_tpu  # noqa: E402
+
+
+@pytest.fixture
+def mock_s3(monkeypatch):
+    with MockS3Server() as srv:
+        monkeypatch.setenv("AWS_ENDPOINT_URL", srv.endpoint)
+        monkeypatch.setenv("AWS_ACCESS_KEY_ID", "mock")
+        monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "mock")
+        monkeypatch.setenv("AWS_DEFAULT_REGION", "us-east-1")
+        srv.create_bucket("bucket")
+        yield srv
+
+
+def test_spill_to_s3_roundtrip(mock_s3, monkeypatch, shutdown_only):
+    """Objects spilled under memory pressure land in the S3 bucket and
+    restore with contents intact."""
+    monkeypatch.setenv(
+        "RAY_TPU_OBJECT_SPILLING_CONFIG",
+        '{"type": "uri", "params": {"uri": "s3://bucket/spill"}}',
+    )
+    arena = 64 * 1024 * 1024
+    obj = 8 * 1024 * 1024
+    ray_tpu.init(num_cpus=2, num_tpus=0, object_store_memory=arena)
+    n = 2 * arena // obj  # 2x the arena forces spilling
+    refs = []
+    for i in range(n):
+        refs.append(ray_tpu.put(np.full(obj // 8, i, dtype=np.float64)))
+    # Something actually went to the bucket.
+    with mock_s3.state.lock:
+        spilled_keys = [
+            k for k in mock_s3.state.buckets["bucket"] if k.startswith("spill/")
+        ]
+    assert spilled_keys, "no objects were spilled to s3://bucket/spill"
+    # Everything restores intact (cold objects pull back from S3).
+    for i, ref in enumerate(refs):
+        out = ray_tpu.get(ref, timeout=120)
+        assert out[0] == i and out[-1] == i and out.shape == (obj // 8,)
+
+
+def test_checkpoint_to_s3_and_resume(mock_s3, shutdown_only, tmp_path):
+    """JaxTrainer persists checkpoints to an s3:// storage path; a second
+    run resumes from the S3 checkpoint."""
+    import json
+
+    from ray_tpu import train
+    from ray_tpu.air import RunConfig, ScalingConfig
+    from ray_tpu.train import Checkpoint
+    from ray_tpu.train.jax import JaxTrainer
+
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+
+    def train_fn(config):
+        import json as _json
+        import os as _os
+        import tempfile
+
+        start = 0
+        ckpt = train.get_checkpoint()
+        if ckpt:
+            with ckpt.as_directory() as d:
+                start = _json.load(
+                    open(_os.path.join(d, "state.json"))
+                )["step"] + 1
+        for i in range(start, start + 2):
+            with tempfile.TemporaryDirectory() as d:
+                _json.dump(
+                    {"step": i}, open(_os.path.join(d, "state.json"), "w")
+                )
+                train.report(
+                    {"step": i}, checkpoint=Checkpoint.from_directory(d)
+                )
+
+    r1 = JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="s3run", storage_path="s3://bucket/results"),
+    ).fit()
+    assert r1.error is None
+    assert r1.checkpoint is not None
+    assert r1.checkpoint.path.startswith("s3://bucket/results/s3run")
+    # The files are really in the bucket.
+    with mock_s3.state.lock:
+        keys = [
+            k for k in mock_s3.state.buckets["bucket"]
+            if k.startswith("results/s3run") and k.endswith("state.json")
+        ]
+    assert keys, "checkpoint files not found in the mock bucket"
+    # Materialize from S3 and read back.
+    with r1.checkpoint.as_directory() as d:
+        assert json.load(open(os.path.join(d, "state.json")))["step"] == 1
+
+    # Resume: steps continue from the persisted S3 checkpoint.
+    r2 = JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="s3run2", storage_path="s3://bucket/results"),
+        resume_from_checkpoint=r1.checkpoint,
+    ).fit()
+    assert r2.error is None
+    assert [m["step"] for m in r2.metrics_history] == [2, 3]
+
+
+def test_uri_storage_local_file_scheme(tmp_path):
+    """The same uri backend covers plain filesystem URIs (NFS-style)."""
+    from ray_tpu._private.external_storage import UriStorage
+
+    store = UriStorage(f"file://{tmp_path}/spill", namespace="nodeA")
+    payload = np.arange(1000, dtype=np.int64).tobytes()
+    uri = store.spill("oid1", memoryview(payload))
+    dest = bytearray(len(payload))
+    n = store.restore(uri, memoryview(dest))
+    assert n == len(payload) and bytes(dest) == payload
+    store.delete(uri)
+    dest2 = bytearray(len(payload))
+    with pytest.raises(Exception):
+        store.restore(uri, memoryview(dest2))
+    store.destroy()

@@ -66,8 +66,56 @@ class Autoscaler:
 
     # -- scaling decisions ---------------------------------------------------
 
+    def _reconcile_provider(self) -> int:
+        """Advance the provider's node state machine and repair tracked
+        gangs with FAILED members (a TPU slice is only usable whole, so a
+        lost host is re-created in place — reference: GCP provider node
+        status handling + slice-gang repair). Returns replacements made."""
+        poll = getattr(self.provider, "poll", None)
+        if poll is None:
+            return 0
+        poll()
+        failed = set(getattr(self.provider, "failed_nodes", lambda: [])())
+        if not failed:
+            return 0
+        repaired = 0
+        forget = getattr(self.provider, "forget_node", lambda _p: None)
+        for t in self._tracked.values():
+            for i, pid in enumerate(list(t.provider_node_ids)):
+                if pid not in failed:
+                    continue
+                # Create the replacement FIRST: if it fails, the pid stays
+                # FAILED and tracked so the next round retries the repair.
+                try:
+                    new_pid = self.provider.create_node(t.node_type)
+                except Exception:
+                    logger.exception(
+                        "gang repair: re-create of %s (%s) failed; will retry",
+                        pid, t.node_type,
+                    )
+                    continue
+                # A FAILED node may still EXIST in GCE (STOPPED/PREEMPTED) —
+                # delete it so it doesn't bill as an untracked orphan. On
+                # success the provider's TERMINATING -> poll path drops the
+                # record once GCE confirms; on failure forget it from the
+                # provider (it is out of the gang now) with a loud warning.
+                if self.provider.terminate_node(pid) is False:
+                    forget(pid)
+                    logger.error(
+                        "gang repair: could not delete failed node %s — it "
+                        "may still exist (and bill) in GCE; clean up "
+                        "manually", pid
+                    )
+                t.provider_node_ids[i] = new_pid
+                repaired += 1
+                logger.warning(
+                    "gang repair: replaced failed node %s with %s", pid, new_pid
+                )
+        return repaired
+
     def update(self) -> Dict[str, int]:
         """One reconcile round; returns {"launched": n, "terminated": m}."""
+        self._reconcile_provider()
         pending, stats = self._cluster_state()
         now = time.monotonic()
         launched = terminated = 0
@@ -129,10 +177,21 @@ class Autoscaler:
                 # A TPU pod slice is one failure/billing domain: its hosts
                 # terminate together (reference: TPU pod scale-down removes
                 # whole replicas, never individual slice hosts).
+                all_ok = True
                 for pid in t.provider_node_ids:
-                    self.provider.terminate_node(pid)
-                    terminated += 1
-                del self._tracked[key]
+                    if self.provider.terminate_node(pid) is False:
+                        all_ok = False
+                    else:
+                        terminated += 1
+                if all_ok:
+                    del self._tracked[key]
+                else:
+                    # Keep the tracker so the next idle round retries the
+                    # failed deletes (terminate_node returning False keeps
+                    # the node alive provider-side too).
+                    logger.warning(
+                        "downscale of %s incomplete; will retry", t.node_type
+                    )
         return {"launched": launched, "terminated": terminated}
 
     def _count(self, node_type: str) -> int:

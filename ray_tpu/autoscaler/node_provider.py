@@ -70,11 +70,27 @@ class FakeNodeProvider(NodeProvider):
         return getattr(node, "node_id", None) if node is not None else None
 
 
+# GCE TPU-VM node lifecycle states (reference: the GCP API's node states,
+# gcp/node_provider.py _get_node status handling).
+REQUESTED = "REQUESTED"  # create issued, no describe yet
+PROVISIONING = "PROVISIONING"  # GCE reports CREATING
+READY = "READY"
+TERMINATING = "TERMINATING"  # delete issued, awaiting disappearance
+FAILED = "FAILED"  # create exhausted retries / node vanished
+
+
+class NodeCreateError(RuntimeError):
+    pass
+
+
 class GCETPUNodeProvider(NodeProvider):
-    """TPU-VM provider: constructs the gcloud commands for node lifecycle
-    (reference: autoscaler/_private/gcp/ + tpu pod handling). Command
-    execution is injectable so air-gapped tests can assert on the exact
-    invocations without network access."""
+    """TPU-VM provider with a real node state machine (reference:
+    autoscaler/_private/gcp/node_provider.py + TPU pod handling): creates
+    are issued async and retried on failure; poll() advances nodes through
+    REQUESTED -> PROVISIONING -> READY by describing them, and confirms
+    TERMINATING nodes actually disappeared. Command execution is injectable
+    (runner(argv) -> stdout, raising on nonzero exit) so tests drive the
+    lifecycle through a fake gcloud that models delays and failures."""
 
     def __init__(
         self,
@@ -84,6 +100,7 @@ class GCETPUNodeProvider(NodeProvider):
         runtime_version: str = "tpu-ubuntu2204-base",
         node_types: Optional[Dict[str, dict]] = None,
         runner=None,
+        create_retries: int = 3,
     ):
         super().__init__(node_types)
         self.project = project
@@ -91,7 +108,9 @@ class GCETPUNodeProvider(NodeProvider):
         self.accelerator_type = accelerator_type
         self.runtime_version = runtime_version
         self._runner = runner or self._default_runner
-        self._nodes: Dict[str, str] = {}
+        self.create_retries = create_retries
+        # name -> {"state", "node_type", "create_attempts"}
+        self._nodes: Dict[str, Dict[str, Any]] = {}
 
     @staticmethod
     def _default_runner(cmd: List[str]) -> str:
@@ -99,30 +118,148 @@ class GCETPUNodeProvider(NodeProvider):
 
         return subprocess.check_output(cmd, text=True)
 
+    # -- gcloud argv ---------------------------------------------------------
+
+    def _scope(self) -> List[str]:
+        return [f"--project={self.project}", f"--zone={self.zone}"]
+
     def _create_cmd(self, name: str) -> List[str]:
         return [
             "gcloud", "compute", "tpus", "tpu-vm", "create", name,
-            f"--project={self.project}",
-            f"--zone={self.zone}",
+            *self._scope(),
             f"--accelerator-type={self.accelerator_type}",
             f"--version={self.runtime_version}",
+            "--async",
         ]
 
     def _delete_cmd(self, name: str) -> List[str]:
         return [
             "gcloud", "compute", "tpus", "tpu-vm", "delete", name,
-            f"--project={self.project}", f"--zone={self.zone}", "--quiet",
+            *self._scope(), "--quiet", "--async",
         ]
 
-    def create_node(self, node_type: str) -> str:
-        name = f"raytpu-{node_type}-{uuid.uuid4().hex[:8]}"
-        self._runner(self._create_cmd(name))
-        self._nodes[name] = node_type
-        return name
+    def _describe_cmd(self, name: str) -> List[str]:
+        return [
+            "gcloud", "compute", "tpus", "tpu-vm", "describe", name,
+            *self._scope(), "--format=value(state)",
+        ]
 
-    def terminate_node(self, provider_node_id: str) -> None:
-        self._runner(self._delete_cmd(provider_node_id))
-        self._nodes.pop(provider_node_id, None)
+    # -- lifecycle -----------------------------------------------------------
+
+    def create_node(self, node_type: str) -> str:
+        """Issue an async create; retries transient gcloud failures with the
+        same name so a half-created node is adopted, not duplicated."""
+        name = f"raytpu-{node_type}-{uuid.uuid4().hex[:8]}"
+        attempts = 0
+        last_err: Optional[Exception] = None
+        while attempts < self.create_retries:
+            attempts += 1
+            try:
+                self._runner(self._create_cmd(name))
+                self._nodes[name] = {
+                    "state": REQUESTED,
+                    "node_type": node_type,
+                    "create_attempts": attempts,
+                    "describe_misses": 0,
+                }
+                return name
+            except Exception as e:  # subprocess.CalledProcessError and kin
+                msg = " ".join(
+                    str(x) for x in (getattr(e, "output", ""), e)
+                ).lower()
+                if "already exists" in msg or "alreadyexists" in msg:
+                    # A prior attempt was accepted server-side even though
+                    # the client errored: adopt the node instead of burning
+                    # retries on a non-transient error.
+                    self._nodes[name] = {
+                        "state": REQUESTED,
+                        "node_type": node_type,
+                        "create_attempts": attempts,
+                        "describe_misses": 0,
+                    }
+                    return name
+                last_err = e
+                logger.warning(
+                    "tpu-vm create %s attempt %d/%d failed: %r",
+                    name, attempts, self.create_retries, e,
+                )
+        raise NodeCreateError(
+            f"tpu-vm create {name} failed after {attempts} attempts"
+        ) from last_err
+
+    def terminate_node(self, provider_node_id: str) -> bool:
+        """Issue an async delete. Returns False on a gcloud failure — the
+        node stays tracked in its current state so the caller can retry."""
+        info = self._nodes.get(provider_node_id)
+        if info is None or info["state"] == TERMINATING:
+            return True  # already gone / already deleting: retry is a no-op
+        try:
+            self._runner(self._delete_cmd(provider_node_id))
+        except Exception as e:
+            logger.warning("tpu-vm delete %s failed: %r", provider_node_id, e)
+            return False
+        if info is not None:
+            info["state"] = TERMINATING
+        return True
+
+    def poll(self) -> None:
+        """Advance the state machine by describing in-flight nodes
+        (REQUESTED/PROVISIONING move toward READY; TERMINATING nodes are
+        dropped once GCE stops reporting them; vanished nodes fail)."""
+        for name, info in list(self._nodes.items()):
+            state = info["state"]
+            if state in (READY, FAILED):
+                # READY needs no polling; FAILED is terminal (repair or
+                # teardown decides its fate — re-describing it every round
+                # costs a gcloud call and can flap behind our back).
+                continue
+            try:
+                out = self._runner(self._describe_cmd(name)).strip().upper()
+            except Exception:
+                if state == TERMINATING:
+                    del self._nodes[name]  # gone, as requested
+                    continue
+                # --async creates may not be describable immediately;
+                # tolerate a few misses before declaring the node lost.
+                info["describe_misses"] = info.get("describe_misses", 0) + 1
+                if info["describe_misses"] > 3:
+                    info["state"] = FAILED
+                    logger.warning(
+                        "tpu-vm %s vanished (describe failed %d times)",
+                        name, info["describe_misses"],
+                    )
+                continue
+            info["describe_misses"] = 0
+            if state == TERMINATING:
+                continue  # still deleting
+            if out == "READY":
+                info["state"] = READY
+            elif out in ("CREATING", "STARTING", "RESTARTING", ""):
+                info["state"] = PROVISIONING
+            elif out in ("STOPPED", "STOPPING", "DELETING", "PREEMPTED"):
+                info["state"] = FAILED
+
+    def node_state(self, provider_node_id: str) -> Optional[str]:
+        info = self._nodes.get(provider_node_id)
+        return info["state"] if info else None
 
     def non_terminated_nodes(self) -> List[str]:
-        return list(self._nodes)
+        return [
+            n
+            for n, info in self._nodes.items()
+            if info["state"] not in (TERMINATING, FAILED)
+        ]
+
+    def ready_nodes(self) -> List[str]:
+        return [
+            n for n, info in self._nodes.items() if info["state"] == READY
+        ]
+
+    def failed_nodes(self) -> List[str]:
+        return [
+            n for n, info in self._nodes.items() if info["state"] == FAILED
+        ]
+
+    def forget_node(self, provider_node_id: str) -> None:
+        """Drop a FAILED node from tracking (after gang repair)."""
+        self._nodes.pop(provider_node_id, None)

@@ -1,0 +1,263 @@
+"""GCETPUNodeProvider state machine + autoscaler slice-gang e2e over a fake
+gcloud (reference: autoscaler/_private/gcp/node_provider.py tested via
+fake_multi_node-style injection)."""
+
+import subprocess
+
+import pytest
+
+from ray_tpu.autoscaler import Autoscaler, AutoscalerConfig
+from ray_tpu.autoscaler.node_provider import (
+    FAILED,
+    PROVISIONING,
+    READY,
+    REQUESTED,
+    TERMINATING,
+    GCETPUNodeProvider,
+    NodeCreateError,
+)
+
+
+class FakeGcloud:
+    """Models gcloud tpu-vm lifecycle: async creates take `provision_polls`
+    describes to reach READY; deletes disappear after one describe; creates
+    can be told to fail N times (transient) or specific nodes can be made to
+    vanish mid-provision."""
+
+    def __init__(self, provision_polls: int = 2):
+        self.provision_polls = provision_polls
+        self.nodes = {}  # name -> {"state", "polls_left"}
+        self.calls = []
+        self.fail_next_creates = 0
+
+    def __call__(self, cmd):
+        self.calls.append(cmd)
+        verb = cmd[4]  # gcloud compute tpus tpu-vm <verb> <name> ...
+        name = cmd[5]
+        if verb == "create":
+            if self.fail_next_creates > 0:
+                self.fail_next_creates -= 1
+                raise subprocess.CalledProcessError(1, cmd, "quota exceeded")
+            self.nodes[name] = {
+                "state": "CREATING",
+                "polls_left": self.provision_polls,
+            }
+            return ""
+        if verb == "delete":
+            if name in self.nodes:
+                self.nodes[name]["state"] = "DELETING"
+                self.nodes[name]["polls_left"] = 1
+            return ""
+        if verb == "describe":
+            info = self.nodes.get(name)
+            if info is None:
+                raise subprocess.CalledProcessError(1, cmd, "NOT_FOUND")
+            if info["state"] == "DELETING":
+                info["polls_left"] -= 1
+                if info["polls_left"] < 0:
+                    del self.nodes[name]
+                    raise subprocess.CalledProcessError(1, cmd, "NOT_FOUND")
+                return "DELETING"  # pre-deletion describe still answers
+            if info["state"] == "CREATING":
+                info["polls_left"] -= 1
+                if info["polls_left"] <= 0:
+                    info["state"] = "READY"
+                return info["state"] if info["state"] == "READY" else "CREATING"
+            return info["state"]
+        raise AssertionError(f"unexpected gcloud verb {verb}")
+
+    def vanish(self, name):
+        self.nodes.pop(name, None)
+
+
+def _provider(gcloud, **node_types):
+    return GCETPUNodeProvider(
+        project="proj",
+        zone="us-central2-b",
+        accelerator_type="v5litepod-8",
+        node_types=node_types or None,
+        runner=gcloud,
+    )
+
+
+def test_state_machine_provision_and_terminate():
+    g = FakeGcloud(provision_polls=2)
+    p = _provider(g)
+    pid = p.create_node("worker")
+    assert p.node_state(pid) == REQUESTED
+    p.poll()
+    assert p.node_state(pid) == PROVISIONING
+    p.poll()
+    assert p.node_state(pid) == READY
+    assert p.ready_nodes() == [pid]
+    p.terminate_node(pid)
+    assert p.node_state(pid) == TERMINATING
+    assert p.non_terminated_nodes() == []
+    p.poll()  # DELETING still answering
+    p.poll()  # NOT_FOUND -> dropped
+    assert p.node_state(pid) is None
+
+
+def test_create_retries_transient_failures():
+    g = FakeGcloud()
+    g.fail_next_creates = 2
+    p = _provider(g)
+    pid = p.create_node("worker")  # third attempt succeeds
+    assert p._nodes[pid]["create_attempts"] == 3
+    create_calls = [c for c in g.calls if c[4] == "create"]
+    assert len(create_calls) == 3
+    # All retries reuse the SAME name (no duplicate half-created nodes).
+    assert len({c[5] for c in create_calls}) == 1
+
+
+def test_create_fails_after_exhausting_retries():
+    g = FakeGcloud()
+    g.fail_next_creates = 99
+    p = _provider(g)
+    with pytest.raises(NodeCreateError):
+        p.create_node("worker")
+    assert p.non_terminated_nodes() == []
+
+
+def test_vanished_node_marked_failed_after_grace():
+    g = FakeGcloud(provision_polls=10)
+    p = _provider(g)
+    pid = p.create_node("worker")
+    p.poll()
+    g.vanish(pid)
+    # --async creates may lag visibility: a few describe misses are
+    # tolerated before the node is declared lost.
+    for _ in range(3):
+        p.poll()
+        assert p.node_state(pid) == PROVISIONING
+    p.poll()
+    assert p.node_state(pid) == FAILED
+    assert p.failed_nodes() == [pid]
+    assert pid not in p.non_terminated_nodes()
+    # FAILED is terminal: no more gcloud describes are spent on it.
+    before = len([c for c in g.calls if c[4] == "describe"])
+    p.poll()
+    after = len([c for c in g.calls if c[4] == "describe"])
+    assert before == after
+
+
+def test_create_adopts_already_exists():
+    g = FakeGcloud()
+
+    real = g.__call__
+
+    def flaky(cmd):
+        if cmd[4] == "create":
+            real(cmd)  # server-side acceptance...
+            raise subprocess.CalledProcessError(
+                1, cmd, "ERROR: resource already exists"
+            )  # ...but the client errors
+        return real(cmd)
+
+    p = GCETPUNodeProvider(
+        project="p", zone="z", runner=flaky, create_retries=3
+    )
+    pid = p.create_node("worker")
+    assert p.node_state(pid) == REQUESTED  # adopted, not failed
+    assert len([c for c in g.calls if c[4] == "create"]) == 1
+
+
+def test_terminate_failure_keeps_tracker_for_retry():
+    g = FakeGcloud(provision_polls=0)
+    p = _provider(g)
+    pid = p.create_node("worker")
+    p.poll()
+    real = g.__call__
+    fail_delete = {"on": True}
+
+    def flaky(cmd):
+        if cmd[4] == "delete" and fail_delete["on"]:
+            raise subprocess.CalledProcessError(1, cmd, "backend error")
+        return real(cmd)
+
+    p._runner = flaky
+    assert p.terminate_node(pid) is False
+    assert p.node_state(pid) == READY  # unchanged; still tracked
+    fail_delete["on"] = False
+    assert p.terminate_node(pid) is True
+    assert p.node_state(pid) == TERMINATING
+    # Idempotent retry while deleting is a cheap no-op success.
+    assert p.terminate_node(pid) is True
+
+
+def test_autoscaler_scales_slice_gang_up_and_down(monkeypatch):
+    """E2E against the fake gcloud: gang demand launches a whole 2-host
+    slice, a host lost mid-provision is repaired in place, the slice reaches
+    READY, and idle timeout terminates the gang together."""
+    g = FakeGcloud(provision_polls=1)
+    p = _provider(
+        g,
+        v5e_slice={
+            "resources": {"TPU": 4.0, "TPU-v5litepod-8-head": 1.0},
+            "tpu_pod_slice": "v5litepod-8",
+            "workers_per_slice": 2,
+            "min_workers": 0,
+            "max_workers": 4,
+        },
+    )
+    scaler = Autoscaler(
+        p, AutoscalerConfig(upscale_delay_s=0.0, idle_timeout_s=0.05)
+    )
+
+    demand = {"pending": 0, "demands": []}
+
+    def fake_state(self):
+        stats = [
+            {
+                "node_id": "head",
+                "pending_leases": demand["pending"],
+                "pending_demand": demand["demands"],
+                "num_workers": 0,
+                "num_idle": 0,
+            }
+        ]
+        return demand["pending"], stats
+
+    monkeypatch.setattr(Autoscaler, "_cluster_state", fake_state)
+
+    from ray_tpu._private.common import RESOURCE_UNIT
+
+    # Gang demand appears: one lease wanting the slice-head resource.
+    demand["pending"] = 1
+    demand["demands"] = [
+        {"TPU-v5litepod-8-head": 1 * RESOURCE_UNIT, "TPU": 4 * RESOURCE_UNIT}
+    ]
+    # Demand must be sustained past the upscale delay: round 1 records it,
+    # round 2 launches.
+    launched_total = scaler.update()["launched"] + scaler.update()["launched"]
+    assert launched_total == 2, "whole slice gang must launch together"
+    launched = p.non_terminated_nodes()
+    assert len(launched) == 2
+
+    # One host dies mid-provision; after the describe-miss grace period a
+    # later round repairs it in place.
+    g.vanish(launched[0])
+    demand["pending"] = 0
+    demand["demands"] = []
+    for _ in range(5):  # 4 misses to FAILED + 1 repair round
+        scaler.update()
+    tracked = list(scaler._tracked.values())[0]
+    assert len(tracked.provider_node_ids) == 2
+    assert launched[0] not in tracked.provider_node_ids
+    assert launched[1] in tracked.provider_node_ids
+
+    # Subsequent polls bring the full gang to READY.
+    for _ in range(4):
+        p.poll()
+    assert len(p.ready_nodes()) == 2
+
+    # Idle long enough -> the whole slice terminates together.
+    import time
+
+    time.sleep(0.1)
+    out = scaler.update()
+    assert out["terminated"] == 2
+    for _ in range(4):
+        p.poll()
+    assert p.non_terminated_nodes() == []
+    assert not g.nodes, "fake gcloud still holds nodes after gang teardown"

@@ -2161,8 +2161,14 @@ class CoreWorker:
         concurrency_group: Optional[str] = None,
         prepared_args: Optional[tuple] = None,
     ) -> List[ObjectRef]:
+        if num_returns == "dynamic":
+            num_returns = -1
         task_id = fast_unique_hex()
-        return_ids = return_object_ids(task_id, num_returns)
+        # Dynamic (streaming-generator) calls have ONE return object whose
+        # value is the ObjectRefGenerator (same convention as submit_task).
+        return_ids = return_object_ids(
+            task_id, 1 if num_returns == -1 else num_returns
+        )
         args_blob, args_object = None, None
         if prepared_args is not None:
             payload, ref_pos, kw_refs, deps = prepared_args
@@ -2210,11 +2216,15 @@ class CoreWorker:
         concurrency_group: Optional[str] = None,
     ) -> Optional[List[ObjectRef]]:
         """Synchronous actor-call fast path (see try_submit_task_fast)."""
+        if num_returns == "dynamic":
+            num_returns = -1
         serialized, ref_pos, kw_refs, deps = self._prepare_args(args, kwargs)
         if serialized.total_size > config.max_direct_call_object_size:
             return None
         task_id = fast_unique_hex()
-        return_ids = return_object_ids(task_id, num_returns)
+        return_ids = return_object_ids(
+            task_id, 1 if num_returns == -1 else num_returns
+        )
         wire = self._actor_wire(
             actor_id, method_name, serialized.to_bytes(), None,
             ref_pos, kw_refs, deps, num_returns, return_ids, task_id,

@@ -30,6 +30,10 @@ from ray_tpu._private.core_worker import CoreWorker, ObjectRef
 
 logger = logging.getLogger(__name__)
 
+# Max unacked streamed generator items in flight to the owner (reference:
+# _generator_backpressure_num_objects).
+_GEN_BACKPRESSURE_WINDOW = 16
+
 
 class _ExecThread:
     """Dedicated execution thread with reply batching.
@@ -84,7 +88,7 @@ class _ExecThread:
                 "async_task": None,
             }
             try:
-                payload = ex._execute_sync(wire)
+                payload = ex._execute_sync(wire, conn)
             except BaseException as e:  # noqa: BLE001 - serialize any failure
                 if isinstance(e, SystemExit):
                     self.loop.call_soon_threadsafe(
@@ -214,7 +218,7 @@ class Executor:
             return
         self._fallback_async(conn, msgid, "PushTask", self.handle_push_task, p)
 
-    def _execute_sync(self, wire: dict):
+    def _execute_sync(self, wire: dict, conn=None):
         """Run one task/actor call on the exec thread; returns the reply
         payload. Slow aspects (ref args, plasma-resident args/returns) hop to
         the event loop via run_on_loop."""
@@ -262,12 +266,30 @@ class Executor:
         if num_returns == 0:
             reply = {"returns": []}
         elif num_returns == -1 and inspect.isgenerator(result):
-            dynamic = []
+            # Streaming generator on the exec thread: store + push each item
+            # as produced (same GeneratorItem protocol as the async path).
+            # Window of unacked pushes bounds the owner's buffering when the
+            # consumer is slower than the producer (reference:
+            # _generator_backpressure_num_objects).
+            idx = 0
+            inflight: list = []
             for item in result:
-                dynamic.extend(
-                    self._store_one_sync(self._dyn_oid(wire, len(dynamic)), item)
+                ret = self._store_one_sync(self._dyn_oid(wire, idx), item)
+                fut = asyncio.run_coroutine_threadsafe(
+                    self._send_generator_item(
+                        conn, wire["task_id"], idx, ret[0]
+                    ),
+                    exec_t.loop,
                 )
-            reply = {"dynamic": dynamic}
+                inflight.append(fut)
+                if len(inflight) >= _GEN_BACKPRESSURE_WINDOW:
+                    for f in inflight:
+                        f.result()  # acks double as flow-control tokens
+                    inflight = []
+                idx += 1
+            for f in inflight:
+                f.result()
+            reply = {"dynamic_count": idx}
         else:
             if num_returns == -1:
                 num_returns = 1
@@ -654,13 +676,13 @@ class Executor:
                 self._advance_seq(caller, seq)
             async with sem:
                 return await self._run_actor_method(
-                    wire, pool=self.cgroup_pools[group]
+                    wire, pool=self.cgroup_pools[group], conn=conn
                 )
         ordered = (self.actor_spec or {}).get("max_concurrency", 1) == 1
         if ordered and seq >= 0:
             await self._wait_my_turn(caller, seq)
         try:
-            return await self._run_actor_method(wire)
+            return await self._run_actor_method(wire, conn=conn)
         finally:
             if ordered and seq >= 0:
                 self._advance_seq(caller, seq)
@@ -682,7 +704,7 @@ class Executor:
             if not fut.done():
                 fut.set_result(None)
 
-    async def _run_actor_method(self, wire: dict, pool=None):
+    async def _run_actor_method(self, wire: dict, pool=None, conn=None):
         if pool is None:
             pool = self.pool
         try:
@@ -702,13 +724,67 @@ class Executor:
                 return {"returns": returns}
             method = getattr(self.actor_instance, wire["actor_method"])
             args, kwargs = await self.load_args(wire)
+            loop = asyncio.get_running_loop()
             if asyncio.iscoroutinefunction(method):
                 result = await method(*args, **kwargs)
             else:
-                loop = asyncio.get_running_loop()
                 result = await loop.run_in_executor(
                     pool, lambda: method(*args, **kwargs)
                 )
+            if (
+                wire["num_returns"] == -1
+                and conn is not None
+                and (inspect.isgenerator(result) or inspect.isasyncgen(result))
+            ):
+                # Streaming actor generator: items are stored and reported
+                # to the owner AS PRODUCED (GeneratorItem pushes), so the
+                # consumer's iteration overlaps this producer — same
+                # protocol as streaming task generators (reference:
+                # ReportGeneratorItemReturns for actor tasks).
+                idx = 0
+                if inspect.isasyncgen(result):
+                    async def _advance():
+                        try:
+                            return True, await result.__anext__()
+                        except StopAsyncIteration:
+                            return False, None
+                    advance = _advance
+                else:
+                    def _advance_sync():
+                        try:
+                            return True, next(result)
+                        except StopIteration:
+                            return False, None
+
+                    async def _advance():
+                        return await loop.run_in_executor(pool, _advance_sync)
+                    advance = _advance
+                inflight = []
+                while True:
+                    ok, item = await advance()
+                    if not ok:
+                        break
+                    ret = await self.store_returns(
+                        {"num_returns": 1,
+                         "return_ids": [self._dyn_oid(wire, idx)]},
+                        item,
+                    )
+                    # Acked delivery with a bounded window: a slow consumer
+                    # throttles the producer instead of the owner buffering
+                    # the whole stream (reference:
+                    # _generator_backpressure_num_objects).
+                    inflight.append(asyncio.ensure_future(
+                        self._send_generator_item(
+                            conn, wire["task_id"], idx, ret[0]
+                        )
+                    ))
+                    if len(inflight) >= _GEN_BACKPRESSURE_WINDOW:
+                        await asyncio.gather(*inflight)
+                        inflight = []
+                    idx += 1
+                if inflight:
+                    await asyncio.gather(*inflight)
+                return {"dynamic_count": idx}
             returns = await self.store_returns(wire, result)
             return {"returns": returns}
         except BaseException as e:  # noqa: BLE001
@@ -717,6 +793,13 @@ class Executor:
                 return {"error": self._error_payload(RuntimeError("actor exited"))}
             logger.info("actor method %s raised: %r", wire.get("actor_method"), e)
             return {"error": self._error_payload(e)}
+
+    async def _send_generator_item(self, conn, task_id: str, idx: int, ret: dict):
+        """One acked GeneratorItem delivery (the ack is the flow-control
+        token — a window of these bounds producer run-ahead)."""
+        return await conn.call(
+            "GeneratorItem", {"task_id": task_id, "index": idx, "ret": ret}
+        )
 
     async def handle_exit(self, conn, p):
         asyncio.get_running_loop().call_later(0.05, os._exit, 0)

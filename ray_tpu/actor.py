@@ -58,7 +58,7 @@ class ActorMethod:
                 num_returns=self._num_returns, max_task_retries=retries,
                 concurrency_group=self._concurrency_group,
             )
-            return refs[0] if self._num_returns == 1 else refs
+            return refs[0] if self._num_returns in (1, -1, "dynamic") else refs
         core = worker_mod._core()
         refs = core.try_submit_actor_task_fast(
             self._handle._actor_id,
@@ -82,7 +82,9 @@ class ActorMethod:
                     concurrency_group=self._concurrency_group,
                 )
             )
-        if self._num_returns == 1:
+        if self._num_returns in (1, -1, "dynamic"):
+            # Dynamic generator calls resolve through ONE ref whose value is
+            # the ObjectRefGenerator.
             return refs[0]
         return refs
 

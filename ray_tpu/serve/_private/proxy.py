@@ -115,38 +115,55 @@ class ProxyActor:
             "grpc_port": self._grpc_port,
         }
 
+    def _grpc_target(self, app: str):
+        """Resolve a ServeRequest.application to a deployment id string."""
+        for _, t in sorted(self._route_table.items()):
+            if not app or t["app"] == app:
+                return f"{t['app']}#{t['ingress']}"
+        return None
+
+    @staticmethod
+    def _encode_reply(result: Any):
+        """-> (payload bytes, content_type tag) for a ServeReply."""
+        if isinstance(result, bytes):
+            return result, "bytes"
+        if isinstance(result, str):
+            return result.encode(), "text"
+        try:
+            return json.dumps(result).encode(), "json"
+        except (TypeError, ValueError):
+            import cloudpickle
+
+            return cloudpickle.dumps(result), "pickle"
+
     async def _start_grpc(self) -> None:
-        """gRPC ingress (reference: serve's gRPC proxy, grpc_util.py +
-        gRPCOptions): a generic bytes-in/bytes-out unary service —
-        /ray_tpu.serve.GenericService/Predict — routed by invocation
-        metadata: ``application`` selects the app (default: any),
-        ``method`` the handler method, ``multiplexed_model_id`` rides
-        through to the replica context."""
+        """Typed gRPC ingress (reference: serve.proto RayServeAPIService):
+        /ray_tpu.serve.ServeAPIService/Predict (unary) and /PredictStreaming
+        (server-streaming), with ServeRequest carrying application, handler
+        method, multiplexed model id, and the payload."""
         import grpc
 
-        async def predict(request: bytes, context) -> bytes:
-            md = {k: v for k, v in (context.invocation_metadata() or ())}
-            app = md.get("application")
-            target = None
-            for _, t in sorted(self._route_table.items()):
-                if app is None or t["app"] == app:
-                    target = t
-                    break
-            if target is None:
+        from ray_tpu.serve.protobuf import (
+            ServeReply,
+            add_serve_api_servicer,
+        )
+
+        def _meta(request):
+            return {
+                "call_method": request.method or "__call__",
+                "multiplexed_model_id": request.multiplexed_model_id or None,
+            }
+
+        async def predict(request, context) -> "ServeReply":
+            dep_id_str = self._grpc_target(request.application)
+            if dep_id_str is None:
                 await context.abort(
                     grpc.StatusCode.NOT_FOUND,
-                    f"no serve application {app!r}",
+                    f"no serve application {request.application!r}",
                 )
-            dep_id_str = f"{target['app']}#{target['ingress']}"
             try:
                 result = await self._router.assign_request(
-                    dep_id_str,
-                    {
-                        "call_method": md.get("method", "__call__"),
-                        "multiplexed_model_id": md.get("multiplexed_model_id"),
-                    },
-                    (request,),
-                    {},
+                    dep_id_str, _meta(request), (request.payload,), {},
                     timeout_s=60.0,
                 )
             except TimeoutError as e:
@@ -155,20 +172,32 @@ class ProxyActor:
                 await context.abort(
                     grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}"
                 )
-            if isinstance(result, bytes):
-                return result
-            if isinstance(result, str):
-                return result.encode()
-            import cloudpickle
+            payload, ctype = self._encode_reply(result)
+            return ServeReply(payload=payload, content_type=ctype)
 
-            return cloudpickle.dumps(result)
+        async def predict_streaming(request, context):
+            dep_id_str = self._grpc_target(request.application)
+            if dep_id_str is None:
+                await context.abort(
+                    grpc.StatusCode.NOT_FOUND,
+                    f"no serve application {request.application!r}",
+                )
+            try:
+                async for item in self._router.assign_request_streaming(
+                    dep_id_str, _meta(request), (request.payload,), {},
+                    timeout_s=60.0,
+                ):
+                    payload, ctype = self._encode_reply(item)
+                    yield ServeReply(payload=payload, content_type=ctype)
+            except TimeoutError as e:
+                await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+            except Exception as e:
+                await context.abort(
+                    grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}"
+                )
 
-        handler = grpc.method_handlers_generic_handler(
-            "ray_tpu.serve.GenericService",
-            {"Predict": grpc.unary_unary_rpc_method_handler(predict)},
-        )
         self._grpc_server = grpc.aio.server()
-        self._grpc_server.add_generic_rpc_handlers((handler,))
+        add_serve_api_servicer(self._grpc_server, predict, predict_streaming)
         bound = self._grpc_server.add_insecure_port(
             f"{self._host}:{self._grpc_port}"
         )
@@ -214,21 +243,26 @@ class ProxyActor:
             headers=dict(request.headers),
             body=body,
         )
+        meta = {
+            "call_method": "__call__",
+            "is_http_request": True,
+            # Reference Serve convention: multiplexed model id rides
+            # an HTTP header.
+            "multiplexed_model_id": request.headers.get(
+                "serve_multiplexed_model_id", ""
+            ),
+        }
+        # Streaming response mode (reference: StreamingResponse from a
+        # generator deployment): strictly opt-in via header — Accept:
+        # text/event-stream is NOT honored because the body is raw chunks,
+        # not SSE framing, and would break EventSource clients.
+        if request.headers.get("serve-streaming"):
+            return await self._handle_streaming(
+                request, dep_id_str, meta, http_req
+            )
         try:
             result = await self._router.assign_request(
-                dep_id_str,
-                {
-                    "call_method": "__call__",
-                    "is_http_request": True,
-                    # Reference Serve convention: multiplexed model id rides
-                    # an HTTP header.
-                    "multiplexed_model_id": request.headers.get(
-                        "serve_multiplexed_model_id", ""
-                    ),
-                },
-                (http_req,),
-                {},
-                timeout_s=60.0,
+                dep_id_str, meta, (http_req,), {}, timeout_s=60.0
             )
         except TimeoutError as e:
             return web.Response(status=503, text=str(e))
@@ -237,6 +271,47 @@ class ProxyActor:
             return web.Response(status=500, text=f"{type(e).__name__}: {e}")
         status, payload, ctype = _to_response(result)
         return web.Response(status=status, body=payload, content_type=ctype.split(";")[0])
+
+    async def _handle_streaming(self, request, dep_id_str, meta, http_req):
+        """Chunked HTTP response: each item the replica's generator yields
+        is written as soon as it arrives (bytes as-is, str utf-8, other
+        values JSON + newline)."""
+        from aiohttp import web
+
+        resp = web.StreamResponse(
+            status=200,
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        started = False
+        try:
+            async for item in self._router.assign_request_streaming(
+                dep_id_str, meta, (http_req,), {}, timeout_s=60.0
+            ):
+                if not started:
+                    await resp.prepare(request)
+                    started = True
+                if isinstance(item, bytes):
+                    chunk = item
+                elif isinstance(item, str):
+                    chunk = item.encode()
+                else:
+                    chunk = json.dumps(item).encode() + b"\n"
+                await resp.write(chunk)
+        except TimeoutError as e:
+            if not started:
+                return web.Response(status=503, text=str(e))
+            raise  # mid-stream: the broken body tells the client
+        except Exception as e:
+            logger.warning("streaming request to %s failed: %r", dep_id_str, e)
+            if not started:
+                return web.Response(
+                    status=500, text=f"{type(e).__name__}: {e}"
+                )
+            raise
+        if not started:
+            await resp.prepare(request)
+        await resp.write_eof()
+        return resp
 
     async def check_health(self) -> bool:
         return True

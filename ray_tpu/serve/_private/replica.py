@@ -90,6 +90,71 @@ class Replica:
         finally:
             self._num_ongoing -= 1
 
+    def handle_request_streaming(
+        self, request_meta: Dict[str, Any], args: Tuple, kwargs: Dict
+    ):
+        """Streaming data plane: the user handler is a (possibly async)
+        generator; each yielded item is published through the runtime's
+        streaming-generator machinery as it is produced, so the proxy
+        forwards chunks while the replica is still generating (reference:
+        replica.py handle_request_streaming + ReportGeneratorItemReturns).
+
+        This is a SYNC generator actor method (invoked with
+        num_returns="dynamic"); it runs on the executor pool, pumping async
+        generators via the worker's event loop."""
+        self._num_ongoing += 1
+        self._total_served += 1
+        model_id = request_meta.get("multiplexed_model_id")
+
+        def _set_model_ctx():
+            # Each resume of this generator may land on a DIFFERENT executor
+            # thread (every next() is its own run_in_executor dispatch), so
+            # the contextvar must be re-set on the current thread before the
+            # user frame runs — a single set at creation time would be lost
+            # across hops and could leak onto unrelated requests.
+            if model_id:
+                from ray_tpu.serve import api as serve_api
+
+                serve_api._multiplexed_model_id_ctx.set(model_id)
+
+        try:
+            _set_model_ctx()
+            method_name = request_meta.get("call_method", "__call__")
+            method = getattr(self._user, method_name)
+            result = method(*args, **kwargs)
+            if inspect.isasyncgen(result):
+                from ray_tpu._private import worker as worker_mod
+
+                loop = worker_mod._core().loop
+
+                async def _anext():
+                    _set_model_ctx()
+                    return await result.__anext__()
+
+                while True:
+                    fut = asyncio.run_coroutine_threadsafe(_anext(), loop)
+                    try:
+                        yield fut.result()
+                    except StopAsyncIteration:
+                        break
+            elif inspect.isgenerator(result):
+                while True:
+                    _set_model_ctx()
+                    try:
+                        item = next(result)
+                    except StopIteration:
+                        break
+                    yield item
+            elif inspect.iscoroutine(result):
+                from ray_tpu._private import worker as worker_mod
+
+                loop = worker_mod._core().loop
+                yield asyncio.run_coroutine_threadsafe(result, loop).result()
+            else:
+                yield result
+        finally:
+            self._num_ongoing -= 1
+
     # -- control plane -------------------------------------------------------
 
     async def get_metrics(self) -> Dict[str, Any]:

@@ -140,15 +140,14 @@ class Router:
                 rs.model_affinity.pop(next(iter(rs.model_affinity)))
         return pick
 
-    async def assign_request(
+    async def _acquire_replica(
         self,
         deployment_id_str: str,
         request_meta: Dict[str, Any],
-        args: Tuple,
-        kwargs: Dict,
-        timeout_s: Optional[float] = None,
-    ) -> Any:
-        """Route one request and return its result value."""
+        timeout_s: Optional[float],
+    ):
+        """Pick a replica (pow-2 with backpressure waits); returns
+        (replica_set, replica) with NO ongoing-count taken yet."""
         self.watch(deployment_id_str)
         rs = self._replica_set(deployment_id_str)
         loop = asyncio.get_running_loop()
@@ -181,6 +180,20 @@ class Router:
                     raise TimeoutError(
                         f"backpressure timeout for {deployment_id_str}"
                     ) from None
+        return rs, replica
+
+    async def assign_request(
+        self,
+        deployment_id_str: str,
+        request_meta: Dict[str, Any],
+        args: Tuple,
+        kwargs: Dict,
+        timeout_s: Optional[float] = None,
+    ) -> Any:
+        """Route one request and return its result value."""
+        rs, replica = await self._acquire_replica(
+            deployment_id_str, request_meta, timeout_s
+        )
         rid = replica.replica_id_str
         rs.ongoing[rid] = rs.ongoing.get(rid, 0) + 1
         try:
@@ -192,6 +205,50 @@ class Router:
                 num_returns=1,
             )
             return await self._core.get_objects(refs[0], timeout=None)
+        finally:
+            rs.ongoing[rid] = max(0, rs.ongoing.get(rid, 1) - 1)
+            rs.slot_freed.set()
+
+    async def assign_request_streaming(
+        self,
+        deployment_id_str: str,
+        request_meta: Dict[str, Any],
+        args: Tuple,
+        kwargs: Dict,
+        timeout_s: Optional[float] = None,
+    ):
+        """Route one request to the streaming handler; async-yields each
+        item as the replica produces it (the runtime's streaming-generator
+        machinery carries items owner-ward while the replica still runs —
+        reference: router.py + replica.py handle_request_streaming)."""
+        rs, replica = await self._acquire_replica(
+            deployment_id_str, request_meta, timeout_s
+        )
+        rid = replica.replica_id_str
+        rs.ongoing[rid] = rs.ongoing.get(rid, 0) + 1
+        try:
+            refs = await self._core.submit_actor_task(
+                self._handle_for(rs, replica)._actor_id,
+                "handle_request_streaming",
+                (request_meta, args, kwargs),
+                {},
+                num_returns=-1,
+            )
+            gen = await self._core.get_objects(refs[0], timeout=None)
+            i = 0
+            while True:
+                if gen._refs is not None:  # fully-materialized legacy form
+                    if i >= len(gen._refs):
+                        break
+                    ref = gen._refs[i]
+                else:
+                    ref = await self._core.dyn_next(
+                        gen._task_id, gen._owner_addr, i
+                    )
+                    if ref is None:
+                        break
+                yield await self._core.get_objects(ref, timeout=None)
+                i += 1
         finally:
             rs.ongoing[rid] = max(0, rs.ongoing.get(rid, 1) - 1)
             rs.slot_freed.set()

@@ -117,9 +117,10 @@ def test_http_ingress(serve_cluster):
         _http_get(f"{base}/nope")
 
 
-def test_grpc_ingress(serve_cluster):
-    """gRPC proxy: generic bytes service routed by metadata (reference:
-    Serve gRPC ingress, gRPCOptions + grpc proxy)."""
+def test_grpc_ingress_typed(serve_cluster):
+    """Typed gRPC proxy: ServeAPIService with proto messages carrying the
+    application / method routing (reference: serve.proto
+    RayServeAPIService)."""
     serve = serve_cluster
     serve.start(http_options={"host": "127.0.0.1", "port": 0, "grpc_port": 0})
 
@@ -129,7 +130,7 @@ def test_grpc_ingress(serve_cluster):
             return b"echo:" + payload
 
         def shout(self, payload: bytes):
-            return payload.upper()
+            return payload.upper().decode()  # str -> content_type "text"
 
     serve.run(Echo.bind(), name="gapp", route_prefix="/gapp")
     import ray_tpu
@@ -140,22 +141,72 @@ def test_grpc_ingress(serve_cluster):
 
     import grpc
 
+    from ray_tpu.serve.protobuf import ServeAPIStub, ServeRequest
+
     chan = grpc.insecure_channel(f"127.0.0.1:{cfg['grpc_port']}")
-    predict = chan.unary_unary("/ray_tpu.serve.GenericService/Predict")
-    assert (
-        predict(b"hi", metadata=(("application", "gapp"),), timeout=30)
-        == b"echo:hi"
+    stub = ServeAPIStub(chan)
+    reply = stub.Predict(
+        ServeRequest(application="gapp", payload=b"hi"), timeout=30
     )
-    assert (
-        predict(
-            b"hi",
-            metadata=(("application", "gapp"), ("method", "shout")),
-            timeout=30,
-        )
-        == b"HI"
+    assert reply.payload == b"echo:hi" and reply.content_type == "bytes"
+    reply = stub.Predict(
+        ServeRequest(application="gapp", method="shout", payload=b"hi"),
+        timeout=30,
     )
+    assert reply.payload == b"HI" and reply.content_type == "text"
     with pytest.raises(grpc.RpcError):
-        predict(b"x", metadata=(("application", "nope"),), timeout=10)
+        stub.Predict(ServeRequest(application="nope", payload=b"x"), timeout=10)
+    chan.close()
+
+
+def test_streaming_responses_http_and_grpc(serve_cluster):
+    """Generator deployments stream: chunked HTTP body and server-streaming
+    gRPC, with items forwarded as the replica produces them (reference:
+    StreamingResponse + serve.proto streaming rpcs)."""
+    serve = serve_cluster
+    serve.start(http_options={"host": "127.0.0.1", "port": 0, "grpc_port": 0})
+
+    @serve.deployment
+    class StreamEcho:
+        def __call__(self, request):
+            # Works for both ingresses: HTTPRequest body or raw grpc bytes.
+            data = request.body if hasattr(request, "body") else request
+            for i in range(3):
+                yield b"chunk%d:%s;" % (i, data)
+
+    serve.run(StreamEcho.bind(), name="sapp", route_prefix="/sapp")
+    import ray_tpu
+
+    controller = ray_tpu.get_actor("SERVE_CONTROLLER", namespace="serve")
+    cfg = ray_tpu.get(controller.get_http_config.remote())
+
+    # HTTP chunked streaming (opt-in via header).
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{cfg['port']}/sapp",
+        data=b"hi",
+        headers={"serve-streaming": "1"},
+    )
+    body = urllib.request.urlopen(req, timeout=30).read()
+    assert body == b"chunk0:hi;chunk1:hi;chunk2:hi;"
+
+    # gRPC server-streaming.
+    import grpc
+
+    from ray_tpu.serve.protobuf import ServeAPIStub, ServeRequest
+
+    chan = grpc.insecure_channel(f"127.0.0.1:{cfg['grpc_port']}")
+    stub = ServeAPIStub(chan)
+    replies = list(
+        stub.PredictStreaming(
+            ServeRequest(application="sapp", payload=b"yo"), timeout=30
+        )
+    )
+    assert [r.payload for r in replies] == [
+        b"chunk0:yo;", b"chunk1:yo;", b"chunk2:yo;",
+    ]
+    assert all(r.content_type == "bytes" for r in replies)
     chan.close()
 
 

@@ -84,6 +84,9 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     # timings in the chrome timeline). Off by default like the reference's
     # RAY_PROFILING — it adds one GCS event per task.
     "task_profile_events": False,
+    # OTel-style task tracing spans with context propagation (reference:
+    # ray.init(_tracing_startup_hook) + tracing_helper.py). Off by default.
+    "task_trace_spans": False,
     # Push manager: max chunks in flight across ALL destination pushes from
     # one node (reference: push_manager.h max_chunks_in_flight). With 8 MiB
     # chunks the default bounds broadcast buffering at ~64 MiB.

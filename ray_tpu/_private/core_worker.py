@@ -1707,6 +1707,12 @@ class CoreWorker:
             "owner_addr": list(self.addr),
             "caller_id": self.worker_id,
         }
+        if config.task_trace_spans:
+            from ray_tpu.util import tracing
+
+            ctx = tracing.make_submit_ctx(self, task_id, name)
+            if ctx is not None:
+                wire["trace_ctx"] = ctx
         if args_object is not None:
             wire["args_object"] = args_object
         if ref_positions:
@@ -2122,7 +2128,7 @@ class CoreWorker:
         ref_pos, kw_refs, deps, num_returns, return_ids, task_id,
         max_task_retries=0, concurrency_group=None,
     ) -> dict:
-        return {
+        wire = {
             "task_id": task_id,
             "job_id": self.job_id,
             "name": method_name,
@@ -2149,6 +2155,13 @@ class CoreWorker:
             "runtime_env": None,
             "concurrency_group": concurrency_group,
         }
+        if config.task_trace_spans:
+            from ray_tpu.util import tracing
+
+            ctx = tracing.make_submit_ctx(self, task_id, method_name)
+            if ctx is not None:
+                wire["trace_ctx"] = ctx
+        return wire
 
     async def submit_actor_task(
         self,

@@ -27,6 +27,7 @@ import cloudpickle
 from ray_tpu._private import rpc, serialization
 from ray_tpu._private.common import TaskError, TaskSpec, config
 from ray_tpu._private.core_worker import CoreWorker, ObjectRef
+from ray_tpu.util import tracing
 
 logger = logging.getLogger(__name__)
 
@@ -247,25 +248,62 @@ class Executor:
         # -- execute
         renv = wire.get("runtime_env") or {}
         env_vars = renv.get("env_vars")
-        if env_vars:
-            from ray_tpu.runtime_env.context import scoped_env_vars
+        # Manual scope: it must stay open through the generator-drain branch
+        # below (a streaming task's body runs during iteration, not at
+        # fn() time), so nested submits keep the trace context and the
+        # execute span covers real execution. Gated on the wire key so the
+        # disabled case costs one dict lookup (this is the 10k+ tasks/s
+        # fast path).
+        trace_scope = (
+            tracing.execute_scope(core, wire) if "trace_ctx" in wire else None
+        )
+        if trace_scope is not None:
+            trace_scope.__enter__()
+        try:
+            if env_vars:
+                from ray_tpu.runtime_env.context import scoped_env_vars
 
-            with scoped_env_vars(env_vars):
-                result = (
-                    exec_t.run_on_loop(fn(*args, **kwargs))
-                    if asyncio.iscoroutinefunction(fn)
-                    else fn(*args, **kwargs)
-                )
-        elif asyncio.iscoroutinefunction(fn):
-            result = exec_t.run_on_loop(fn(*args, **kwargs))
-        else:
-            result = fn(*args, **kwargs)
-        t_exec = time.time()
-        # -- returns
+                with scoped_env_vars(env_vars):
+                    result = (
+                        exec_t.run_on_loop(fn(*args, **kwargs))
+                        if asyncio.iscoroutinefunction(fn)
+                        else fn(*args, **kwargs)
+                    )
+            elif asyncio.iscoroutinefunction(fn):
+                result = exec_t.run_on_loop(fn(*args, **kwargs))
+            else:
+                result = fn(*args, **kwargs)
+            t_exec = time.time()
+            # -- returns (inside the trace scope: generator bodies run here)
+            reply, t_exec = self._sync_returns(wire, result, conn, t_exec)
+        finally:
+            if trace_scope is not None:
+                trace_scope.__exit__(None, None, None)
+        if profile:
+            # Per-task phase spans (reference: worker profile events in the
+            # chrome timeline, RAY_PROFILING + profiling.py).
+            core.record_task_event(
+                wire["task_id"],
+                wire["name"],
+                "PROFILE",
+                start=t0,
+                phases={
+                    "deserialize_args": t_args - t0,
+                    "execute": t_exec - t_args,
+                    "store_returns": time.time() - t_exec,
+                },
+            )
+        return reply
+
+    def _sync_returns(self, wire: dict, result, conn, t_exec):
+        """Store the result(s) of an exec-thread call; returns (reply,
+        t_exec). Runs INSIDE the trace scope: a streaming generator's body
+        executes during the drain loop here, not at fn() time."""
+        exec_t = self._exec_thread
         num_returns = wire["num_returns"]
         if num_returns == 0:
-            reply = {"returns": []}
-        elif num_returns == -1 and inspect.isgenerator(result):
+            return {"returns": []}, t_exec
+        if num_returns == -1 and inspect.isgenerator(result):
             # Streaming generator on the exec thread: store + push each item
             # as produced (same GeneratorItem protocol as the async path).
             # Window of unacked pushes bounds the owner's buffering when the
@@ -289,35 +327,21 @@ class Executor:
                 idx += 1
             for f in inflight:
                 f.result()
-            reply = {"dynamic_count": idx}
-        else:
-            if num_returns == -1:
-                num_returns = 1
-            values = [result] if num_returns == 1 else list(result)
-            if num_returns != 1 and len(values) != num_returns:
-                raise ValueError(
-                    f"task declared num_returns={num_returns} but returned "
-                    f"{len(values)}"
-                )
-            out = []
-            for oid, value in zip(wire["return_ids"], values):
-                out.extend(self._store_one_sync(oid, value))
-            reply = {"returns": out}
-        if profile:
-            # Per-task phase spans (reference: worker profile events in the
-            # chrome timeline, RAY_PROFILING + profiling.py).
-            core.record_task_event(
-                wire["task_id"],
-                wire["name"],
-                "PROFILE",
-                start=t0,
-                phases={
-                    "deserialize_args": t_args - t0,
-                    "execute": t_exec - t_args,
-                    "store_returns": time.time() - t_exec,
-                },
+            # Generator execution IS the drain; restate t_exec so the
+            # PROFILE store_returns phase doesn't swallow it.
+            return {"dynamic_count": idx}, time.time()
+        if num_returns == -1:
+            num_returns = 1
+        values = [result] if num_returns == 1 else list(result)
+        if num_returns != 1 and len(values) != num_returns:
+            raise ValueError(
+                f"task declared num_returns={num_returns} but returned "
+                f"{len(values)}"
             )
-        return reply
+        out = []
+        for oid, value in zip(wire["return_ids"], values):
+            out.extend(self._store_one_sync(oid, value))
+        return {"returns": out}, t_exec
 
     def _store_one_sync(self, oid: str, value) -> list:
         serialized = serialization.serialize(value)
@@ -434,7 +458,10 @@ class Executor:
             t_args = time.time()
             from ray_tpu.runtime_env.context import scoped_env_vars
 
-            with scoped_env_vars(renv.get("env_vars")):
+            with scoped_env_vars(renv.get("env_vars")), tracing.execute_scope(
+                self.core, wire
+            ):
+                tctx = tracing.current_context()
                 if task_id in self.cancelled_tasks:
                     # Cancel arrived while args/function were being resolved.
                     self.cancelled_tasks.pop(task_id, None)
@@ -455,44 +482,58 @@ class Executor:
 
                             raise TaskCancelledError("task cancelled")
                         track["thread_id"] = threading.get_ident()
+                        # Trace context does not cross run_in_executor.
+                        tok = tracing.set_context(tctx)
                         try:
                             return fn(*args, **kwargs)
                         finally:
+                            tracing.reset_context(tok)
                             track["thread_id"] = None
 
                     result = await loop.run_in_executor(self.pool, run_tracked)
-            if wire["num_returns"] == -1 and inspect.isgenerator(result):
-                # Streaming generator: each yielded item is stored and
-                # reported to the owner AS PRODUCED, so the consumer's
-                # iteration overlaps this producer (reference:
-                # ReportGeneratorItemReturns, core_worker.proto). Each
-                # next() runs on the executor pool — user code must not
-                # block the worker loop.
-                idx = 0
-                loop = asyncio.get_running_loop()
+                if wire["num_returns"] == -1 and inspect.isgenerator(result):
+                    # Streaming generator: each yielded item is stored and
+                    # reported to the owner AS PRODUCED, so the consumer's
+                    # iteration overlaps this producer (reference:
+                    # ReportGeneratorItemReturns). Runs INSIDE the trace
+                    # scope: the generator body executes during this drain.
+                    # Acked window = flow control (see _GEN_BACKPRESSURE_WINDOW).
+                    idx = 0
+                    loop = asyncio.get_running_loop()
 
-                def _advance():
-                    try:
-                        return True, next(result)
-                    except StopIteration:
-                        return False, None
+                    def _advance():
+                        tok = tracing.set_context(tctx)
+                        try:
+                            return True, next(result)
+                        except StopIteration:
+                            return False, None
+                        finally:
+                            tracing.reset_context(tok)
 
-                while True:
-                    ok, item = await loop.run_in_executor(self.pool, _advance)
-                    if not ok:
-                        break
-                    ret = await self.store_returns(
-                        {"num_returns": 1, "return_ids": [self._dyn_oid(wire, idx)]},
-                        item,
-                    )
-                    conn.push_nowait(
-                        "GeneratorItem",
-                        {"task_id": wire["task_id"], "index": idx, "ret": ret[0]},
-                    )
-                    idx += 1
-                if profile:
-                    self._record_profile(wire, t0, t_args, t_args)
-                return {"dynamic_count": idx}
+                    inflight = []
+                    while True:
+                        ok, item = await loop.run_in_executor(self.pool, _advance)
+                        if not ok:
+                            break
+                        ret = await self.store_returns(
+                            {"num_returns": 1,
+                             "return_ids": [self._dyn_oid(wire, idx)]},
+                            item,
+                        )
+                        inflight.append(asyncio.ensure_future(
+                            self._send_generator_item(
+                                conn, wire["task_id"], idx, ret[0]
+                            )
+                        ))
+                        if len(inflight) >= _GEN_BACKPRESSURE_WINDOW:
+                            await asyncio.gather(*inflight)
+                            inflight = []
+                        idx += 1
+                    if inflight:
+                        await asyncio.gather(*inflight)
+                    if profile:
+                        self._record_profile(wire, t0, t_args, t_args)
+                    return {"dynamic_count": idx}
             t_exec = time.time()
             returns = await self.store_returns(wire, result)
             if profile:
@@ -725,66 +766,80 @@ class Executor:
             method = getattr(self.actor_instance, wire["actor_method"])
             args, kwargs = await self.load_args(wire)
             loop = asyncio.get_running_loop()
-            if asyncio.iscoroutinefunction(method):
-                result = await method(*args, **kwargs)
-            else:
-                result = await loop.run_in_executor(
-                    pool, lambda: method(*args, **kwargs)
-                )
-            if (
-                wire["num_returns"] == -1
-                and conn is not None
-                and (inspect.isgenerator(result) or inspect.isasyncgen(result))
-            ):
-                # Streaming actor generator: items are stored and reported
-                # to the owner AS PRODUCED (GeneratorItem pushes), so the
-                # consumer's iteration overlaps this producer — same
-                # protocol as streaming task generators (reference:
-                # ReportGeneratorItemReturns for actor tasks).
-                idx = 0
-                if inspect.isasyncgen(result):
-                    async def _advance():
-                        try:
-                            return True, await result.__anext__()
-                        except StopAsyncIteration:
-                            return False, None
-                    advance = _advance
-                else:
-                    def _advance_sync():
-                        try:
-                            return True, next(result)
-                        except StopIteration:
-                            return False, None
 
-                    async def _advance():
-                        return await loop.run_in_executor(pool, _advance_sync)
-                    advance = _advance
-                inflight = []
-                while True:
-                    ok, item = await advance()
-                    if not ok:
-                        break
-                    ret = await self.store_returns(
-                        {"num_returns": 1,
-                         "return_ids": [self._dyn_oid(wire, idx)]},
-                        item,
-                    )
-                    # Acked delivery with a bounded window: a slow consumer
-                    # throttles the producer instead of the owner buffering
-                    # the whole stream (reference:
-                    # _generator_backpressure_num_objects).
-                    inflight.append(asyncio.ensure_future(
-                        self._send_generator_item(
-                            conn, wire["task_id"], idx, ret[0]
+            with tracing.execute_scope(self.core, wire):
+                tctx = tracing.current_context()
+                if asyncio.iscoroutinefunction(method):
+                    result = await method(*args, **kwargs)
+                else:
+                    def _run_with_ctx():
+                        tok = tracing.set_context(tctx)
+                        try:
+                            return method(*args, **kwargs)
+                        finally:
+                            tracing.reset_context(tok)
+
+                    result = await loop.run_in_executor(pool, _run_with_ctx)
+                if (
+                    wire["num_returns"] == -1
+                    and conn is not None
+                    and (inspect.isgenerator(result) or inspect.isasyncgen(result))
+                ):
+                    # Streaming actor generator: items are stored and
+                    # reported to the owner AS PRODUCED (GeneratorItem
+                    # pushes), so the consumer's iteration overlaps this
+                    # producer. Runs INSIDE the trace scope — the generator
+                    # body executes during this drain, and its nested
+                    # submits must inherit the trace context.
+                    idx = 0
+                    if inspect.isasyncgen(result):
+                        async def _advance():
+                            try:
+                                return True, await result.__anext__()
+                            except StopAsyncIteration:
+                                return False, None
+                        advance = _advance
+                    else:
+                        def _advance_sync():
+                            tok = tracing.set_context(tctx)
+                            try:
+                                return True, next(result)
+                            except StopIteration:
+                                return False, None
+                            finally:
+                                tracing.reset_context(tok)
+
+                        async def _advance():
+                            return await loop.run_in_executor(
+                                pool, _advance_sync
+                            )
+                        advance = _advance
+                    inflight = []
+                    while True:
+                        ok, item = await advance()
+                        if not ok:
+                            break
+                        ret = await self.store_returns(
+                            {"num_returns": 1,
+                             "return_ids": [self._dyn_oid(wire, idx)]},
+                            item,
                         )
-                    ))
-                    if len(inflight) >= _GEN_BACKPRESSURE_WINDOW:
+                        # Acked delivery with a bounded window: a slow
+                        # consumer throttles the producer instead of the
+                        # owner buffering the whole stream (reference:
+                        # _generator_backpressure_num_objects).
+                        inflight.append(asyncio.ensure_future(
+                            self._send_generator_item(
+                                conn, wire["task_id"], idx, ret[0]
+                            )
+                        ))
+                        if len(inflight) >= _GEN_BACKPRESSURE_WINDOW:
+                            await asyncio.gather(*inflight)
+                            inflight = []
+                        idx += 1
+                    if inflight:
                         await asyncio.gather(*inflight)
-                        inflight = []
-                    idx += 1
-                if inflight:
-                    await asyncio.gather(*inflight)
-                return {"dynamic_count": idx}
+                    return {"dynamic_count": idx}
             returns = await self.store_returns(wire, result)
             return {"returns": returns}
         except BaseException as e:  # noqa: BLE001

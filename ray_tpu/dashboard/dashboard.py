@@ -217,6 +217,8 @@ class Dashboard:
         reply = await self._gcs("ListTaskEvents", {"limit": 100000})
         latest: Dict[str, dict] = {}
         for e in reply["events"]:
+            if e.get("state") in ("PROFILE", "SPAN"):
+                continue  # phase/trace records, not lifecycle states
             cur = latest.get(e["task_id"])
             if cur is None or e["time"] >= cur["time"]:
                 latest[e["task_id"]] = e

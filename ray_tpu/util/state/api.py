@@ -57,8 +57,8 @@ def list_tasks(filters=None, limit: int = 10000, job_id: Optional[str] = None) -
     latest: Dict[str, dict] = {}
     first_ts: Dict[str, float] = {}
     for e in events:
-        if e.get("state") == "PROFILE":
-            continue  # phase timings, not a lifecycle state (worker clock)
+        if e.get("state") in ("PROFILE", "SPAN"):
+            continue  # phase/trace records, not lifecycle states
         tid = e["task_id"]
         first_ts.setdefault(tid, e["time"])
         cur = latest.get(tid)
@@ -215,6 +215,32 @@ def summarize_objects() -> Dict[str, Any]:
 # -- timeline (reference: ray.timeline, _private/state.py:922) ----------------
 
 
+def list_spans(trace_id: Optional[str] = None) -> List[dict]:
+    """Tracing spans (reference: the OTel spans tracing_helper.py emits).
+    Each: {span_id, parent_span_id, trace_id, kind: submit|execute, name,
+    task_id, start, duration}. Requires RAY_TPU_TASK_TRACE_SPANS=1."""
+    events = _call_gcs("ListTaskEvents", {"limit": 100000})["events"]
+    spans = []
+    for e in events:
+        if e.get("state") != "SPAN":
+            continue
+        if trace_id is not None and e.get("trace_id") != trace_id:
+            continue
+        spans.append(
+            {
+                "span_id": e.get("span_id"),
+                "parent_span_id": e.get("parent_span_id"),
+                "trace_id": e.get("trace_id"),
+                "kind": e.get("kind"),
+                "name": e.get("name"),
+                "task_id": e.get("task_id"),
+                "start": e.get("start"),
+                "duration": e.get("duration"),
+            }
+        )
+    return sorted(spans, key=lambda s: s["start"] or 0)
+
+
 def timeline(filename: Optional[str] = None) -> List[dict]:
     """Chrome-tracing events derived from the task-event log: one complete
     ("X") event per RUNNING->FINISHED/FAILED task span."""
@@ -223,6 +249,27 @@ def timeline(filename: Optional[str] = None) -> List[dict]:
     out: List[dict] = []
     for e in sorted(events, key=lambda x: x["time"]):
         tid = e["task_id"]
+        if e["state"] == "SPAN":
+            # Tracing spans: one X event each, with the trace linkage in
+            # args so chrome://tracing flows can be reconstructed.
+            out.append(
+                {
+                    "name": f"{e.get('name') or 'task'}::{e.get('kind')}",
+                    "cat": "span",
+                    "ph": "X",
+                    "ts": (e.get("start") or e["time"]) * 1e6,
+                    "dur": max(0.0, (e.get("duration") or 0.0) * 1e6),
+                    "pid": e.get("node_id", "node"),
+                    "tid": e.get("worker_id", "worker"),
+                    "args": {
+                        "task_id": tid,
+                        "span_id": e.get("span_id"),
+                        "parent_span_id": e.get("parent_span_id"),
+                        "trace_id": e.get("trace_id"),
+                    },
+                }
+            )
+            continue
         if e["state"] == "PROFILE":
             # Worker-side phase spans (deserialize/execute/store): one X
             # event per phase, laid back-to-back from the recorded start

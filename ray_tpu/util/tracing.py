@@ -1,0 +1,110 @@
+"""Distributed task tracing: OTel-style spans with context propagation.
+
+Analog of python/ray/util/tracing/tracing_helper.py (:36-57): when enabled
+(set the ``RAY_TPU_TASK_TRACE_SPANS=1`` environment variable before
+``ray_tpu.init``), every task/actor submission carries a trace context inside the task wire
+dict, the submitting side emits a ``submit`` span parented to the caller's
+active span, and the executing worker emits an ``execute`` span parented to
+the submit span — with the active-span contextvar set for the duration of
+user code, so tasks submitted FROM a task chain into the same trace.
+
+Spans ride the existing task-event pipeline (record_task_event state="SPAN"
+-> GcsTaskManager analog) and surface through the chrome timeline plus
+``ray_tpu.util.state.api.list_spans()``. No OpenTelemetry SDK dependency:
+the span model (trace_id / span_id / parent_span_id / kind / start /
+duration) is OTLP-shaped so an exporter can translate 1:1.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import time
+from typing import Any, Dict, Optional
+
+from ray_tpu._private.common import config
+
+# (trace_id, active_span_id) for the current task of execution.
+_trace_ctx: "contextvars.ContextVar[Optional[tuple]]" = contextvars.ContextVar(
+    "ray_tpu_trace_ctx", default=None
+)
+
+
+def enabled() -> bool:
+    return bool(config.task_trace_spans)
+
+
+def _new_id() -> str:
+    return os.urandom(8).hex()
+
+
+def current_context() -> Optional[tuple]:
+    """(trace_id, span_id) of the active span, or None."""
+    return _trace_ctx.get()
+
+
+def set_context(ctx: Optional[tuple]):
+    """Set the active span on the CURRENT thread/context; returns a reset
+    token (or None if ctx is None). Needed because run_in_executor does not
+    propagate contextvars onto pool threads — execution paths that hop
+    threads re-set the span where user code actually runs."""
+    if ctx is None:
+        return None
+    return _trace_ctx.set(ctx)
+
+
+def reset_context(token) -> None:
+    if token is not None:
+        _trace_ctx.reset(token)
+
+
+def make_submit_ctx(core, task_id: str, name: str) -> Optional[Dict[str, str]]:
+    """Record the submit-side span and return the wire trace context
+    ({trace_id, span_id}) the executing worker will parent to."""
+    if not enabled():
+        return None
+    cur = _trace_ctx.get()
+    trace_id = cur[0] if cur else _new_id()
+    span_id = _new_id()
+    core.record_task_event(
+        task_id,
+        name,
+        "SPAN",
+        span_id=span_id,
+        parent_span_id=cur[1] if cur else None,
+        trace_id=trace_id,
+        kind="submit",
+        start=time.time(),
+        duration=0.0,
+    )
+    return {"trace_id": trace_id, "span_id": span_id}
+
+
+@contextlib.contextmanager
+def execute_scope(core, wire: Dict[str, Any]):
+    """Worker-side span around user code execution. Sets the active-span
+    contextvar so nested submissions parent correctly (the propagation the
+    reference does by injecting into TaskSpec and wrapping the function)."""
+    ctx = wire.get("trace_ctx")
+    if not ctx:
+        yield
+        return
+    span_id = _new_id()
+    token = _trace_ctx.set((ctx["trace_id"], span_id))
+    t0 = time.time()
+    try:
+        yield
+    finally:
+        _trace_ctx.reset(token)
+        core.record_task_event(
+            wire["task_id"],
+            wire.get("name") or wire.get("actor_method") or "task",
+            "SPAN",
+            span_id=span_id,
+            parent_span_id=ctx["span_id"],
+            trace_id=ctx["trace_id"],
+            kind="execute",
+            start=t0,
+            duration=time.time() - t0,
+        )

@@ -1,0 +1,117 @@
+"""Task tracing spans with context propagation (reference:
+python/ray/util/tracing/tracing_helper.py — spans injected into TaskSpec,
+parent-child linkage across submit/execute boundaries)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.state import api as state_api
+
+
+@pytest.fixture
+def traced_cluster(monkeypatch, shutdown_only):
+    monkeypatch.setenv("RAY_TPU_TASK_TRACE_SPANS", "1")
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield
+
+
+def _spans_by_kind(spans):
+    return (
+        {s["task_id"]: s for s in spans if s["kind"] == "submit"},
+        {s["task_id"]: s for s in spans if s["kind"] == "execute"},
+    )
+
+
+def _wait_spans(min_count, trace_id=None, timeout=20):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        spans = state_api.list_spans(trace_id)
+        if len(spans) >= min_count:
+            return spans
+        time.sleep(0.25)
+    raise AssertionError(
+        f"expected >={min_count} spans, got {state_api.list_spans(trace_id)}"
+    )
+
+
+def test_parent_child_spans_across_task_chain(traced_cluster):
+    """Driver submits `outer`, which submits `inner`: all four spans share
+    one trace id and link parent->child across the process boundaries."""
+
+    @ray_tpu.remote
+    def inner(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) * 10
+
+    assert ray_tpu.get(outer.remote(1)) == 20
+    spans = _wait_spans(4)
+    submits, executes = _spans_by_kind(spans)
+    # Identify the tasks by name.
+    outer_exec = next(s for s in executes.values() if s["name"] == "outer")
+    inner_exec = next(s for s in executes.values() if s["name"] == "inner")
+    outer_sub = submits[outer_exec["task_id"]]
+    inner_sub = submits[inner_exec["task_id"]]
+
+    # One trace end to end.
+    tid = outer_sub["trace_id"]
+    assert tid and all(
+        s["trace_id"] == tid
+        for s in (outer_exec, inner_sub, inner_exec)
+    )
+    # Driver-side submit of `outer` is the root.
+    assert outer_sub["parent_span_id"] is None
+    # execute(outer) is a child of submit(outer).
+    assert outer_exec["parent_span_id"] == outer_sub["span_id"]
+    # submit(inner) happened INSIDE execute(outer) on the worker.
+    assert inner_sub["parent_span_id"] == outer_exec["span_id"]
+    # execute(inner) is a child of submit(inner).
+    assert inner_exec["parent_span_id"] == inner_sub["span_id"]
+    # Execute spans carry durations.
+    assert inner_exec["duration"] >= 0.0
+
+
+def test_actor_method_spans(traced_cluster):
+    @ray_tpu.remote
+    class A:
+        def work(self, x):
+            return x * 2
+
+    a = A.remote()
+    assert ray_tpu.get(a.work.remote(3)) == 6
+    spans = _wait_spans(2)
+    submits, executes = _spans_by_kind(spans)
+    ex = next(s for s in executes.values() if s["name"] == "work")
+    sub = submits[ex["task_id"]]
+    assert ex["parent_span_id"] == sub["span_id"]
+    assert ex["trace_id"] == sub["trace_id"]
+
+
+def test_spans_in_timeline(traced_cluster):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get(f.remote())
+    _wait_spans(2)
+    events = state_api.timeline()
+    span_events = [e for e in events if e["cat"] == "span"]
+    assert span_events, "timeline must export span events"
+    ev = span_events[0]
+    assert ev["args"]["trace_id"] and ev["args"]["span_id"]
+
+
+def test_tracing_disabled_by_default(shutdown_only):
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get(f.remote())
+    time.sleep(1.0)
+    assert state_api.list_spans() == []

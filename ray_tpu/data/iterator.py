@@ -104,14 +104,62 @@ def prefetch_iterator(it: Iterator[Any], n: int) -> Iterator[Any]:
         stop.set()
 
 
-class _SplitCoordinator:
-    """Actor owning one dataset execution, serving blocks to N splits.
+def _mapped_with_close(fn, it):
+    """map() that forwards close() to the source generator — the prefetch
+    thread relies on close() to run upstream finally-blocks promptly when
+    the consumer abandons iteration (plain map objects have no close)."""
+    try:
+        for item in it:
+            yield fn(item)
+    finally:
+        close = getattr(it, "close", None)
+        if close is not None:
+            close()
 
-    Blocks are assigned round-robin at execution time; each epoch restarts
-    iteration over the materialized block refs (first epoch materializes).
+
+class _SplitCoordinator:
+    """Actor owning one dataset execution, streaming blocks to N splits.
+
+    True streaming (reference: _internal/execution/operators/output_splitter.py
+    + streaming_executor.py:48): a producer thread drives the pull-based
+    StreamingExecutor and deposits block refs into per-split queues; splits
+    drain their queue on demand. The producer blocks when every queue is at
+    its cap, so backpressure reaches the executor's submit window and the
+    dataset never has to fit in the object store. First-batch latency is one
+    block, not one epoch.
+
+    Dispatch (the reference OutputSplitter's equal=False load balancing):
+    each block goes to the least-loaded non-full queue, so a stalled or
+    slow split only ever pins cap-many blocks while healthy splits keep
+    streaming. Once the producer finishes, an idle split steals the tail
+    from lagging splits — immediately from splits that joined the epoch
+    (they are racing it anyway), and only after a grace period from splits
+    that never showed up (protects a late-starting trainer worker's share).
+
+    Epochs: a split calls start_epoch before each pass. Joining a running
+    epoch is immediate; asking for the NEXT epoch blocks until every split
+    that joined the current epoch has drained it (barrier — prevents a fast
+    split's relaunch from leaking next-epoch blocks into a slow split's
+    current iteration), then relaunches the execution. A split that
+    abandoned an epoch mid-way (consumer broke out of iter_batches) has its
+    leftover share discarded when it asks for a fresh pass.
     """
 
+    # Handed-out refs are pinned for this many subsequent next_refs calls of
+    # the same split: the owner (this actor) must keep a ref alive until the
+    # borrower has fetched the payload, and the consumer fetches group k
+    # before requesting group k+1.
+    _PIN_GROUPS = 2
+    # Seconds after producer completion before an idle split may steal from
+    # a split that never joined this epoch. Trade-off: shorter means a
+    # sole sequential consumer finishes sooner; longer protects a
+    # slow-starting trainer worker's share (reference equal=False makes no
+    # reservation at all — any grace here is stricter fairness than the
+    # reference's demand dispatch).
+    _STEAL_GRACE = 10.0
+
     def __init__(self, plan_blob: bytes, n: int, parallelism: int):
+        import collections
         import threading
 
         import cloudpickle
@@ -119,41 +167,197 @@ class _SplitCoordinator:
         self.ops = cloudpickle.loads(plan_blob)
         self.n = n
         self.parallelism = parallelism
-        self.refs: Optional[List[Any]] = None
-        self.positions: Dict[int, int] = {}
-        self._lock = threading.Lock()  # splits call in concurrently
+        self._cond = threading.Condition()
+        self._queues: List[Any] = [collections.deque() for _ in range(n)]
+        self._queue_cap = max(2, -(-parallelism // n) + 1)
+        self._buffered = 0
+        self._rr = 0  # tie-break rotation for least-loaded dispatch
+        self._epoch = -1
+        self._producer: Optional[Any] = None
+        self._producer_done = True
+        self._done_at: float = 0.0  # monotonic time the producer finished
+        self._producer_error: Optional[BaseException] = None
+        # Epoch membership: splits that called start_epoch for the current
+        # epoch, and splits that observed it exhausted.
+        self._joined: set = set()
+        self._finished: set = set()
+        # split_idx -> deque of recently handed-out ref groups (pinning).
+        self._handed: Dict[int, Any] = {
+            i: collections.deque(maxlen=self._PIN_GROUPS) for i in range(n)
+        }
 
-    def _ensure(self):
-        with self._lock:
-            if self.refs is None:
-                from ray_tpu.data._execution import StreamingExecutor
+    # -- producer ------------------------------------------------------------
 
+    def _launch(self, joined_by: int) -> None:
+        """Start execution for a new epoch. Caller holds self._cond."""
+        import threading
+        import time as _time
+
+        self._epoch += 1
+        for q in self._queues:
+            q.clear()
+        self._buffered = 0
+        self._producer_done = False
+        self._producer_error = None
+        self._joined = {joined_by}
+        self._finished = set()
+        epoch = self._epoch
+
+        def run():
+            from ray_tpu.data._execution import StreamingExecutor
+
+            try:
                 ex = StreamingExecutor(self.parallelism)
-                self.refs = list(ex.execute(self.ops))
+                for ref in ex.execute(self.ops):
+                    with self._cond:
+                        while (
+                            self._epoch == epoch
+                            and min(len(q) for q in self._queues)
+                            >= self._queue_cap
+                        ):
+                            self._cond.wait(1.0)
+                        if self._epoch != epoch:
+                            return  # superseded; drop the rest
+                        # Least-loaded non-full queue; rotate ties so an
+                        # all-empty start round-robins.
+                        order = sorted(
+                            range(self.n),
+                            key=lambda i: (
+                                len(self._queues[i]),
+                                (i - self._rr) % self.n,
+                            ),
+                        )
+                        dest = order[0]
+                        self._rr = (dest + 1) % self.n
+                        self._queues[dest].append(ref)
+                        self._buffered += 1
+                        self._cond.notify_all()
+            except BaseException as e:  # surfaced to every consumer
+                with self._cond:
+                    if self._epoch == epoch:  # a superseded producer's late
+                        self._producer_error = e  # failure must not poison
+                        # the relaunched epoch.
+            finally:
+                with self._cond:
+                    if self._epoch == epoch:
+                        self._producer_done = True
+                        self._done_at = _time.monotonic()
+                        self._cond.notify_all()
 
-    def start_epoch(self, split_idx: int) -> None:
-        self._ensure()
-        self.positions[split_idx] = 0
+        self._producer = threading.Thread(
+            target=run, daemon=True, name=f"split-producer-{epoch}"
+        )
+        self._producer.start()
 
-    def next_block(self, split_idx: int):
-        """Next block (as a table) for this split, or None when exhausted.
-        Kept for compatibility; split_refs is the fast path."""
-        self._ensure()
-        pos = self.positions.get(split_idx, 0)
-        idx = pos * self.n + split_idx
-        if idx >= len(self.refs):
-            return None
-        self.positions[split_idx] = pos + 1
-        return ray_tpu.get(self.refs[idx])
+    # -- split-facing API ----------------------------------------------------
 
-    def split_refs(self, split_idx: int) -> List[Any]:
-        """This split's block refs (round-robin assignment). The consumer
-        fetches blocks straight from the object store — the data plane never
-        flows through this actor (the old per-block next_block path
-        re-serialized every block through the actor reply: two copies plus
-        an actor round-trip per block)."""
-        self._ensure()
-        return self.refs[split_idx :: self.n]
+    def start_epoch(self, split_idx: int, timeout: float = 600.0) -> int:
+        """Begin (or join) an epoch for this split; returns the epoch id.
+
+        Blocks (barrier) when asking for a new epoch while peers are still
+        draining the current one.
+        """
+        import time as _time
+
+        with self._cond:
+            if self._producer is None:
+                self._launch(split_idx)
+                return self._epoch
+            if split_idx not in self._joined:
+                # Fresh join of the running (or just-drained) epoch. Its
+                # reserved share is still in its queue — un-joined splits
+                # are protected from stealing by the grace period.
+                self._joined.add(split_idx)
+                return self._epoch
+            if split_idx not in self._finished:
+                # Abandoned mid-epoch (consumer broke out of iteration):
+                # discard this split's leftover share so the epoch can
+                # drain, then fall through to request a fresh pass.
+                q = self._queues[split_idx]
+                self._buffered -= len(q)
+                q.clear()
+                self._finished.add(split_idx)
+                self._cond.notify_all()
+            # Wants the NEXT epoch: wait until every joined split drained
+            # the current one, then relaunch (one waiter wins; the rest see
+            # the epoch advance and join it).
+            target = self._epoch + 1
+            deadline = _time.monotonic() + timeout
+            while self._epoch < target:
+                # Ready when every joined split drained the epoch. The
+                # producer need not be done: if all consumers abandoned, the
+                # relaunch supersedes it (the producer thread observes the
+                # epoch bump and exits instead of producing to nobody).
+                ready = self._buffered == 0 and self._joined <= self._finished
+                if ready:
+                    self._launch(split_idx)
+                    return self._epoch
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"split {split_idx} waited {timeout}s for peers "
+                        f"{sorted(self._joined - self._finished)} to finish "
+                        f"epoch {self._epoch}"
+                    )
+                self._cond.wait(min(remaining, 1.0))
+            self._joined.add(split_idx)
+            return self._epoch
+
+    def next_refs(self, split_idx: int, max_n: int = 4, timeout: float = 300.0):
+        """Claim up to max_n block refs for this split.
+
+        Returns (refs, done): done=True means the epoch is exhausted and no
+        further refs will arrive. Blocks until at least one ref is available
+        or the epoch ends; raises the producer's error if execution failed.
+        """
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        with self._cond:
+            while True:
+                if self._producer_error is not None:
+                    raise self._producer_error
+                src = self._queues[split_idx]
+                if not src and self._producer_done and self._buffered:
+                    # Tail steal: production finished and this split is
+                    # idle. Joined peers are fair game (they are actively
+                    # racing); never-joined peers keep their share for a
+                    # grace period in case they are still spawning.
+                    grace_over = (
+                        _time.monotonic() - self._done_at >= self._STEAL_GRACE
+                    )
+                    candidates = [
+                        q
+                        for j, q in enumerate(self._queues)
+                        if q and (j in self._joined or grace_over)
+                    ]
+                    if candidates:
+                        src = max(candidates, key=len)
+                if src:
+                    refs = []
+                    while src and len(refs) < max_n:
+                        refs.append(src.popleft())
+                    self._buffered -= len(refs)
+                    done = self._producer_done and self._buffered == 0
+                    if done:
+                        self._finished.add(split_idx)
+                    # Pin: the bounded deque drops groups handed out
+                    # _PIN_GROUPS calls ago — by then the consumer has
+                    # fetched them (it requests group k+1 only after
+                    # consuming group k).
+                    self._handed[split_idx].append(refs)
+                    self._cond.notify_all()  # wake the producer (queue space)
+                    return refs, done
+                if self._producer_done and self._buffered == 0:
+                    self._finished.add(split_idx)
+                    self._cond.notify_all()  # release the epoch barrier
+                    return [], True
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"split {split_idx} waited {timeout}s for a block"
+                    )
+                self._cond.wait(min(remaining, 1.0))
 
 
 class DataIterator:
@@ -165,11 +369,18 @@ class DataIterator:
         self._idx = split_idx
 
     def _blocks(self) -> Iterator[pa.Table]:
-        refs = ray_tpu.get(self._coord.split_refs.remote(self._idx))
-        for ref in refs:
-            # Direct object-store fetch: zero-copy shm view for local
-            # blocks, chunked pull for remote ones.
-            yield ray_tpu.get(ref)
+        ray_tpu.get(self._coord.start_epoch.remote(self._idx))
+        while True:
+            refs, done = ray_tpu.get(
+                self._coord.next_refs.remote(self._idx)
+            )
+            for ref in refs:
+                # Direct object-store fetch: zero-copy shm view for local
+                # blocks, chunked pull for remote ones — the data plane
+                # never flows through the coordinator actor.
+                yield ray_tpu.get(ref)
+            if done:
+                return
 
     def iter_batches(
         self,
@@ -178,10 +389,21 @@ class DataIterator:
         batch_format: str = "numpy",
         drop_last: bool = False,
         prefetch_batches: int = 1,
+        _finalize_fn: Optional[Any] = None,
     ) -> Iterator[Any]:
+        """Iterate fixed-size batches over this split's stream of blocks.
+
+        _finalize_fn (reference: python/ray/data/iterator.py iter_batches
+        _finalize_fn) runs on each batch INSIDE the prefetch thread — put
+        `jax.device_put` there and the host->device copy of batch k+1
+        overlaps the consumer's device compute on batch k (double
+        buffering).
+        """
         it = batches_from_blocks(
             self._blocks(), batch_size, batch_format, drop_last
         )
+        if _finalize_fn is not None:
+            it = _mapped_with_close(_finalize_fn, it)
         yield from prefetch_iterator(it, prefetch_batches)
 
     def iter_rows(self) -> Iterator[Any]:

@@ -299,11 +299,52 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer=None):
                               "params": p_shard}
 
 
-def flops_per_token(cfg: TransformerConfig, seq_len: int) -> float:
-    """Approximate train FLOPs/token (6ND rule + attention quadratic term)."""
-    d, f, L = cfg.d_model, cfg.ff_dim, cfg.n_layers
+def _fwd_flops_per_token(cfg: TransformerConfig, seq_len: int):
+    """(matmul fwd flops/token per layer, causal attn fwd flops/token per
+    layer, lm-head fwd flops/token)."""
+    d, f = cfg.d_model, cfg.ff_dim
     h, hk, dh = cfg.n_heads, cfg.kv_heads, cfg.head_dim
     per_layer = 2 * d * (h * dh + 2 * hk * dh) + 2 * h * dh * d + 2 * 3 * d * f
-    attn = 2 * 2 * h * dh * seq_len  # qk^T + pv, causal halves then bwd doubles
+    # Causal attention: token t attends to t+1 keys, so the average query
+    # sees (seq_len + 1) / 2 positions; qk^T and pv each cost 2*h*dh flops
+    # per (query, key) pair. The flash kernel really skips the masked-out
+    # tiles, so crediting full seq_len here would overcount ~2x.
+    attn = 2 * 2 * h * dh * ((seq_len + 1) / 2)
     embed = 2 * d * cfg.vocab_size
-    return 3 * (L * (per_layer + attn) + embed)
+    return per_layer, attn, embed
+
+
+def flops_per_token(cfg: TransformerConfig, seq_len: int) -> float:
+    """USEFUL train FLOPs/token: 6ND rule + CAUSAL attention quadratic term.
+
+    1 forward + backward at 2x forward (the PaLM / scaling-book accounting).
+    Recomputation (remat, flash-backward recompute) is deliberately
+    excluded — this is the numerator for useful-MFU. Use
+    hardware_flops_per_token for what the chip actually executes.
+    """
+    per_layer, attn, embed = _fwd_flops_per_token(cfg, seq_len)
+    return 3 * (cfg.n_layers * (per_layer + attn) + embed)
+
+
+def hardware_flops_per_token(
+    cfg: TransformerConfig, seq_len: int, remat: Optional[bool] = None
+) -> float:
+    """Actually-executed train FLOPs/token, including recomputation:
+
+    - the pallas flash-attention backward recomputes the attention forward
+      (recompute custom_vjp in ops/flash_attention.py): +1 attention fwd
+      per layer, always;
+    - per-block remat (cfg.remat) recomputes the whole block forward during
+      the backward: +1 block fwd per layer.
+
+    hardware-MFU = hardware_flops_per_token * tokens/s / peak must come out
+    below 1.0 — the sanity bound useful-MFU alone cannot provide.
+    """
+    if remat is None:
+        remat = cfg.remat
+    per_layer, attn, embed = _fwd_flops_per_token(cfg, seq_len)
+    fwd_layer = per_layer + attn
+    extra = cfg.n_layers * attn  # flash bwd recompute
+    if remat:
+        extra += cfg.n_layers * fwd_layer  # block fwd recompute
+    return 3 * (cfg.n_layers * fwd_layer + embed) + extra

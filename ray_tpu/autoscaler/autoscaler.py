@@ -177,18 +177,18 @@ class Autoscaler:
                 # A TPU pod slice is one failure/billing domain: its hosts
                 # terminate together (reference: TPU pod scale-down removes
                 # whole replicas, never individual slice hosts).
-                all_ok = True
+                remaining = []
                 for pid in t.provider_node_ids:
                     if self.provider.terminate_node(pid) is False:
-                        all_ok = False
+                        remaining.append(pid)
                     else:
                         terminated += 1
-                if all_ok:
+                if not remaining:
                     del self._tracked[key]
                 else:
-                    # Keep the tracker so the next idle round retries the
-                    # failed deletes (terminate_node returning False keeps
-                    # the node alive provider-side too).
+                    # Keep only the failed pids so the retry round neither
+                    # re-terminates nor re-counts nodes already TERMINATING.
+                    t.provider_node_ids = remaining
                     logger.warning(
                         "downscale of %s incomplete; will retry", t.node_type
                     )

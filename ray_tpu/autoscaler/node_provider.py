@@ -215,9 +215,22 @@ class GCETPUNodeProvider(NodeProvider):
                 continue
             try:
                 out = self._runner(self._describe_cmd(name)).strip().upper()
-            except Exception:
+            except Exception as e:
+                # CalledProcessError keeps gcloud's message in output/stderr,
+                # not in str(e).
+                msg = " ".join(
+                    str(part)
+                    for part in (e, getattr(e, "output", ""), getattr(e, "stderr", ""))
+                ).upper()
+                not_found = "NOT_FOUND" in msg or "NOT FOUND" in msg
                 if state == TERMINATING:
-                    del self._nodes[name]  # gone, as requested
+                    # Only a confirmed NOT_FOUND (or repeated misses) drops
+                    # the record: a transient gcloud/network failure must
+                    # not silently forget a node that may still exist and
+                    # bill.
+                    info["describe_misses"] = info.get("describe_misses", 0) + 1
+                    if not_found or info["describe_misses"] > 3:
+                        del self._nodes[name]  # gone, as requested
                     continue
                 # --async creates may not be describable immediately;
                 # tolerate a few misses before declaring the node lost.

@@ -21,6 +21,7 @@ from ray_tpu.tune.search import (
     sample_from,
     uniform,
 )
+from ray_tpu.tune.suggest import Searcher, TPESearcher
 from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner, with_resources
 
 ASHAScheduler = AsyncHyperBandScheduler
@@ -86,4 +87,6 @@ __all__ = [
     "PopulationBasedTraining",
     "MedianStoppingRule",
     "Trainable",
+    "Searcher",
+    "TPESearcher",
 ]

@@ -338,7 +338,10 @@ class HyperBandScheduler(TrialScheduler):
         self._closed.add((b, milestone))
         # Rank only members still alive (dead ones cannot resume).
         alive = {tid: v for tid, v in rung.items() if tid in live}
-        keep_n = max(1, int(len(rung) / self.eta))
+        # Halve over trials that can actually resume: when cohort members
+        # died after reporting, keep_n from len(rung) would resume more than
+        # 1/eta of the survivors and weaken the selection.
+        keep_n = max(1, int(len(alive) / self.eta))
         ranked = sorted(alive.items(), key=lambda kv: -kv[1])
         for i, (tid, _) in enumerate(ranked):
             if i < keep_n:

@@ -68,9 +68,17 @@ class TuneController:
         max_concurrent: Optional[int] = None,
         resources_per_trial: Optional[Dict[str, float]] = None,
         poll_timeout: float = 2.0,
+        searcher: Optional[Any] = None,
+        num_samples: int = 0,
     ):
         self.trainable_blob = cloudpickle.dumps(trainable)
         self.trials = trials
+        # Sequential suggest/observe searcher (reference: tune/search/
+        # searcher.py protocol): trials are created on demand via
+        # searcher.suggest() as slots free up, and completions feed back
+        # through searcher.on_trial_complete so the model adapts.
+        self.searcher = searcher
+        self.num_samples = num_samples
         self.experiment_name = experiment_name
         self.experiment_dir = experiment_dir
         self.storage_path = storage_path
@@ -137,6 +145,29 @@ class TuneController:
             except Exception:
                 pass
             trial.actor = None
+        if self.searcher is not None and status in (TERMINATED, ERROR):
+            self.searcher.on_trial_complete(
+                trial.trial_id, trial.last_result if status == TERMINATED else None
+            )
+
+    def _suggest_trials(self) -> None:
+        """Top up pending trials from the searcher while sample budget and
+        concurrency allow."""
+        if self.searcher is None:
+            return
+        live = [t for t in self.trials if t.status in (RUNNING, PENDING, PAUSED)]
+        while (
+            len(self.trials) < self.num_samples
+            and len(live) < self.max_concurrent
+        ):
+            tid = new_trial_id()
+            config = self.searcher.suggest(tid)
+            if config is None:
+                break
+            trial = Trial(trial_id=tid, config=config)
+            self.trials.append(trial)
+            self.scheduler.on_trial_add(tid)
+            live.append(trial)
 
     # -- the loop ------------------------------------------------------------
 
@@ -188,13 +219,21 @@ class TuneController:
             return False
         for key, threshold in self.stop_criteria.items():
             val = metrics.get(key)
-            if val is not None and float(val) >= float(threshold):
-                return True
+            if val is None:
+                continue
+            try:
+                if float(val) >= float(threshold):
+                    return True
+            except (TypeError, ValueError):
+                # Non-numeric reported value (e.g. a status string) must not
+                # abort the whole experiment from inside the poll loop.
+                continue
         return False
 
     def run(self, result_cb: Optional[Callable[[Trial, Dict], None]] = None):
         while True:
             self._drain_scheduler_actions()
+            self._suggest_trials()
             running = [t for t in self.trials if t.status == RUNNING]
             pending = [t for t in self.trials if t.status == PENDING]
             paused = [t for t in self.trials if t.status == PAUSED]

@@ -143,14 +143,30 @@ class Tuner:
 
     def fit(self) -> ResultGrid:
         name, storage, exp_dir = self._experiment_layout()
+        searcher = None
         if self._preloaded_trials is not None:
             trials = self._preloaded_trials
         else:
+            from ray_tpu.tune.suggest import Searcher
+
             search = self.tune_config.search_alg or BasicVariantGenerator(
                 self.tune_config.seed
             )
-            configs = search.generate(self.param_space, self.tune_config.num_samples)
-            trials = [Trial(trial_id=new_trial_id(), config=c) for c in configs]
+            if isinstance(search, Searcher):
+                # Sequential suggest/observe searcher (TPE etc.): trials are
+                # created on demand inside the controller so completed
+                # results can steer later suggestions.
+                search.set_search_space(self.param_space)
+                search.set_metric(self.tune_config.metric, self.tune_config.mode)
+                searcher = search
+                trials = []
+            else:
+                configs = search.generate(
+                    self.param_space, self.tune_config.num_samples
+                )
+                trials = [
+                    Trial(trial_id=new_trial_id(), config=c) for c in configs
+                ]
         scheduler = self.tune_config.scheduler
         if scheduler is not None:
             scheduler.set_metric(self.tune_config.metric, self.tune_config.mode)
@@ -163,6 +179,8 @@ class Tuner:
             scheduler=scheduler,
             max_concurrent=self.tune_config.max_concurrent_trials,
             resources_per_trial=_with_resources_of(self.trainable),
+            searcher=searcher,
+            num_samples=self.tune_config.num_samples,
         )
         controller.metric = self.tune_config.metric
         controller.mode = self.tune_config.mode

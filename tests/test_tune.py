@@ -142,3 +142,73 @@ def test_trainer_under_tuner(ray_start_regular, tmp_path):
     best = grid.get_best_result()
     assert best.metrics["acc"] == 5.0
     assert best.metrics["world"] == 2
+
+
+def test_tpe_searcher_pure_protocol():
+    """TPE model quality without the runtime: on a deterministic quadratic,
+    TPE's post-startup suggestions concentrate near the optimum and beat
+    random search under the same budget (seed-matched)."""
+    import random
+
+    from ray_tpu.tune import TPESearcher
+    from ray_tpu.tune.search import _walk
+
+    space = {"x": tune.uniform(-10.0, 10.0)}
+
+    def objective(cfg):
+        return -((cfg["x"] - 3.0) ** 2)  # max at x=3
+
+    def run_tpe(seed):
+        s = TPESearcher(metric="score", mode="max", n_initial=8, seed=seed)
+        s.set_search_space(space)
+        best = -float("inf")
+        for i in range(40):
+            cfg = s.suggest(f"t{i}")
+            score = objective(cfg)
+            best = max(best, score)
+            s.on_trial_complete(f"t{i}", {"score": score})
+        return best
+
+    def run_random(seed):
+        rng = random.Random(seed)
+        _, domains = _walk(space, ())
+        best = -float("inf")
+        for _ in range(40):
+            x = domains[0][1].sample(rng)
+            best = max(best, objective({"x": x}))
+        return best
+
+    tpe_wins = sum(
+        1 for seed in range(5) if run_tpe(seed) >= run_random(seed)
+    )
+    assert tpe_wins >= 4  # dominates random under a matched budget
+
+
+def test_tpe_searcher_through_tuner(ray_start_regular, tmp_path):
+    """Tuner(search_alg=TPESearcher): trials are suggested on demand and
+    results feed the model back through the controller."""
+    from ray_tpu.air import RunConfig
+    from ray_tpu.tune import TPESearcher
+
+    def trainable(config):
+        x = config["x"]
+        tune.report({"score": -((x - 3.0) ** 2)})
+
+    grid = tune.Tuner(
+        trainable,
+        param_space={"x": tune.uniform(-10.0, 10.0)},
+        tune_config=tune.TuneConfig(
+            metric="score",
+            mode="max",
+            num_samples=12,
+            search_alg=TPESearcher(n_initial=4, seed=0),
+            max_concurrent_trials=2,
+        ),
+        run_config=RunConfig(name="exp_tpe", storage_path=str(tmp_path)),
+    ).fit()
+    results = [r for r in grid]
+    assert len(results) == 12
+    best = grid.get_best_result()
+    # With 8 adaptive suggestions after 4 random startups the best x should
+    # land well inside (-10, 10)'s central region around 3.
+    assert best.metrics["score"] > -9.0

@@ -327,6 +327,7 @@ class GcsServer:
     def _register_handlers(self) -> None:
         s = self.server
         s.register("RegisterNode", self._register_node)
+        s.register("UnregisterNode", self._unregister_node)
         s.register("GetAllNodes", self._get_all_nodes)
         s.register("UpdateResources", self._update_resources)
         s.register("CreateActor", self._create_actor)
@@ -376,6 +377,14 @@ class GcsServer:
         self._wake_scheduler.set()
         return {"ok": True, "session_name": self.session_name}
 
+    async def _unregister_node(self, conn, p):
+        """Graceful node departure (reference: DrainNode/UnregisterNode in
+        gcs_node_manager.cc): same state transition as a detected death —
+        actors on the node still fail over — but logged as a planned exit,
+        not a health-check death."""
+        await self._handle_node_death(p["node_id"], graceful=True)
+        return {"ok": True}
+
     async def _get_all_nodes(self, conn, p):
         return {
             "nodes": [n.to_wire() for n in self.nodes.values()],
@@ -416,12 +425,15 @@ class GcsServer:
                 pass  # loop already stopped (interpreter shutdown)
         self.publisher.remove_subscriber(conn)
 
-    async def _handle_node_death(self, node_id: str) -> None:
+    async def _handle_node_death(self, node_id: str, graceful: bool = False) -> None:
         node = self.nodes.get(node_id)
         if node is None or node.state == "DEAD":
             return
         node.state = "DEAD"
-        logger.warning("node %s died", node_id[:8])
+        if graceful:
+            logger.info("node %s unregistered (graceful shutdown)", node_id[:8])
+        else:
+            logger.warning("node %s died", node_id[:8])
         self._publish_msg("nodes", {"event": "removed", "node": node.to_wire()})
         self._bump_view(node)
         # Fail/restart actors that lived there.

@@ -267,11 +267,40 @@ class Raylet:
 
     async def stop(self) -> None:
         if self.gcs is not None:
+            # Graceful departure: tell the GCS this node is leaving so the
+            # dropped link is not reported as a health-check death.
+            try:
+                await asyncio.wait_for(
+                    self.gcs.call("UnregisterNode", {"node_id": self.node_id}),
+                    2,
+                )
+            except Exception:
+                pass
             await self.gcs.close()  # before anything else: no re-registration
         for t in self._tasks:
             t.cancel()
+        procs = [w.proc for w in list(self.workers.values())]
         for w in list(self.workers.values()):
             self._kill_worker_proc(w)
+        # Reap children through the event loop so their subprocess
+        # transports close while the loop is alive — otherwise transport
+        # __del__ at interpreter exit emits "child process exit status
+        # already read" / "Event loop is closed" noise.
+        if procs:
+            try:
+                await asyncio.wait_for(
+                    asyncio.gather(*(p.wait() for p in procs), return_exceptions=True),
+                    5,
+                )
+            except asyncio.TimeoutError:
+                for p in procs:
+                    try:
+                        p.kill()
+                    except ProcessLookupError:
+                        pass
+                await asyncio.gather(
+                    *(p.wait() for p in procs), return_exceptions=True
+                )
         # Quiesce spill IO before the arena unmaps: pool threads and
         # suspended spill/restore frames hold memoryview slices into it;
         # mmap.close() with exported views raises BufferError.

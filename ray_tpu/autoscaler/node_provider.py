@@ -79,6 +79,19 @@ TERMINATING = "TERMINATING"  # delete issued, awaiting disappearance
 FAILED = "FAILED"  # create exhausted retries / node vanished
 
 
+def _error_text(e: Exception) -> str:
+    """Lower-cased message of a runner failure, including the gcloud output
+    that CalledProcessError keeps in .output/.stderr rather than str(e)."""
+    parts = [str(e)]
+    for attr in ("output", "stderr"):
+        v = getattr(e, attr, None)
+        if isinstance(v, bytes):
+            v = v.decode("utf-8", "replace")
+        if v:
+            parts.append(str(v))
+    return " ".join(parts).lower()
+
+
 class NodeCreateError(RuntimeError):
     pass
 
@@ -164,9 +177,7 @@ class GCETPUNodeProvider(NodeProvider):
                 }
                 return name
             except Exception as e:  # subprocess.CalledProcessError and kin
-                msg = " ".join(
-                    str(x) for x in (getattr(e, "output", ""), e)
-                ).lower()
+                msg = _error_text(e)
                 if "already exists" in msg or "alreadyexists" in msg:
                     # A prior attempt was accepted server-side even though
                     # the client errored: adopt the node instead of burning
@@ -200,6 +211,10 @@ class GCETPUNodeProvider(NodeProvider):
             return False
         if info is not None:
             info["state"] = TERMINATING
+            # Fresh miss budget for the deletion phase: leftover provisioning
+            # misses must not let one transient describe failure drop the
+            # record of a node that may still exist and bill.
+            info["describe_misses"] = 0
         return True
 
     def poll(self) -> None:
@@ -216,13 +231,8 @@ class GCETPUNodeProvider(NodeProvider):
             try:
                 out = self._runner(self._describe_cmd(name)).strip().upper()
             except Exception as e:
-                # CalledProcessError keeps gcloud's message in output/stderr,
-                # not in str(e).
-                msg = " ".join(
-                    str(part)
-                    for part in (e, getattr(e, "output", ""), getattr(e, "stderr", ""))
-                ).upper()
-                not_found = "NOT_FOUND" in msg or "NOT FOUND" in msg
+                msg = _error_text(e)
+                not_found = "not_found" in msg or "not found" in msg
                 if state == TERMINATING:
                     # Only a confirmed NOT_FOUND (or repeated misses) drops
                     # the record: a transient gcloud/network failure must

@@ -155,7 +155,25 @@ class Tuner:
             if isinstance(search, Searcher):
                 # Sequential suggest/observe searcher (TPE etc.): trials are
                 # created on demand inside the controller so completed
-                # results can steer later suggestions.
+                # results can steer later suggestions. Cohort schedulers are
+                # incompatible with on-demand creation: synchronous
+                # HyperBand fixes rung membership up front (late adds join
+                # already-closed rungs), and PBT's exploit mutates configs
+                # behind the searcher's back, poisoning its model.
+                from ray_tpu.tune.schedulers import (
+                    HyperBandScheduler,
+                    PopulationBasedTraining,
+                )
+
+                if isinstance(
+                    self.tune_config.scheduler,
+                    (HyperBandScheduler, PopulationBasedTraining),
+                ):
+                    raise ValueError(
+                        "search_alg searchers cannot be combined with "
+                        "synchronous HyperBand or PBT; use ASHA, median "
+                        "stopping, or the default FIFO scheduler"
+                    )
                 search.set_search_space(self.param_space)
                 search.set_metric(self.tune_config.metric, self.tune_config.mode)
                 searcher = search

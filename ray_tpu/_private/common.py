@@ -84,6 +84,12 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     # timings in the chrome timeline). Off by default like the reference's
     # RAY_PROFILING — it adds one GCS event per task.
     "task_profile_events": False,
+    # Native direct-call task channel (src/fastpath.cc): eligible
+    # dependency-free tasks ride a C++-owned socket past the asyncio/msgpack
+    # RPC stack (reference: the C++ direct task transport,
+    # direct_task_transport.h:75). Auto-disabled per task when tracing or
+    # profile events need the RPC path's instrumentation.
+    "fastpath_enabled": True,
     # OTel-style task tracing spans with context propagation (reference:
     # ray.init(_tracing_startup_hook) + tracing_helper.py). Off by default.
     "task_trace_spans": False,
@@ -119,16 +125,30 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
 
 
 class _Config:
+    """Config table with RAY_TPU_* env overrides.
+
+    Resolved values are cached on the instance (hot paths read config
+    multiple times per task; an os.environ lookup per read costs ~1us
+    each). Entry points that may run after test fixtures mutate the
+    environment (ray_tpu.init, Cluster bring-up) call refresh().
+    """
+
     def __getattr__(self, name: str):
         if name not in _CONFIG_DEFAULTS:
             raise AttributeError(name)
         env = os.environ.get(f"RAY_TPU_{name.upper()}")
         default = _CONFIG_DEFAULTS[name]
         if env is None:
-            return default
-        if isinstance(default, bool):
-            return env.lower() in ("1", "true", "yes")
-        return type(default)(env)
+            value = default
+        elif isinstance(default, bool):
+            value = env.lower() in ("1", "true", "yes")
+        else:
+            value = type(default)(env)
+        self.__dict__[name] = value  # shadows __getattr__ until refresh()
+        return value
+
+    def refresh(self) -> None:
+        self.__dict__.clear()
 
 
 config = _Config()

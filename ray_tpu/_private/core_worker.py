@@ -294,18 +294,49 @@ class ReferenceTable:
                 core.schedule_release(oid)
 
 
+_FP_MOD: Any = None  # None = untried; False = unavailable; module otherwise
+
+# Cached serialized ([], {}) — the args blob of every no-arg task.
+_EMPTY_ARGS: Any = None
+
+
+def _fp_mod():
+    """The native fastpath extension, or False when disabled/missing."""
+    global _FP_MOD
+    if _FP_MOD is None:
+        if not config.fastpath_enabled:
+            _FP_MOD = False
+        else:
+            try:
+                from ray_tpu._native import _fastpath as m
+
+                _FP_MOD = m
+            except Exception:
+                _FP_MOD = False
+    return _FP_MOD
+
+
 class Lease:
     __slots__ = (
         "lease_id", "worker_id", "addr", "conn", "raylet_conn",
         "outstanding", "in_idle", "checked_out", "used", "parked_at",
+        "fp_port", "fp_channel",
     )
 
-    def __init__(self, lease_id: str, worker_id: str, addr, conn, raylet_conn):
+    def __init__(
+        self, lease_id: str, worker_id: str, addr, conn, raylet_conn,
+        fp_port=None,
+    ):
         self.lease_id = lease_id
         self.worker_id = worker_id
         self.addr = tuple(addr)
         self.conn: rpc.Connection = conn
         self.raylet_conn: rpc.Connection = raylet_conn
+        # Native fastpath channel (src/fastpath.cc): advertised port and the
+        # lazily-opened channel id (None until first eligible dispatch;
+        # False after a failed connect so we stop retrying).
+        self.fp_port = fp_port
+        self.fp_channel = None
         # Tasks pushed but not yet replied. The dispatcher pipelines up to
         # PIPELINE_DEPTH tasks per leased worker so the next task's frame is
         # already in the worker's socket buffer when the current one finishes
@@ -372,19 +403,32 @@ class LeasePool:
     # max_pending_lease_requests_per_scheduling_category).
     MAX_INFLIGHT = 16
     # Tasks pushed-but-unreplied per leased worker (execution stays serial on
-    # the worker; >1 hides the push/reply RTT behind execution). 16 keeps a
-    # fast worker's queue non-empty across the dispatch round trip at
-    # 10k+ tasks/s; _allowed_depth scales this down whenever the backlog is
-    # small relative to the lease supply, so long-task bursts still spread.
-    PIPELINE_DEPTH = 16
+    # the worker; >1 hides the push/reply RTT behind execution). Deep enough
+    # that a worker's queue stays non-empty across a whole completion-drain
+    # cycle on a loaded single-core host (measured: 16 leaves pipeline
+    # bubbles at >10k tasks/s; 64 removes them); _allowed_depth scales this
+    # down whenever the backlog is small relative to the lease supply, so
+    # long-task bursts still spread.
+    PIPELINE_DEPTH = 64
 
     def __init__(self, core: "CoreWorker"):
         self.core = core
         self.pools: Dict[tuple, _ShapePool] = {}
+        # Native fastpath state: task_id -> (key, pool, lease, wire) for
+        # tasks in flight on a C++ channel, and whether the completion
+        # drainer is wired onto the event loop.
+        self._fp_inflight: Dict[str, tuple] = {}
+        self._fp_drainer_installed = False
 
     @staticmethod
     def shape_key(resources: Dict[str, int], pg_id, bundle_index, strategy=None) -> tuple:
-        skey = tuple(sorted(strategy.items())) if strategy else None
+        if strategy:
+            import json
+
+            # Canonical hashable form; label strategies nest dicts.
+            skey = json.dumps(strategy, sort_keys=True)
+        else:
+            skey = None
         return (tuple(sorted((resources or {}).items())), pg_id, bundle_index, skey)
 
     def _pool(self, key, resources, pg_id, bundle_index, strategy=None) -> _ShapePool:
@@ -591,6 +635,7 @@ class LeasePool:
                         reply["worker_addr"],
                         conn,
                         raylet_conn,
+                        fp_port=reply.get("fp_port"),
                     )
                     pool.inflight -= 1
                     pool.leases.add(lease)
@@ -657,6 +702,26 @@ class LeasePool:
         if entry is not None:
             entry["conn"] = lease.conn
         core.record_task_event(wire["task_id"], wire["name"], "RUNNING")
+        if (
+            lease.fp_port
+            and lease.fp_channel is not False
+            and wire.get("args_blob") is not None
+            and not wire.get("ref_positions")
+            and not wire.get("kw_ref_keys")
+            and wire.get("num_returns") == 1
+            and "trace_ctx" not in wire
+            and not wire.get("_no_fastpath")
+            and not wire.get("runtime_env")  # env_vars/working_dir need the
+            and not config.task_profile_events  # RPC path's application step
+            and self._fp_submit(key, pool, lease, wire)
+        ):
+            lease.outstanding += 1
+            pool.total_outstanding += 1
+            lease.used = True
+            if lease.outstanding >= self._pool_depth(pool) and lease.in_idle:
+                pool.idle.remove(lease)
+                lease.in_idle = False
+            return
         try:
             # Inline reply callback (no Future/call_soon hop): the reply
             # dispatches _on_task_reply straight from the read path.
@@ -681,6 +746,81 @@ class LeasePool:
         if lease.outstanding >= self._pool_depth(pool) and lease.in_idle:
             pool.idle.remove(lease)
             lease.in_idle = False
+
+    # -- native fastpath (src/fastpath.cc) -----------------------------------
+
+    def _fp_submit(self, key, pool: _ShapePool, lease: Lease, wire: dict) -> bool:
+        """Hand one eligible task to the C++ direct-call channel. Returns
+        False (and poisons the lease's channel) when the native path is
+        unavailable, so the caller falls through to the RPC push."""
+        fp = _fp_mod()
+        if not fp:
+            lease.fp_channel = False
+            return False
+        if lease.fp_channel is None:
+            ch = fp.client_connect(lease.addr[0], lease.fp_port)
+            if ch < 0:
+                lease.fp_channel = False
+                return False
+            lease.fp_channel = ch
+            if not self._fp_drainer_installed:
+                asyncio.get_running_loop().add_reader(
+                    fp.notify_fd(), self._fp_drain, fp
+                )
+                self._fp_drainer_installed = True
+        tid = wire["task_id"]
+        if not fp.submit(
+            lease.fp_channel,
+            tid.encode(),
+            wire["func_id"].encode(),
+            wire["name"].encode(),
+            wire["args_blob"],
+        ):
+            lease.fp_channel = False
+            return False
+        self._fp_inflight[tid] = (key, pool, lease, wire)
+        return True
+
+    def _fp_drain(self, fp) -> None:
+        """Event-loop callback: fold a batch of native completions into the
+        normal reply bookkeeping (one loop wakeup per batch, not per task)."""
+        for tid, status, payload in fp.drain():
+            entry = self._fp_inflight.pop(tid.decode(), None)
+            if entry is None:
+                continue
+            key, pool, lease, wire = entry
+            if status == 0:  # inline value
+                self._on_task_reply(
+                    key, pool, lease, wire, {"returns": [{"inline": payload}]}, None
+                )
+            elif status == 6:  # large value parked in worker-side plasma
+                import pickle
+
+                self._on_task_reply(
+                    key, pool, lease, wire,
+                    {"returns": [pickle.loads(payload)]}, None,
+                )
+            elif status == 1:  # application error (serialized exception)
+                if not payload:
+                    # The C++ callback shim failed before Python could
+                    # serialize anything; surface a real exception.
+                    payload = serialization.serialize(
+                        WorkerCrashedError("fastpath execution failed")
+                    ).to_bytes()
+                self._on_task_reply(
+                    key, pool, lease, wire, {"error": payload}, None
+                )
+            elif status == 4:  # function not cached there: RPC path exports it
+                lease.outstanding -= 1
+                pool.total_outstanding -= 1
+                wire["_no_fastpath"] = True
+                pool.pending.append(("task", wire))
+                self._lease_available(key, pool, lease)
+            else:  # 2: channel lost — normal worker-death retry machinery
+                lease.fp_channel = False
+                self._on_task_reply(
+                    key, pool, lease, wire, None, rpc._CONNECTION_LOST
+                )
 
     def _on_task_reply(self, key, pool: _ShapePool, lease: Lease, wire: dict, reply, err) -> None:
         core = self.core
@@ -766,6 +906,14 @@ class LeasePool:
         self._lease_available(key, pool, lease)
 
     async def _return_worker(self, lease: Lease, dirty: bool) -> None:
+        if isinstance(lease.fp_channel, int):
+            fp = _fp_mod()
+            if fp:
+                try:
+                    fp.client_close(lease.fp_channel)
+                except Exception:
+                    pass
+            lease.fp_channel = False
         try:
             await lease.raylet_conn.call(
                 "ReturnWorker", {"lease_id": lease.lease_id, "dirty": dirty}
@@ -1608,6 +1756,13 @@ class CoreWorker:
         by the executor to values (reference semantics); nested refs pass
         through as refs. A large blob moves via the shm store.
         """
+        if not args and not kwargs:
+            # No-arg calls are the most common task shape; one cached blob
+            # serves them all (serialize + ref-scan are ~20us per call).
+            global _EMPTY_ARGS
+            if _EMPTY_ARGS is None:
+                _EMPTY_ARGS = serialization.serialize(([], {}))
+            return _EMPTY_ARGS, [], [], []
         ref_positions = []
         plain_args = list(args)
         for i, a in enumerate(plain_args):
@@ -1770,6 +1925,7 @@ class CoreWorker:
         scheduling_strategy: Optional[dict] = None,
         runtime_env: Optional[dict] = None,
         resources_units: Optional[Dict[str, int]] = None,
+        no_fastpath: bool = False,
     ) -> Optional[List[ObjectRef]]:
         """Synchronous submission fast path, callable from any thread.
 
@@ -1821,6 +1977,8 @@ class CoreWorker:
             scheduling_strategy=scheduling_strategy,
             runtime_env=None,
         )
+        if no_fastpath:
+            wire["_no_fastpath"] = True
         refs = self._register_task_bookkeeping(wire)
         self._enqueue_submit(("task", wire), loop)
         return refs
@@ -2382,6 +2540,14 @@ class CoreWorker:
         for t in self._bg_tasks:
             t.cancel()
         await self._flush_task_events()
+        if self.lease_pool._fp_drainer_installed:
+            fp = _fp_mod()
+            if fp:
+                try:
+                    asyncio.get_running_loop().remove_reader(fp.notify_fd())
+                except Exception:
+                    pass
+            self.lease_pool._fp_drainer_installed = False
         await self.lease_pool.drain()
         self.plasma.close()
         for conn in self._conns.values():

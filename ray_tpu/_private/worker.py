@@ -109,6 +109,8 @@ def init(
     """
     import os as _os
 
+    config.refresh()  # pick up env overrides set after import (fixtures)
+
     if address and (address.startswith("ray-tpu://") or address.startswith("ray://")):
         # Client mode (reference: Ray Client, ray.init("ray://...")): drive
         # the cluster through its proxy endpoint; this process never joins

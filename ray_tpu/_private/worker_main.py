@@ -147,6 +147,8 @@ class Executor:
         # Bounded: best-effort markers for races with finished tasks must not
         # accumulate forever.
         self.cancelled_tasks: "OrderedDict[str, None]" = OrderedDict()
+        # Event loop handle for the native fastpath callback's plasma hop.
+        self._fp_loop: Optional[asyncio.AbstractEventLoop] = None
         core.server.register("PushTask", self.handle_push_task)
         core.server.register("PushActorTask", self.handle_push_actor_task)
         core.server.register("CreateActor", self.handle_create_actor)
@@ -154,6 +156,48 @@ class Executor:
         core.server.register("Exit", self.handle_exit)
         core.server.register_sync("PushTask", self._sync_push_task)
         core.server.register_sync("PushActorTask", self._sync_push_actor_task)
+
+    # -- native fastpath (ray_tpu._native._fastpath server callback) ---------
+
+    def fastpath_exec(self, tid: bytes, fid: bytes, name: bytes, blob: bytes):
+        """Execute one task for the native direct-call channel.
+
+        Runs on the extension's connection thread with the GIL held (the
+        C++ side serializes execution per connection, matching the sync
+        exec-thread semantics). Statuses: 0 ok (payload = inline serialized
+        value), 1 error (payload = serialized exception), 4 function not
+        cached here (driver re-sends via the RPC path, which populates the
+        cache), 6 large result stored in plasma (payload = pickled returns
+        descriptor).
+        """
+        import pickle
+
+        from ray_tpu._private.ids import return_object_ids
+
+        try:
+            fn = self.fn_cache.get(fid.decode())
+            if fn is None or asyncio.iscoroutinefunction(fn):
+                # Unknown here, or a coroutine function (needs the event
+                # loop): the driver re-sends via the RPC path.
+                return (4, b"")
+            with serialization.DeserializationContext(
+                ref_deserializer=self.core._deserialize_ref
+            ):
+                (args, kwargs), _ = serialization.deserialize(blob)
+            result = fn(*args, **kwargs)
+            serialized = serialization.serialize(result)
+            if serialized.total_size <= config.max_direct_call_object_size:
+                return (0, serialized.to_bytes())
+            # Large return: plasma write via the worker loop, then the same
+            # returns descriptor the RPC path uses.
+            oid = return_object_ids(tid.decode(), 1)[0]
+            asyncio.run_coroutine_threadsafe(
+                self.core.plasma.put_serialized(oid, serialized),
+                self._fp_loop,
+            ).result(timeout=60)
+            return (6, pickle.dumps({"plasma": list(self.core.raylet_addr)}))
+        except BaseException as e:  # noqa: BLE001 - must serialize any failure
+            return (1, self._error_payload(e))
 
     # -- sync fast-path dispatch (called inline from data_received) ----------
 
@@ -214,6 +258,7 @@ class Executor:
             and not renv.get("working_dir")
             and not renv.get("py_modules")
             and not renv.get("pip")
+            and not renv.get("conda")
         ):
             self._exec().submit(conn, msgid, "PushTask", wire)
             return
@@ -436,7 +481,10 @@ class Executor:
         track = self.running_tasks[task_id] = {"thread_id": None, "async_task": None}
         try:
             renv = wire.get("runtime_env") or {}
-            if renv.get("working_dir") or renv.get("py_modules") or renv.get("pip"):
+            if (
+                renv.get("working_dir") or renv.get("py_modules")
+                or renv.get("pip") or renv.get("conda")
+            ):
                 # Shared worker process: packages and pip-env site-packages
                 # go on sys.path (idempotent) but the cwd is left alone; env
                 # vars are call-scoped below.
@@ -446,7 +494,7 @@ class Executor:
                     self.core,
                     {
                         k: renv[k]
-                        for k in ("working_dir", "py_modules", "pip")
+                        for k in ("working_dir", "py_modules", "pip", "conda")
                         if k in renv
                     },
                     chdir=False,
@@ -903,8 +951,25 @@ async def amain() -> None:
 
     worker_mod.attach_existing(core, asyncio.get_running_loop())
 
+    # Native direct-call channel (reference: the worker-side PushTask fast
+    # lane of the C++ core worker). Optional: without the extension the RPC
+    # path serves everything.
+    fp_port = None
+    fp_server_id = None
+    if config.fastpath_enabled:
+        try:
+            from ray_tpu._native import _fastpath as _fp
+
+            executor._fp_loop = asyncio.get_running_loop()
+            fp_server_id, fp_port = _fp.serve(
+                "127.0.0.1", 0, executor.fastpath_exec
+            )
+        except Exception:
+            fp_port = None
+
     reply = await raylet_conn.call(
-        "RegisterWorker", {"worker_id": worker_id, "addr": list(addr)}
+        "RegisterWorker",
+        {"worker_id": worker_id, "addr": list(addr), "fp_port": fp_port},
     )
     core.job_id = core.job_id or reply.get("job_id", "")
 

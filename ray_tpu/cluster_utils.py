@@ -20,6 +20,9 @@ from ray_tpu._private.raylet import Raylet
 
 class Cluster:
     def __init__(self, initialize_head: bool = True, head_node_args: Optional[dict] = None):
+        from ray_tpu._private.common import config
+
+        config.refresh()  # pick up env overrides set after import (fixtures)
         self._w = worker_mod.global_worker
         if self._w.loop is None:
             self._w._start_loop()

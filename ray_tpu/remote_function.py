@@ -40,6 +40,7 @@ def _strategy_fields(opts):
     if ss is not None:
         from ray_tpu.util.scheduling_strategies import (
             NodeAffinitySchedulingStrategy,
+            NodeLabelSchedulingStrategy,
             PlacementGroupSchedulingStrategy,
         )
 
@@ -48,6 +49,8 @@ def _strategy_fields(opts):
             bundle_index = ss.placement_group_bundle_index
         elif isinstance(ss, NodeAffinitySchedulingStrategy):
             strategy = {"node_id": ss.node_id, "soft": ss.soft}
+        elif isinstance(ss, NodeLabelSchedulingStrategy):
+            strategy = ss.to_wire()
         elif isinstance(ss, dict):
             strategy = ss
     if opts.get("placement_group") is not None:
@@ -58,6 +61,8 @@ def _strategy_fields(opts):
 
 class RemoteFunction:
     def __init__(self, fn, **options):
+        import asyncio
+
         self._fn = fn
         self._options = options
         self._pickled: Optional[bytes] = None
@@ -65,6 +70,10 @@ class RemoteFunction:
         # fixed-point conversion and strategy unpacking are hot-path costs).
         self._res_units: Optional[Dict[str, int]] = None
         self._strategy_cache = None
+        # Coroutine functions need the worker's event loop — permanently
+        # ineligible for the native fastpath (gating here avoids a
+        # per-call status-4 bounce off the worker).
+        self._no_fastpath = asyncio.iscoroutinefunction(fn)
         functools.update_wrapper(self, fn)
 
     def _get_pickled(self) -> bytes:
@@ -120,6 +129,7 @@ class RemoteFunction:
             bundle_index=bundle_index,
             scheduling_strategy=strategy,
             runtime_env=opts.get("runtime_env"),
+            no_fastpath=self._no_fastpath,
         )
         if refs is None:
             refs = worker_mod.global_worker.run_async(

@@ -23,5 +23,10 @@ setup(
             sources=["src/store_core.cc"],
             extra_compile_args=["-O2", "-std=c++17"],
         ),
+        Extension(
+            "ray_tpu._native._fastpath",
+            sources=["src/fastpath.cc"],
+            extra_compile_args=["-O2", "-std=c++17"],
+        ),
     ],
 )

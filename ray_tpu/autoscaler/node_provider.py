@@ -157,6 +157,39 @@ class GCETPUNodeProvider(NodeProvider):
             *self._scope(), "--format=value(state)",
         ]
 
+    def discover_nodes(self) -> List[str]:
+        """Adopt raytpu-* TPU VMs that exist in GCE but aren't tracked here
+        (a fresh process running `down`, or crash recovery). Returns the
+        adopted names."""
+        out = self._runner(
+            [
+                "gcloud", "compute", "tpus", "tpu-vm", "list", *self._scope(),
+                "--filter=name~^raytpu-", "--format=value(name)",
+            ]
+        )
+        adopted = []
+        for name in out.split():
+            name = name.strip()
+            if name and name not in self._nodes:
+                self._nodes[name] = {
+                    "state": READY,
+                    "node_type": "unknown",
+                    "create_attempts": 0,
+                    "describe_misses": 0,
+                }
+                adopted.append(name)
+        return adopted
+
+    def run_on_node(self, name: str, command: str, worker: str = "all") -> str:
+        """Run a shell command on a TPU VM over gcloud ssh (the launcher's
+        head bootstrap path; reference: ray up's ssh command runner)."""
+        return self._runner(
+            [
+                "gcloud", "compute", "tpus", "tpu-vm", "ssh", name,
+                *self._scope(), f"--worker={worker}", "--command", command,
+            ]
+        )
+
     # -- lifecycle -----------------------------------------------------------
 
     def create_node(self, node_type: str) -> str:

@@ -77,7 +77,11 @@ def cmd_start(args) -> None:
         if not args.no_dashboard:
             from ray_tpu.dashboard.dashboard import Dashboard
 
-            dash = Dashboard(node.gcs_addr, port=args.dashboard_port)
+            dash = Dashboard(
+                node.gcs_addr,
+                port=args.dashboard_port,
+                session_name=node.session_name,
+            )
             host, port = await dash.start()
             dash_addr = f"http://{host}:{port}"
         client_srv = None
@@ -186,6 +190,78 @@ def cmd_job(args) -> None:
 # -- ray-tpu summary / timeline ------------------------------------------------
 
 
+def cmd_up(args) -> None:
+    """Boot a cluster from a YAML (reference: `ray up`, scripts.py:1279)."""
+    import time as _time
+
+    from ray_tpu.autoscaler.launcher import ClusterConfig, ClusterLauncher
+
+    launcher = ClusterLauncher(ClusterConfig.from_yaml(args.config))
+    addr = launcher.up()
+    print(f"cluster up; head address: {addr}")
+    if args.monitor:
+        print("autoscaler monitor running (ctrl-c to detach)...")
+        try:
+            while True:
+                launcher.update()
+                _time.sleep(launcher.autoscaler.config.poll_interval_s)
+        except KeyboardInterrupt:
+            pass
+
+
+def cmd_down(args) -> None:
+    """Tear down a cluster (reference: `ray down`, scripts.py:1355)."""
+    from ray_tpu.autoscaler.launcher import (
+        ClusterConfig,
+        ClusterLauncher,
+        read_cluster_state,
+    )
+
+    config = ClusterConfig.from_yaml(args.config)
+    state = read_cluster_state(config.cluster_name)
+    launcher = ClusterLauncher(config)
+    launcher._make_provider()
+    if state:
+        launcher.head_address = state.get("head_address")
+        launcher._worker_pids = state.get("worker_pids", [])
+    # A fresh process has no in-memory node table: adopt what the cloud
+    # reports before terminating.
+    discover = getattr(launcher.provider, "discover_nodes", None)
+    if discover is not None:
+        discover()
+    launcher.down()
+    print(f"cluster {config.cluster_name} down")
+
+
+def cmd_submit(args) -> None:
+    """Submit an entrypoint against a cluster booted with `up`."""
+    from ray_tpu.autoscaler.launcher import ClusterConfig, read_cluster_state
+    from ray_tpu.job import JobSubmissionClient
+
+    config = ClusterConfig.from_yaml(args.config)
+    state = read_cluster_state(config.cluster_name)
+    if not state:
+        raise SystemExit(f"no running cluster named {config.cluster_name!r}")
+    # argparse REMAINDER may include the literal "--" separator as the
+    # first token; anything after it (including dashes) IS the entrypoint.
+    tokens = list(args.entrypoint)
+    if tokens and tokens[0] == "--":
+        tokens = tokens[1:]
+    entry = " ".join(tokens)
+    client = JobSubmissionClient(state["head_address"])
+    sid = client.submit_job(entrypoint=entry)
+    print(f"submitted job {sid}")
+    if not args.no_wait:
+        import time as _time
+
+        while True:
+            info = client.get_job_info(sid)
+            if info.status in ("SUCCEEDED", "FAILED", "STOPPED"):
+                print(f"job {sid}: {info.status}")
+                break
+            _time.sleep(0.5)
+
+
 def cmd_summary(args) -> None:
     import ray_tpu
     from ray_tpu.util import state as state_api
@@ -272,6 +348,24 @@ def build_parser() -> argparse.ArgumentParser:
         j.add_argument("id")
     jsub.add_parser("list")
     sp.set_defaults(fn=cmd_job)
+
+    sp = sub.add_parser("up", help="boot a cluster from a YAML config")
+    sp.add_argument("config", help="cluster YAML (see autoscaler/launcher.py)")
+    sp.add_argument(
+        "--monitor", action="store_true",
+        help="keep running the autoscaler loop after bring-up",
+    )
+    sp.set_defaults(fn=cmd_up)
+
+    sp = sub.add_parser("down", help="tear down a cluster booted with `up`")
+    sp.add_argument("config", help="cluster YAML used for `up`")
+    sp.set_defaults(fn=cmd_down)
+
+    sp = sub.add_parser("submit", help="submit an entrypoint to a cluster")
+    sp.add_argument("config", help="cluster YAML used for `up`")
+    sp.add_argument("--no-wait", action="store_true")
+    sp.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    sp.set_defaults(fn=cmd_submit)
 
     sp = sub.add_parser("summary", help="summarize tasks/actors/objects")
     sp.add_argument("kind", choices=["tasks", "actors", "objects"])

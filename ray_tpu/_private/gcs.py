@@ -136,6 +136,11 @@ class GcsServer:
         # bumps it and broadcasts a delta (reference: ray_syncer.h:88
         # bidirectional versioned sync streams).
         self.view_version = 0
+        # Structured events (reference: src/ray/util/event.cc): durable
+        # JSONL + queryable ring, served via ListEvents.
+        from ray_tpu._private.events import EventLogger
+
+        self.events = EventLogger(session_name or "default", "GCS")
         self._pending_actor_queue: List[str] = []
         self._wake_scheduler = asyncio.Event()
         self._scheduler_task: Optional[asyncio.Task] = None
@@ -328,6 +333,7 @@ class GcsServer:
         s = self.server
         s.register("RegisterNode", self._register_node)
         s.register("UnregisterNode", self._unregister_node)
+        s.register("ListEvents", self._list_events)
         s.register("GetAllNodes", self._get_all_nodes)
         s.register("UpdateResources", self._update_resources)
         s.register("CreateActor", self._create_actor)
@@ -372,10 +378,25 @@ class GcsServer:
         info = NodeInfo(p["node_id"], p["addr"], p["resources"], p.get("labels"), conn)
         self.nodes[p["node_id"]] = info
         conn.context["node_id"] = p["node_id"]
+        self.events.emit(
+            "NODE_ADDED",
+            f"node {p['node_id'][:8]} joined",
+            node_id=p["node_id"],
+            resources=p["resources"],
+        )
         self._publish_msg("nodes", {"event": "added", "node": info.to_wire()})
         self._bump_view(info)
         self._wake_scheduler.set()
         return {"ok": True, "session_name": self.session_name}
+
+    async def _list_events(self, conn, p):
+        return {
+            "events": self.events.list(
+                severity=p.get("severity"),
+                label=p.get("label"),
+                limit=p.get("limit", 1000),
+            )
+        }
 
     async def _unregister_node(self, conn, p):
         """Graceful node departure (reference: DrainNode/UnregisterNode in
@@ -434,6 +455,13 @@ class GcsServer:
             logger.info("node %s unregistered (graceful shutdown)", node_id[:8])
         else:
             logger.warning("node %s died", node_id[:8])
+        self.events.emit(
+            "NODE_REMOVED",
+            f"node {node_id[:8]} {'unregistered' if graceful else 'died'}",
+            severity="INFO" if graceful else "WARNING",
+            node_id=node_id,
+            graceful=graceful,
+        )
         self._publish_msg("nodes", {"event": "removed", "node": node.to_wire()})
         self._bump_view(node)
         # Fail/restart actors that lived there.
@@ -511,6 +539,22 @@ class GcsServer:
         candidates = [n for n in self.nodes.values() if n.state == "ALIVE"]
         if strategy.get("node_id"):
             candidates = [n for n in candidates if n.node_id == strategy["node_id"]]
+        labels = strategy.get("labels")
+        if labels:
+            # NODE_LABEL actor placement (reference: GcsActorScheduler +
+            # scheduling_options.h NODE_LABEL): hard gates, soft prefers.
+            from ray_tpu.util.scheduling_strategies import node_matches_labels
+
+            hard = labels.get("hard") or {}
+            soft = labels.get("soft") or {}
+            candidates = [
+                n for n in candidates if node_matches_labels(hard, n.labels)
+            ]
+            if soft:
+                preferred = [
+                    n for n in candidates if node_matches_labels(soft, n.labels)
+                ]
+                candidates = preferred or candidates
         if actor.spec.get("pg_id"):
             pg = self.placement_groups.get(actor.spec["pg_id"])
             if pg is None or pg.state != "CREATED":
@@ -590,11 +634,27 @@ class GcsServer:
             self._publish_msg(f"actor:{actor.actor_id}", actor.to_wire())
             self._pending_actor_queue.append(actor.actor_id)
             self._wake_scheduler.set()
+            self.events.emit(
+                "ACTOR_RESTARTING",
+                f"actor {actor.actor_id[:8]} restarting "
+                f"({actor.num_restarts}/{actor.max_restarts}): {cause}",
+                severity="WARNING",
+                actor_id=actor.actor_id,
+                cause=cause,
+            )
         else:
             await self._fail_actor(actor, cause)
 
     async def _fail_actor(self, actor: ActorInfo, cause: str, creation_failed=False) -> None:
         actor.state = DEAD
+        self.events.emit(
+            "ACTOR_DEAD",
+            f"actor {actor.actor_id[:8]} died: {cause}",
+            # Deliberate kills are lifecycle, not failures.
+            severity="INFO" if "ray.kill" in cause else "ERROR",
+            actor_id=actor.actor_id,
+            cause=cause,
+        )
         actor.death_cause = cause
         for fut in actor.pending:
             if not fut.done():

@@ -50,6 +50,7 @@ class WorkerHandle:
         self.proc = proc
         self.conn: Optional[rpc.Connection] = None
         self.addr: Optional[Tuple[str, int]] = None
+        self.fp_port: Optional[int] = None  # native fastpath channel port
         self.registered = asyncio.get_running_loop().create_future()
         self.lease_id: Optional[str] = None
         self.actor_id: Optional[str] = None
@@ -444,7 +445,7 @@ class Raylet:
 
     # -- worker pool ---------------------------------------------------------
 
-    async def _start_worker(self) -> WorkerHandle:
+    async def _start_worker(self, container: Optional[dict] = None) -> WorkerHandle:
         from ray_tpu._private.ids import WorkerID
 
         worker_id = WorkerID.from_random().hex()
@@ -476,10 +477,16 @@ class Raylet:
                 "RAY_TPU_SESSION": self.session_name,
             }
         )
+        argv = [sys.executable, "-m", "ray_tpu._private.worker_main"]
+        if container:
+            # Containerized worker (reference: runtime_env/container.py):
+            # the podman/docker argv wraps the same worker module; host
+            # networking + /dev/shm keep RPC and plasma working.
+            from ray_tpu.runtime_env.container import build_container_argv
+
+            argv = build_container_argv(container, argv, env)
         proc = await asyncio.create_subprocess_exec(
-            sys.executable,
-            "-m",
-            "ray_tpu._private.worker_main",
+            *argv,
             env=env,
             stdout=asyncio.subprocess.PIPE,
             stderr=asyncio.subprocess.PIPE,
@@ -608,6 +615,7 @@ class Raylet:
             raise rpc.RpcError("unknown worker")
         handle.conn = conn
         handle.addr = tuple(p["addr"])
+        handle.fp_port = p.get("fp_port")
         conn.context["worker_id"] = p["worker_id"]
         if not handle.registered.done():
             handle.registered.set_result(handle)
@@ -698,6 +706,33 @@ class Raylet:
                     f"demand cannot fit on affinity target {affinity[:8]}"
                 )
             affinity = None
+        labels = strategy.get("labels")
+        if labels:
+            # Node-label policy (reference: scheduling_options.h NODE_LABEL
+            # + NodeLabelSchedulingStrategy): hard expressions gate
+            # eligibility; soft expressions rank among the eligible.
+            from ray_tpu.util.scheduling_strategies import node_matches_labels
+
+            if (
+                p.get("spilled_from")
+                and node_matches_labels(labels.get("hard") or {}, self.labels)
+                and demand.is_subset_of(self.total)
+            ):
+                # Spilled here by a peer's label pick and we qualify: queue
+                # locally instead of re-picking (avoids placement ping-pong
+                # on lagging views).
+                strategy = {k: v for k, v in strategy.items() if k != "labels"}
+            else:
+                target = await self._label_pick(demand, labels)
+                if target is None:
+                    raise rpc.RpcError(
+                        f"no node matches label constraints {labels['hard']} "
+                        "with capacity for the demand"
+                    )
+                if target["node_id"] != self.node_id:
+                    return {"spillback": target}
+                # Local node is the pick: fall through to queue here.
+                strategy = {k: v for k, v in strategy.items() if k != "labels"}
         if not demand.is_subset_of(self.total):
             # Infeasible here — suggest spillback target from GCS view.
             target = await self._find_spillback_node(demand)
@@ -881,6 +916,44 @@ class Raylet:
             return None  # we're no worse than the best remote; stay local
         return {"node_id": pick["node_id"], "addr": pick["addr"]}
 
+    async def _label_pick(self, demand: ResourceSet, labels: dict):
+        """NODE_LABEL policy: hard-eligible nodes, soft-matching preferred,
+        least-utilized wins (capacity-feasible now preferred over
+        total-feasible). Returns None when no node can ever satisfy."""
+        from ray_tpu.util.scheduling_strategies import node_matches_labels
+
+        hard = labels.get("hard") or {}
+        soft = labels.get("soft") or {}
+        eligible = []
+        for n in await self._cluster_view():
+            if not node_matches_labels(hard, n.get("labels") or {}):
+                continue
+            if not demand.is_subset_of(ResourceSet.from_units(n["total"])):
+                continue
+            eligible.append(n)
+        if not eligible:
+            return None
+        if soft:
+            preferred = [
+                n
+                for n in eligible
+                if node_matches_labels(soft, n.get("labels") or {})
+            ]
+            pool = preferred or eligible
+        else:
+            pool = eligible
+        now_fits = [
+            n
+            for n in pool
+            if demand.is_subset_of(ResourceSet.from_units(n["available"]))
+        ]
+        pool = now_fits or pool
+        pool.sort(
+            key=lambda n: self._node_util(n["total"], n["available"])
+        )
+        pick = pool[0]
+        return {"node_id": pick["node_id"], "addr": pick["addr"]}
+
     async def _cancel_worker_lease(self, conn, p):
         """Cancel a queued (ungranted) lease request: the surplus-request
         drain that keeps recycled-lease pools from pinning the raylet queue
@@ -908,8 +981,19 @@ class Raylet:
                 granted_any = True
 
     async def _grant(self, req: LeaseRequest) -> None:
+        container = (
+            ((req.payload.get("spec") or {}).get("runtime_env") or {})
+            .get("container")
+        )
         try:
-            handle = await self._get_or_start_idle_worker()
+            if container:
+                # Containerized actors get a dedicated fresh worker booted
+                # inside the image — shared pool workers cannot switch
+                # containers mid-process.
+                handle = await self._start_worker(container=container)
+                await handle.registered
+            else:
+                handle = await self._get_or_start_idle_worker()
         except rpc.RpcError as e:
             self.available = self.available + req.demand
             self._mark_dirty()
@@ -928,6 +1012,7 @@ class Raylet:
                     "worker_id": handle.worker_id,
                     "worker_addr": list(handle.addr),
                     "lease_id": req.lease_id,
+                    "fp_port": handle.fp_port,
                 }
             )
         else:  # caller gave up; return resources

@@ -21,3 +21,85 @@ class NodeAffinitySchedulingStrategy:
     def __init__(self, node_id: str, soft: bool = False):
         self.node_id = node_id
         self.soft = soft
+
+
+# Label match operators (reference: python/ray/util/scheduling_strategies.py
+# In/NotIn/Exists/DoesNotExist used by NodeLabelSchedulingStrategy).
+
+
+class In:
+    def __init__(self, *values: str):
+        self.values = [str(v) for v in values]
+
+    def to_wire(self):
+        return {"op": "in", "values": self.values}
+
+
+class NotIn:
+    def __init__(self, *values: str):
+        self.values = [str(v) for v in values]
+
+    def to_wire(self):
+        return {"op": "not_in", "values": self.values}
+
+
+class Exists:
+    def to_wire(self):
+        return {"op": "exists"}
+
+
+class DoesNotExist:
+    def to_wire(self):
+        return {"op": "does_not_exist"}
+
+
+def _expr_to_wire(expr):
+    if isinstance(expr, (In, NotIn, Exists, DoesNotExist)):
+        return expr.to_wire()
+    # Plain value = equality (sugar over In(value)).
+    return {"op": "in", "values": [str(expr)]}
+
+
+class NodeLabelSchedulingStrategy:
+    """Schedule on nodes matching label expressions (reference:
+    NodeLabelSchedulingStrategy + the NODE_LABEL policy in
+    src/ray/raylet/scheduling/policy/scheduling_options.h:30-44).
+
+    hard: every expression must match or the node is ineligible.
+    soft: preferred — among hard-eligible nodes, those also matching soft
+    win; if none match soft, hard-eligible nodes are still used.
+    """
+
+    def __init__(self, hard: Optional[dict] = None, soft: Optional[dict] = None):
+        if not hard and not soft:
+            raise ValueError("NodeLabelSchedulingStrategy needs hard or soft")
+        self.hard = dict(hard or {})
+        self.soft = dict(soft or {})
+
+    def to_wire(self) -> dict:
+        return {
+            "labels": {
+                "hard": {k: _expr_to_wire(v) for k, v in self.hard.items()},
+                "soft": {k: _expr_to_wire(v) for k, v in self.soft.items()},
+            }
+        }
+
+
+def match_label_expr(expr: dict, labels: dict, key: str) -> bool:
+    """Evaluate one wire expression against a node's label map."""
+    op = expr.get("op")
+    present = key in labels
+    if op == "exists":
+        return present
+    if op == "does_not_exist":
+        return not present
+    if op == "in":
+        return present and str(labels[key]) in expr.get("values", [])
+    if op == "not_in":
+        # Reference semantics: a missing label trivially satisfies NotIn.
+        return not present or str(labels[key]) not in expr.get("values", [])
+    return False
+
+
+def node_matches_labels(exprs: dict, labels: dict) -> bool:
+    return all(match_label_expr(e, labels or {}, k) for k, e in exprs.items())

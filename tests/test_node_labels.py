@@ -1,0 +1,128 @@
+"""NodeLabelSchedulingStrategy: label-gated task + actor placement
+(reference: python/ray/util/scheduling_strategies.py NodeLabelSchedulingStrategy,
+policy src/ray/raylet/scheduling/policy/scheduling_options.h:30-44)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util.scheduling_strategies import (
+    DoesNotExist,
+    Exists,
+    In,
+    NodeLabelSchedulingStrategy,
+    NotIn,
+    match_label_expr,
+    node_matches_labels,
+)
+
+
+def test_label_expression_semantics():
+    labels = {"region": "us-west", "tier": "gold"}
+    assert match_label_expr(In("us-west").to_wire(), labels, "region")
+    assert not match_label_expr(In("eu").to_wire(), labels, "region")
+    assert match_label_expr(NotIn("eu").to_wire(), labels, "region")
+    # Missing label satisfies NotIn, fails In/Exists, passes DoesNotExist.
+    assert match_label_expr(NotIn("x").to_wire(), labels, "absent")
+    assert not match_label_expr(In("x").to_wire(), labels, "absent")
+    assert match_label_expr(Exists().to_wire(), labels, "tier")
+    assert not match_label_expr(Exists().to_wire(), labels, "absent")
+    assert match_label_expr(DoesNotExist().to_wire(), labels, "absent")
+    # Plain string sugar == In(value).
+    wire = NodeLabelSchedulingStrategy(hard={"region": "us-west"}).to_wire()
+    assert wire["labels"]["hard"]["region"] == {"op": "in", "values": ["us-west"]}
+    assert node_matches_labels(wire["labels"]["hard"], labels)
+
+
+@pytest.fixture
+def label_cluster():
+    cluster = Cluster(
+        initialize_head=True, head_node_args={"num_cpus": 1}
+    )
+    cluster.add_node(num_cpus=2, labels={"accel": "tpu", "gen": "v5e"})
+    cluster.add_node(num_cpus=2, labels={"accel": "gpu"})
+    ray_tpu.init(address=cluster.address)
+    yield cluster
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+def test_task_hard_label_affinity(label_cluster):
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        import os
+
+        return os.environ["RAY_TPU_NODE_ID"]
+
+    tpu_node = {
+        n["node_id"]: n.get("labels") or {}
+        for n in ray_tpu.nodes()
+    }
+    tpu_ids = [k for k, v in tpu_node.items() if v.get("accel") == "tpu"]
+    gpu_ids = [k for k, v in tpu_node.items() if v.get("accel") == "gpu"]
+    assert len(tpu_ids) == 1 and len(gpu_ids) == 1
+
+    strat = NodeLabelSchedulingStrategy(hard={"accel": In("tpu")})
+    got = ray_tpu.get(
+        [
+            where.options(scheduling_strategy=strat).remote()
+            for _ in range(4)
+        ]
+    )
+    assert set(got) == {tpu_ids[0]}
+
+    # Anti-affinity: NOT the tpu node.
+    strat = NodeLabelSchedulingStrategy(hard={"accel": NotIn("tpu")})
+    got = ray_tpu.get(
+        [where.options(scheduling_strategy=strat).remote() for _ in range(4)]
+    )
+    assert tpu_ids[0] not in set(got)
+
+
+def test_task_unsatisfiable_hard_labels(label_cluster):
+    @ray_tpu.remote(num_cpus=1)
+    def f():
+        return 1
+
+    strat = NodeLabelSchedulingStrategy(hard={"accel": In("nonexistent")})
+    with pytest.raises(Exception):
+        ray_tpu.get(f.options(scheduling_strategy=strat).remote(), timeout=30)
+
+
+def test_soft_labels_prefer_but_fall_back(label_cluster):
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        import os
+
+        return os.environ["RAY_TPU_NODE_ID"]
+
+    nodes = {n["node_id"]: n.get("labels") or {} for n in ray_tpu.nodes()}
+    v5e = [k for k, v in nodes.items() if v.get("gen") == "v5e"]
+    # Soft preference for gen=v5e lands there...
+    strat = NodeLabelSchedulingStrategy(
+        hard={"accel": Exists()}, soft={"gen": In("v5e")}
+    )
+    got = ray_tpu.get(where.options(scheduling_strategy=strat).remote())
+    assert got == v5e[0]
+    # ...but a soft-only miss still schedules somewhere.
+    strat = NodeLabelSchedulingStrategy(soft={"gen": In("not-a-gen")})
+    assert ray_tpu.get(where.options(scheduling_strategy=strat).remote()) in nodes
+
+
+def test_actor_label_placement(label_cluster):
+    @ray_tpu.remote(num_cpus=1)
+    class Pinned:
+        def where(self):
+            import os
+
+            return os.environ["RAY_TPU_NODE_ID"]
+
+    nodes = {n["node_id"]: n.get("labels") or {} for n in ray_tpu.nodes()}
+    gpu = [k for k, v in nodes.items() if v.get("accel") == "gpu"]
+    a = Pinned.options(
+        scheduling_strategy=NodeLabelSchedulingStrategy(
+            hard={"accel": In("gpu")}
+        )
+    ).remote()
+    assert ray_tpu.get(a.where.remote()) == gpu[0]
+    ray_tpu.kill(a)

@@ -1,0 +1,105 @@
+"""Structured event framework (reference: src/ray/util/event.cc +
+python/ray/_private/event/event_logger.py).
+
+Events are operational facts about the cluster — node joined, node died,
+actor restarted — recorded two ways:
+  - durably: one JSON line per event appended to
+    <session>/logs/events/event_<SOURCE>.log (the reference's event file
+    layout, consumable by log shippers);
+  - queryably: a bounded in-memory ring served over the GCS ListEvents RPC
+    and the state API's list_cluster_events().
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import tempfile
+import time
+import uuid
+from typing import Any, Deque, Dict, List, Optional
+
+SEVERITIES = ("DEBUG", "INFO", "WARNING", "ERROR", "FATAL")
+
+
+class EventLogger:
+    def __init__(self, session_name: str, source: str, ring_size: int = 2000):
+        self.source = source
+        self.dir = os.path.join(
+            tempfile.gettempdir(), f"ray_tpu_{session_name}", "logs", "events"
+        )
+        self.path = os.path.join(self.dir, f"event_{source}.log")
+        self.ring: Deque[Dict[str, Any]] = collections.deque(maxlen=ring_size)
+        self._fh = None
+
+    def emit(
+        self,
+        label: str,
+        message: str,
+        severity: str = "INFO",
+        **custom_fields: Any,
+    ) -> Dict[str, Any]:
+        event = {
+            "event_id": uuid.uuid4().hex,
+            "timestamp": time.time(),
+            "severity": severity if severity in SEVERITIES else "INFO",
+            "label": label,
+            "message": message,
+            "source_type": self.source,
+            "source_pid": os.getpid(),
+            "custom_fields": custom_fields,
+        }
+        self.ring.append(event)
+        try:
+            if self._fh is None:
+                os.makedirs(self.dir, exist_ok=True)
+                self._fh = open(self.path, "a", buffering=1)
+            self._fh.write(json.dumps(event) + "\n")
+        except OSError:
+            pass  # events must never take the control plane down
+        return event
+
+    def list(
+        self,
+        severity: Optional[str] = None,
+        label: Optional[str] = None,
+        limit: int = 1000,
+    ) -> List[Dict[str, Any]]:
+        out = []
+        for e in reversed(self.ring):
+            if severity and e["severity"] != severity:
+                continue
+            if label and e["label"] != label:
+                continue
+            out.append(e)
+            if len(out) >= limit:
+                break
+        out.reverse()
+        return out
+
+    def close(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+
+
+def read_event_log(session_name: str, source: str) -> List[Dict[str, Any]]:
+    """Parse a session's durable event file (what a log shipper would see)."""
+    path = os.path.join(
+        tempfile.gettempdir(),
+        f"ray_tpu_{session_name}", "logs", "events", f"event_{source}.log",
+    )
+    events = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    events.append(json.loads(line))
+    except OSError:
+        pass
+    return events

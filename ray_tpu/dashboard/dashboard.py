@@ -50,12 +50,20 @@ refresh(); setInterval(refresh, 5000);
 
 
 class Dashboard:
-    def __init__(self, gcs_addr: Tuple[str, int], host: str = "127.0.0.1", port: int = 8265):
+    def __init__(
+        self,
+        gcs_addr: Tuple[str, int],
+        host: str = "127.0.0.1",
+        port: int = 8265,
+        session_name: str = "",
+    ):
         self.gcs_addr = gcs_addr
         self.host = host
         self.port = port
+        self.session_name = session_name
         self._conn = None
         self._runner = None
+        self._sd_writer = None
 
     async def _gcs(self, method: str, payload: Optional[dict] = None):
         from ray_tpu._private import rpc
@@ -85,9 +93,36 @@ class Dashboard:
         await site.start()
         if self.port == 0:
             self.port = site._server.sockets[0].getsockname()[1]
+        # Observability side outputs (reference: metrics_agent.py:595 file-SD
+        # + dashboard/modules/metrics generated Grafana dashboards): a stock
+        # Prometheus file_sd_config pointed at the session dir scrapes this
+        # dashboard's /metrics; the Grafana JSON is provisioning-ready.
+        try:
+            import os
+            import tempfile
+
+            from ray_tpu.util.metrics_export import (
+                PrometheusServiceDiscoveryWriter,
+                write_grafana_dashboards,
+            )
+
+            session_dir = os.path.join(
+                tempfile.gettempdir(),
+                f"ray_tpu_{self.session_name or 'default'}",
+            )
+            self._sd_writer = PrometheusServiceDiscoveryWriter(
+                lambda: [f"{self.host}:{self.port}"], session_dir
+            )
+            self._sd_writer.start()
+            write_grafana_dashboards(session_dir)
+        except Exception:
+            pass
         return self.host, self.port
 
     async def stop(self) -> None:
+        if self._sd_writer is not None:
+            self._sd_writer.stop()
+            self._sd_writer = None
         if self._runner is not None:
             await self._runner.cleanup()
         if self._conn is not None:

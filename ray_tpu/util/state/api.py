@@ -36,6 +36,21 @@ def list_nodes(filters=None, limit: int = 10000) -> List[dict]:
     return _filter(rows, filters)[:limit]
 
 
+def list_cluster_events(
+    severity: Optional[str] = None,
+    label: Optional[str] = None,
+    limit: int = 1000,
+) -> List[dict]:
+    """Structured cluster events (reference: python/ray/_private/event/ +
+    `ray list cluster-events`): node membership, actor failures/restarts,
+    emitted by the GCS event logger and durably appended to
+    <session>/logs/events/event_GCS.log."""
+    return _call_gcs(
+        "ListEvents",
+        {"severity": severity, "label": label, "limit": limit},
+    )["events"]
+
+
 def list_actors(filters=None, limit: int = 10000) -> List[dict]:
     rows = _call_gcs("ListActors")["actors"]
     return _filter(rows, filters)[:limit]

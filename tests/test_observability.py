@@ -1,0 +1,106 @@
+"""Observability closure: Prometheus file-SD, generated Grafana dashboards,
+structured events (reference: _private/metrics_agent.py:595,
+dashboard/modules/metrics/, src/ray/util/event.cc + _private/event/)."""
+
+import json
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.metrics_export import (
+    PrometheusServiceDiscoveryWriter,
+    generate_grafana_dashboard,
+    write_grafana_dashboards,
+)
+
+
+def test_file_sd_output_matches_prometheus_schema(tmp_path):
+    """The written JSON is exactly what a stock Prometheus file_sd_config
+    consumes: a list of {targets: [str], labels: {str: str}} groups."""
+    targets = ["127.0.0.1:8265", "10.0.0.2:8265"]
+    w = PrometheusServiceDiscoveryWriter(
+        lambda: list(targets), str(tmp_path), labels={"cluster": "test"}
+    )
+    path = w.write_once()
+    groups = json.loads(open(path).read())
+    assert isinstance(groups, list) and len(groups) == 1
+    g = groups[0]
+    assert set(g) == {"targets", "labels"}
+    assert g["targets"] == sorted(targets)
+    assert g["labels"]["job"] == "ray_tpu"
+    assert g["labels"]["cluster"] == "test"
+    assert all(isinstance(t, str) for t in g["targets"])
+    assert all(
+        isinstance(k, str) and isinstance(v, str)
+        for k, v in g["labels"].items()
+    )
+    # Background refresh picks up target changes.
+    w.interval_s = 0.05
+    w.start()
+    targets.append("10.0.0.3:8265")
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if "10.0.0.3:8265" in json.loads(open(path).read())[0]["targets"]:
+            break
+        time.sleep(0.05)
+    w.stop()
+    assert "10.0.0.3:8265" in json.loads(open(path).read())[0]["targets"]
+
+
+def test_grafana_dashboard_generation(tmp_path):
+    dash = generate_grafana_dashboard(extra_metrics=["my_app_qps"])
+    assert dash["uid"] == "ray-tpu-core"
+    assert dash["panels"], "dashboard must have panels"
+    exprs = [p["targets"][0]["expr"] for p in dash["panels"]]
+    assert "my_app_qps" in exprs
+    for p in dash["panels"]:
+        assert p["type"] == "timeseries"
+        assert p["targets"][0]["refId"] == "A"
+    out = write_grafana_dashboards(str(tmp_path), ["my_app_qps"])
+    written = json.loads(open(out).read())
+    assert written["title"] == "Ray TPU Core"
+
+
+def test_structured_events_emitted_and_queryable(shutdown_only):
+    """Node membership and actor failure produce events, queryable via the
+    state API and durably appended to the session's event log file."""
+    from ray_tpu.util.state.api import list_cluster_events
+
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+
+    events = list_cluster_events()
+    labels = [e["label"] for e in events]
+    assert "NODE_ADDED" in labels
+
+    @ray_tpu.remote(max_restarts=0)
+    class Doomed:
+        def die(self):
+            import os
+
+            os._exit(1)
+
+    a = Doomed.remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(a.die.remote())
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        dead = list_cluster_events(label="ACTOR_DEAD")
+        if dead:
+            break
+        time.sleep(0.2)
+    assert dead and dead[-1]["severity"] == "ERROR"
+    assert "custom_fields" in dead[-1] and dead[-1]["custom_fields"]["actor_id"]
+
+    # Severity filter works.
+    assert all(
+        e["severity"] == "ERROR" for e in list_cluster_events(severity="ERROR")
+    )
+
+    # Durable JSONL file parses back to the same events.
+    from ray_tpu._private.events import read_event_log
+    from ray_tpu._private.worker import global_worker
+
+    session = global_worker.node.session_name
+    on_disk = read_event_log(session, "GCS")
+    assert any(e["label"] == "NODE_ADDED" for e in on_disk)

@@ -180,10 +180,11 @@ class PPO(Algorithm):
     def training_step(self) -> Dict[str, Any]:
         cfg = self.config
         n_runners = max(1, cfg.num_env_runners)
-        # Multi-agent runners step ONE env each (num_envs_per_env_runner is
-        # not vectorized there), so the per-runner step count must not be
-        # divided by it or the train batch silently shrinks.
-        envs_per_runner = 1 if self.multi_agent else cfg.num_envs_per_env_runner
+        # Both runner kinds vectorize num_envs_per_env_runner (multi-agent
+        # runners step num_envs env copies per lockstep step), so the
+        # per-runner step count divides by it in both cases — the train
+        # batch stays at train_batch_size env steps.
+        envs_per_runner = cfg.num_envs_per_env_runner
         steps_per_runner = max(
             1, cfg.train_batch_size // (n_runners * envs_per_runner)
         )

@@ -29,7 +29,7 @@ class MultiAgentEnvRunner:
         seed: int = 0,
         worker_index: int = 0,
         env_config: Optional[Dict[str, Any]] = None,
-        num_envs: int = 1,  # accepted for group-API parity; one env per runner
+        num_envs: int = 1,  # env copies stepped in lockstep (vector sampling)
         policy_kind: str = "pi_vf",
     ):
         import jax
@@ -45,7 +45,13 @@ class MultiAgentEnvRunner:
                 "multi-agent envs are passed as factory callables "
                 "(config.environment(env=lambda cfg: MyMultiAgentEnv(cfg)))"
             )
-        self.env = env_factory(env_config or {})
+        # Vectorized sampling: num_envs env copies step in lockstep; each
+        # policy still performs ONE batched jitted forward per step, over
+        # num_envs * n_agents rows (reference: MultiAgentEnvRunner over
+        # gymnasium vector envs).
+        self.num_envs = max(1, int(num_envs))
+        self.envs = [env_factory(env_config or {}) for _ in range(self.num_envs)]
+        self.env = self.envs[0]  # spaces/agents template
         self.worker_index = worker_index
         self.rng = jax.random.PRNGKey(seed * 10007 + worker_index + 17)
 
@@ -99,15 +105,18 @@ class MultiAgentEnvRunner:
 
             self._policy_step[pid] = jax.jit(_step)
 
-        self._obs, _ = self.env.reset(seed=seed * 7919 + worker_index)
-        # Per-agent liveness: an individually-terminated agent may drop out
-        # of subsequent obs dicts while the episode continues; its slot then
-        # replays its last obs with zero reward and terminated=True (the
-        # GAE mask zeroes any contribution).
-        self._last_obs = dict(self._obs)
-        self._agent_done = {a: False for a in self.agents}
-        self._episode_return = 0.0
-        self._episode_len = 0
+        # Per-env state. Per-agent liveness: an individually-terminated
+        # agent may drop out of subsequent obs dicts while the episode
+        # continues; its slot then replays its last obs with zero reward and
+        # terminated=True (the GAE mask zeroes any contribution).
+        self._last_obs = []
+        self._agent_done = []
+        self._episode_return = [0.0] * self.num_envs
+        self._episode_len = [0] * self.num_envs
+        for e, env in enumerate(self.envs):
+            obs, _ = env.reset(seed=seed * 7919 + worker_index * 101 + e)
+            self._last_obs.append(dict(obs))
+            self._agent_done.append({a: False for a in self.agents})
         self._completed: collections.deque = collections.deque(maxlen=100)
         self._weights_version = 0
 
@@ -134,19 +143,27 @@ class MultiAgentEnvRunner:
     # -- sampling ------------------------------------------------------------
 
     def _obs_mat(self, pid: str) -> np.ndarray:
+        """[num_envs * n_agents, obs_dim] — env-major, agent-minor rows."""
         return np.stack(
-            [np.asarray(self._last_obs[a], dtype=np.float32).reshape(-1)
-             for a in self.agents_of[pid]]
+            [
+                np.asarray(self._last_obs[e][a], dtype=np.float32).reshape(-1)
+                for e in range(self.num_envs)
+                for a in self.agents_of[pid]
+            ]
         )
 
     def sample(self, num_steps: int, **_ignored) -> Dict[str, Any]:
-        """num_steps env steps. Returns {"policies": {pid: batch}, ...} where
-        each batch is single-agent-shaped: [T, n_agents_of_policy, ...]."""
+        """num_steps lockstep steps of every env copy. Returns
+        {"policies": {pid: batch}, ...} where each batch is
+        single-agent-shaped: [T, num_envs * n_agents_of_policy, ...] —
+        env copies and a policy's agents both ride the batch axis, so the
+        per-policy learner path is unchanged."""
         T = num_steps
+        E = self.num_envs
         pids = [p for p in self.policy_ids if self.agents_of[p]]
         buf: Dict[str, Dict[str, np.ndarray]] = {}
         for pid in pids:
-            n = len(self.agents_of[pid])
+            n = len(self.agents_of[pid]) * E
             d = self.specs[pid].obs_dim
             buf[pid] = {
                 "obs": np.empty((T, n, d), np.float32),
@@ -165,58 +182,66 @@ class MultiAgentEnvRunner:
 
         env_steps = 0
         for t in range(T):
-            action_dict: Dict[Any, Any] = {}
+            # One batched forward per policy over ALL envs' agents.
+            acts_of: Dict[str, np.ndarray] = {}
             for pid in pids:
                 obs_mat = self._obs_mat(pid)
                 buf[pid]["obs"][t] = obs_mat
                 actions, logp, value = self._policy_step[pid](
                     self.params[pid], self._next_rng(), obs_mat
                 )
-                actions = np.asarray(actions)
-                buf[pid]["actions"][t] = actions
+                acts_of[pid] = np.asarray(actions)
+                buf[pid]["actions"][t] = acts_of[pid]
                 buf[pid]["logp"][t] = np.asarray(logp)
                 buf[pid]["values"][t] = np.asarray(value)
-                for i, aid in enumerate(self.agents_of[pid]):
-                    if not self._agent_done[aid]:
-                        action_dict[aid] = int(actions[i])
-            next_obs, rewards, terms, truncs, _infos = self.env.step(action_dict)
-            env_steps += 1
-            all_term = bool(terms.get("__all__", False))
-            all_trunc = bool(truncs.get("__all__", False))
-            for pid in pids:
-                for i, aid in enumerate(self.agents_of[pid]):
-                    done_before = self._agent_done[aid]
-                    buf[pid]["mask"][t, i] = 0.0 if done_before else 1.0
-                    buf[pid]["rewards"][t, i] = (
-                        0.0 if done_before else float(rewards.get(aid, 0.0))
-                    )
-                    buf[pid]["terminateds"][t, i] = bool(
-                        done_before or terms.get(aid, all_term)
-                    )
-                    buf[pid]["truncateds"][t, i] = bool(
-                        truncs.get(aid, all_trunc)
-                    )
-                    buf[pid]["next_obs"][t, i] = np.asarray(
-                        next_obs.get(aid, self._last_obs[aid]),
-                        dtype=np.float32,
-                    ).reshape(-1)
-            self._episode_return += float(sum(rewards.values()))
-            self._episode_len += 1
-            if all_term or all_trunc:
-                self._completed.append(
-                    (self._episode_return, self._episode_len)
+            for e in range(E):
+                action_dict: Dict[Any, Any] = {}
+                for pid in pids:
+                    na = len(self.agents_of[pid])
+                    for i, aid in enumerate(self.agents_of[pid]):
+                        if not self._agent_done[e][aid]:
+                            action_dict[aid] = int(acts_of[pid][e * na + i])
+                next_obs, rewards, terms, truncs, _infos = self.envs[e].step(
+                    action_dict
                 )
-                self._episode_return, self._episode_len = 0.0, 0
-                self._obs, _ = self.env.reset()
-                self._last_obs = dict(self._obs)
-                self._agent_done = {a: False for a in self.agents}
-            else:
-                self._obs = next_obs
-                for aid in self.agents:
-                    if aid in next_obs:
-                        self._last_obs[aid] = next_obs[aid]
-                    if terms.get(aid) or truncs.get(aid):
-                        self._agent_done[aid] = True
+                env_steps += 1
+                all_term = bool(terms.get("__all__", False))
+                all_trunc = bool(truncs.get("__all__", False))
+                for pid in pids:
+                    na = len(self.agents_of[pid])
+                    for i, aid in enumerate(self.agents_of[pid]):
+                        s = e * na + i
+                        done_before = self._agent_done[e][aid]
+                        buf[pid]["mask"][t, s] = 0.0 if done_before else 1.0
+                        buf[pid]["rewards"][t, s] = (
+                            0.0 if done_before else float(rewards.get(aid, 0.0))
+                        )
+                        buf[pid]["terminateds"][t, s] = bool(
+                            done_before or terms.get(aid, all_term)
+                        )
+                        buf[pid]["truncateds"][t, s] = bool(
+                            truncs.get(aid, all_trunc)
+                        )
+                        buf[pid]["next_obs"][t, s] = np.asarray(
+                            next_obs.get(aid, self._last_obs[e][aid]),
+                            dtype=np.float32,
+                        ).reshape(-1)
+                self._episode_return[e] += float(sum(rewards.values()))
+                self._episode_len[e] += 1
+                if all_term or all_trunc:
+                    self._completed.append(
+                        (self._episode_return[e], self._episode_len[e])
+                    )
+                    self._episode_return[e], self._episode_len[e] = 0.0, 0
+                    obs, _ = self.envs[e].reset()
+                    self._last_obs[e] = dict(obs)
+                    self._agent_done[e] = {a: False for a in self.agents}
+                else:
+                    for aid in self.agents:
+                        if aid in next_obs:
+                            self._last_obs[e][aid] = next_obs[aid]
+                        if terms.get(aid) or truncs.get(aid):
+                            self._agent_done[e][aid] = True
 
         out_policies: Dict[str, Dict[str, Any]] = {}
         for pid in pids:
@@ -252,4 +277,5 @@ class MultiAgentEnvRunner:
         }
 
     def stop(self) -> None:
-        self.env.close()
+        for env in self.envs:
+            env.close()

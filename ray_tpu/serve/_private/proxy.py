@@ -252,10 +252,18 @@ class ProxyActor:
                 "serve_multiplexed_model_id", ""
             ),
         }
-        # Streaming response mode (reference: StreamingResponse from a
-        # generator deployment): strictly opt-in via header — Accept:
-        # text/event-stream is NOT honored because the body is raw chunks,
-        # not SSE framing, and would break EventSource clients.
+        # Streaming response modes (reference: StreamingResponse from a
+        # generator deployment + the fastapi SSE integration):
+        #   Accept: text/event-stream  -> standards-compliant SSE framing
+        #     (each yielded item becomes one `data:` event; EventSource
+        #     clients work unmodified);
+        #   serve-streaming header    -> raw chunked bytes (legacy opt-in
+        #     for binary streams).
+        accept = request.headers.get("Accept", "")
+        if "text/event-stream" in accept:
+            return await self._handle_streaming(
+                request, dep_id_str, meta, http_req, sse=True
+            )
         if request.headers.get("serve-streaming"):
             return await self._handle_streaming(
                 request, dep_id_str, meta, http_req
@@ -272,16 +280,39 @@ class ProxyActor:
         status, payload, ctype = _to_response(result)
         return web.Response(status=status, body=payload, content_type=ctype.split(";")[0])
 
-    async def _handle_streaming(self, request, dep_id_str, meta, http_req):
-        """Chunked HTTP response: each item the replica's generator yields
-        is written as soon as it arrives (bytes as-is, str utf-8, other
-        values JSON + newline)."""
+    @staticmethod
+    def _sse_frame(item) -> bytes:
+        """One server-sent event per yielded item. Multi-line payloads get
+        one `data:` line each (SSE spec: consecutive data lines join with
+        newline on the client)."""
+        if isinstance(item, bytes):
+            text = item.decode("utf-8", "replace")
+        elif isinstance(item, str):
+            text = item
+        else:
+            text = json.dumps(item)
+        lines = text.split("\n")
+        return ("".join(f"data: {ln}\n" for ln in lines) + "\n").encode()
+
+    async def _handle_streaming(
+        self, request, dep_id_str, meta, http_req, sse: bool = False
+    ):
+        """Streamed HTTP response: each item the replica's generator yields
+        is written as soon as it arrives. sse=True uses text/event-stream
+        framing (Accept-negotiated); otherwise raw chunks (bytes as-is,
+        str utf-8, other values JSON + newline)."""
         from aiohttp import web
 
-        resp = web.StreamResponse(
-            status=200,
-            headers={"Content-Type": "application/octet-stream"},
+        headers = (
+            {
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            }
+            if sse
+            else {"Content-Type": "application/octet-stream"}
         )
+        resp = web.StreamResponse(status=200, headers=headers)
         started = False
         try:
             async for item in self._router.assign_request_streaming(
@@ -290,7 +321,9 @@ class ProxyActor:
                 if not started:
                     await resp.prepare(request)
                     started = True
-                if isinstance(item, bytes):
+                if sse:
+                    chunk = self._sse_frame(item)
+                elif isinstance(item, bytes):
                     chunk = item
                 elif isinstance(item, str):
                     chunk = item.encode()

@@ -353,3 +353,41 @@ def test_replica_recovery_after_kill(serve_cluster):
         except Exception:
             time.sleep(0.5)
     assert ok, "service did not recover after replica kill"
+
+
+def test_sse_streaming_via_accept_header(serve_cluster):
+    """Accept: text/event-stream negotiates standards-compliant SSE framing
+    — every yielded item becomes one `data:` event an EventSource client
+    can parse (reference: serve streaming + SSE integrations)."""
+    serve = serve_cluster
+    serve.start(http_options={"host": "127.0.0.1", "port": 0})
+
+    @serve.deployment
+    class Tokens:
+        def __call__(self, request):
+            yield "hello"
+            yield {"k": 1}
+            yield "multi\nline"
+
+    serve.run(Tokens.bind(), name="sse", route_prefix="/sse")
+    import ray_tpu
+
+    controller = ray_tpu.get_actor("SERVE_CONTROLLER", namespace="serve")
+    cfg = ray_tpu.get(controller.get_http_config.remote())
+
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{cfg['port']}/sse",
+        data=b"x",
+        headers={"Accept": "text/event-stream"},
+    )
+    resp = urllib.request.urlopen(req, timeout=30)
+    assert resp.headers["Content-Type"].startswith("text/event-stream")
+    body = resp.read().decode()
+    # SSE framing: one event per yield, blank-line separated; the multiline
+    # item becomes consecutive data: lines of ONE event.
+    events = [e for e in body.split("\n\n") if e]
+    assert events[0] == "data: hello"
+    assert events[1] == 'data: {"k": 1}'
+    assert events[2] == "data: multi\ndata: line"

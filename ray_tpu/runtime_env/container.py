@@ -1,0 +1,73 @@
+"""Container runtime-env: run a worker inside podman/docker (reference:
+python/ray/_private/runtime_env/container.py — the worker command is
+wrapped in a `podman run` argv; the container shares the host network so
+raylet/GCS/object-store TCP endpoints keep working).
+
+Scope: actors own their process, so `runtime_env={"container": {...}}` on
+an actor makes the raylet spawn THAT actor's worker inside the container
+(tasks in shared pool workers cannot switch containers mid-process; the
+reference has the same per-worker granularity).
+
+The container runtime binary is discovered on PATH (podman preferred,
+docker fallback) — tests put a fake `podman` shim first on PATH, exactly
+like the GCE provider's injectable gcloud runner.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Any, Dict, List, Optional
+
+
+def find_container_runtime() -> Optional[str]:
+    for binary in ("podman", "docker"):
+        path = shutil.which(binary)
+        if path:
+            return path
+    return None
+
+
+def build_container_argv(
+    spec: Dict[str, Any],
+    inner_argv: List[str],
+    env: Dict[str, str],
+    runtime: Optional[str] = None,
+) -> List[str]:
+    """The full argv that boots `inner_argv` inside the requested image.
+
+    spec: {"image": str, "run_options": [str, ...], "worker_path": str?}
+      - image: required container image.
+      - run_options: extra args spliced into `run` (mounts, --gpus, ...).
+      - worker_path: python inside the image (default: python3).
+    env vars are passed through with --env so the worker finds its raylet,
+    GCS, session, and IDs; --network=host keeps every TCP endpoint valid.
+    """
+    image = spec.get("image")
+    if not image:
+        raise ValueError("runtime_env container spec needs an 'image'")
+    runtime = runtime or find_container_runtime()
+    if runtime is None:
+        raise RuntimeError(
+            "runtime_env container requested but neither podman nor docker "
+            "is on PATH"
+        )
+    argv = [
+        runtime,
+        "run",
+        "--rm",
+        "--network=host",
+        # The shm object store is host-shared memory: the worker must see
+        # the same /dev/shm to map plasma segments zero-copy.
+        "-v", "/dev/shm:/dev/shm",
+        "-v", "/tmp:/tmp",
+    ]
+    for k, v in env.items():
+        argv += ["--env", f"{k}={v}"]
+    argv += list(spec.get("run_options") or [])
+    argv.append(str(image))
+    python = spec.get("worker_path", "python3")
+    # inner_argv is [sys.executable, "-m", "ray_tpu._private.worker_main"];
+    # inside the image the interpreter is the image's python.
+    argv += [python] + list(inner_argv[1:])
+    return argv

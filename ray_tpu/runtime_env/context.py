@@ -22,7 +22,10 @@ _EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
 class RuntimeEnv(dict):
     """Dict subclass for API parity with ray.runtime_env.RuntimeEnv."""
 
-    KNOWN = {"env_vars", "working_dir", "py_modules", "pip", "conda", "config"}
+    KNOWN = {
+        "env_vars", "working_dir", "py_modules", "pip", "conda",
+        "container", "config",
+    }
 
     def __init__(self, **kwargs):
         unknown = set(kwargs) - self.KNOWN
@@ -80,13 +83,13 @@ async def prepare(core, runtime_env: Optional[Dict[str, Any]]) -> Optional[Dict]
     if env.get("pip"):
         env["pip"] = _normalize_pip(env["pip"])
     if env.get("conda"):
-        # Fail loudly at submission time rather than silently ignoring the
-        # request (conda env provisioning is not supported; use pip or bake
-        # dependencies into the image).
-        raise ValueError(
-            "runtime_env conda environments are not supported; use "
-            "runtime_env={'pip': [...]} or bake dependencies into the image"
-        )
+        env["conda"] = _normalize_conda(env["conda"])
+    if env.get("container"):
+        spec = env["container"]
+        if not isinstance(spec, dict) or not spec.get("image"):
+            raise ValueError(
+                "runtime_env container spec must be a dict with an 'image'"
+            )
     return env
 
 
@@ -159,10 +162,11 @@ async def apply_runtime_env(
         site = await ensure_pip_env(pip)
         if site:
             _activate_pip_site(site)
-    if runtime_env.get("conda"):
-        raise RuntimeError(
-            "runtime_env conda environments are not supported on this worker"
-        )
+    conda = runtime_env.get("conda")
+    if conda:
+        prefix = await ensure_conda_env(conda)
+        if prefix:
+            _activate_conda_env(prefix)
 
 
 def _pip_env_key(spec: Dict[str, Any]) -> str:
@@ -277,6 +281,161 @@ async def ensure_pip_env(pip: Any) -> Optional[str]:
             with open(marker, "w") as f:
                 f.write("ok")
             return _site_packages(dest)
+        except BaseException:
+            import shutil
+
+            shutil.rmtree(dest, ignore_errors=True)
+            raise
+    finally:
+        try:
+            fcntl.flock(lock_f, fcntl.LOCK_UN)
+        except OSError:
+            pass
+        lock_f.close()
+
+
+# -- conda (reference: runtime_env/conda.py) ---------------------------------
+
+
+def _normalize_conda(conda: Any) -> Dict[str, Any]:
+    """Accepts a named env (str), an environment.yml path (str ending in
+    .yml/.yaml, read driver-side), or an inline spec dict."""
+    if isinstance(conda, str):
+        if conda.endswith((".yml", ".yaml")):
+            import yaml
+
+            with open(os.path.expanduser(conda)) as f:
+                spec = yaml.safe_load(f) or {}
+            if not isinstance(spec, dict):
+                raise ValueError(f"conda yaml {conda!r} is not a mapping")
+            return spec
+        return {"name": conda, "_existing": True}
+    if isinstance(conda, dict):
+        return dict(conda)
+    raise ValueError(f"unsupported runtime_env conda spec: {conda!r}")
+
+
+def _conda_site_packages(prefix: str) -> str:
+    import glob
+
+    hits = glob.glob(os.path.join(prefix, "lib", "python*", "site-packages"))
+    return hits[0] if hits else os.path.join(
+        prefix, "lib",
+        f"python{sys.version_info.major}.{sys.version_info.minor}",
+        "site-packages",
+    )
+
+
+_active_conda_prefix: Optional[str] = None
+
+
+def _activate_conda_env(prefix: str) -> None:
+    """Switch this worker to the conda env: its site-packages goes on
+    sys.path (with the previous env's modules evicted, mirroring
+    _activate_pip_site) and CONDA_PREFIX/PATH point at it so subprocesses
+    see the env too."""
+    global _active_conda_prefix
+    if _active_conda_prefix == prefix:
+        return
+    old = _active_conda_prefix
+    if old is not None:
+        old_site = _conda_site_packages(old)
+        try:
+            sys.path.remove(old_site)
+        except ValueError:
+            pass
+        for name, mod in list(sys.modules.items()):
+            f = getattr(mod, "__file__", None)
+            if f and f.startswith(old_site + os.sep):
+                del sys.modules[name]
+    site = _conda_site_packages(prefix)
+    if site not in sys.path:
+        sys.path.insert(0, site)
+    os.environ["CONDA_PREFIX"] = prefix
+    bindir = os.path.join(prefix, "bin")
+    if bindir not in os.environ.get("PATH", "").split(os.pathsep):
+        os.environ["PATH"] = bindir + os.pathsep + os.environ.get("PATH", "")
+    _active_conda_prefix = prefix
+
+
+def _conda_env_key(spec: Dict[str, Any]) -> str:
+    import json
+
+    blob = json.dumps(spec, sort_keys=True).encode()
+    return hashlib.sha1(blob).hexdigest()[:20]
+
+
+async def ensure_conda_env(conda: Any) -> Optional[str]:
+    """Worker-side: provision (or reuse) the conda env; returns its prefix.
+
+    Named existing envs resolve through `conda run`; spec dicts create a
+    per-hash cached env with `conda env create -p <prefix> -f <yaml>` under
+    the same flock install-election protocol as pip envs (reference:
+    runtime_env/conda.py per-hash cached envs). The conda binary comes from
+    PATH — tests inject a shim, like the GCE provider's fake gcloud."""
+    import asyncio
+    import fcntl
+
+    spec = _normalize_conda(conda)
+
+    async def _run(cmd, what):
+        proc = await asyncio.create_subprocess_exec(
+            *cmd,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.STDOUT,
+        )
+        out, _ = await proc.communicate()
+        if proc.returncode != 0:
+            raise RuntimeError(f"{what} failed: {out.decode()[-2000:]}")
+        return out.decode()
+
+    if spec.get("_existing"):
+        out = await _run(
+            [
+                "conda", "run", "-n", spec["name"], "python", "-c",
+                "import sys; print(sys.prefix)",
+            ],
+            f"conda env lookup of {spec['name']!r}",
+        )
+        prefix = out.strip().splitlines()[-1]
+        return prefix
+
+    key = _conda_env_key(spec)
+    dest = os.path.join(EXTRACT_ROOT, "conda", key)
+    marker = os.path.join(dest, ".ready")
+    if os.path.exists(marker):
+        return dest
+    os.makedirs(os.path.dirname(dest), exist_ok=True)
+    lock_f = open(dest + ".flock", "a+")
+    try:
+        await asyncio.get_running_loop().run_in_executor(
+            None, fcntl.flock, lock_f, fcntl.LOCK_EX
+        )
+        if os.path.exists(marker):  # another installer finished meanwhile
+            return dest
+        try:
+            import json as _json
+            import shutil
+            import tempfile
+
+            shutil.rmtree(dest, ignore_errors=True)
+            yml = {k: v for k, v in spec.items() if not k.startswith("_")}
+            with tempfile.NamedTemporaryFile(
+                "w", suffix=".yml", delete=False
+            ) as f:
+                # JSON is valid YAML; no yaml dependency needed worker-side.
+                _json.dump(yml, f)
+                yml_path = f.name
+            try:
+                await _run(
+                    ["conda", "env", "create", "-p", dest, "-f", yml_path],
+                    f"conda env create for {yml}",
+                )
+            finally:
+                os.unlink(yml_path)
+            with open(marker, "w") as f:
+                f.write("ok")
+            return dest
         except BaseException:
             import shutil
 

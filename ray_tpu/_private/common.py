@@ -90,6 +90,15 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     # direct_task_transport.h:75). Auto-disabled per task when tracing or
     # profile events need the RPC path's instrumentation.
     "fastpath_enabled": True,
+    # Max bytes of concurrent inbound object transfers a raylet admits
+    # (reference: pull_manager.h bandwidth-capped pulls). Head-of-line
+    # pulls exceed it rather than deadlock.
+    "pull_max_bytes_in_flight": 256 * 1024 * 1024,
+    # Fork workers from a preloaded zygote process (reference:
+    # worker_pool.cc prestart) instead of cold `python -m` spawns —
+    # ~10ms vs ~0.5-1.5s per worker, the difference between seconds and
+    # minutes when a thousand actors start at once.
+    "worker_zygote_enabled": True,
     # OTel-style task tracing spans with context propagation (reference:
     # ray.init(_tracing_startup_hook) + tracing_helper.py). Off by default.
     "task_trace_spans": False,

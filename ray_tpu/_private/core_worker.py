@@ -1299,7 +1299,9 @@ class CoreWorker:
             raise GetTimeoutError("get timed out")
         return rem
 
-    async def _resolve_payload(self, ref: ObjectRef, deadline) -> bytes:
+    async def _resolve_payload(
+        self, ref: ObjectRef, deadline, purpose: str = "get"
+    ) -> bytes:
         oid = ref.hex()
         entry = self.memory_store.get(oid)
         owned = oid in self.reference_table.entries and self.reference_table.entries[oid].owned
@@ -1313,7 +1315,9 @@ class CoreWorker:
             recoveries = 0
             while True:
                 try:
-                    return await self._fetch_plasma(oid, entry.plasma_addr, deadline)
+                    return await self._fetch_plasma(
+                        oid, entry.plasma_addr, deadline, purpose
+                    )
                 except (ObjectLostError, rpc.RpcError):
                     # Primary copy gone (node death, eviction). If we own it
                     # and have lineage, recompute; else propagate.
@@ -1336,13 +1340,15 @@ class CoreWorker:
             return found[oid]
         return await self._fetch_from_owner(ref, deadline)
 
-    async def _fetch_plasma(self, oid: str, plasma_addr, deadline) -> memoryview:
+    async def _fetch_plasma(
+        self, oid: str, plasma_addr, deadline, purpose: str = "get"
+    ) -> memoryview:
         if tuple(plasma_addr) == self.raylet_addr:
             found, missing = await self.plasma.get([oid], timeout=self._remaining(deadline))
             if oid in found:
                 return found[oid]
             raise ObjectLostError(f"object {oid[:12]} lost from local store")
-        return await self.plasma.pull(oid, tuple(plasma_addr))
+        return await self.plasma.pull(oid, tuple(plasma_addr), purpose)
 
     async def _fetch_from_owner(self, ref: ObjectRef, deadline) -> bytes:
         if ref.owner_addr is None:
@@ -2524,6 +2530,14 @@ class CoreWorker:
             if reply.get("error") is None and wire.get("max_retries", 0) > 0:
                 self._register_lineage(wire, reply)
         except Exception as e:
+            if isinstance(e, rpc.ConnectionLost):
+                # Callers' retry loops catch RayTpuError (the documented
+                # pattern); a raw transport error must not leak past them
+                # when the real meaning is "the actor's process went away".
+                e = ActorUnavailableError(
+                    f"actor {wire['actor_id'][:8]} unreachable for task "
+                    f"{wire['name']!r}: {e}"
+                )
             self._store_task_error(wire, e)
         finally:
             self._cleanup_task(wire)

@@ -516,20 +516,54 @@ class GcsServer:
 
     async def _actor_scheduler_loop(self) -> None:
         """Places pending actors on nodes as resources allow (analog of
-        GcsActorScheduler). Runs whenever resources or the queue change."""
+        GcsActorScheduler). Placements run CONCURRENTLY under a bounded
+        semaphore — each placement awaits a full lease -> worker spawn ->
+        CreateActor round trip, and serializing those would make N actors
+        cost N round trips of wall clock (the reference scheduler also
+        leases in parallel). Runs whenever resources or the queue change."""
+        sem = asyncio.Semaphore(64)
+        placing: set = set()
+
+        async def place_one(actor_id: str) -> None:
+            async with sem:
+                actor = self.actors.get(actor_id)
+                if actor is None or actor.state not in (
+                    PENDING_CREATION, RESTARTING,
+                ):
+                    placing.discard(actor_id)
+                    return
+                try:
+                    placed = await self._try_place_actor(actor)
+                except Exception:
+                    # An unexpected error (e.g. a lease RPC timing out
+                    # under extreme load) must requeue the actor, never
+                    # kill placement — every pending actor depends on it.
+                    logger.exception(
+                        "placing actor %s failed; will retry", actor_id[:8]
+                    )
+                    placed = False
+                placing.discard(actor_id)
+                if not placed:
+                    await asyncio.sleep(0.2)  # resources busy; retry paced
+                    self._pending_actor_queue.append(actor_id)
+                    self._wake_scheduler.set()
+
         while True:
             await self._wake_scheduler.wait()
             self._wake_scheduler.clear()
-            remaining: List[str] = []
-            for actor_id in self._pending_actor_queue:
-                actor = self.actors.get(actor_id)
-                if actor is None or actor.state not in (PENDING_CREATION, RESTARTING):
+            queue, self._pending_actor_queue = self._pending_actor_queue, []
+            requeue: List[str] = []
+            for actor_id in queue:
+                if actor_id in placing:
+                    # A placement for this actor is already in flight; the
+                    # event behind this entry (e.g. a second death) must
+                    # not be dropped — re-examine it next round.
+                    requeue.append(actor_id)
                     continue
-                placed = await self._try_place_actor(actor)
-                if not placed:
-                    remaining.append(actor_id)
-            self._pending_actor_queue = remaining
-            if remaining:
+                placing.add(actor_id)
+                rpc.spawn(place_one(actor_id))
+            if requeue:
+                self._pending_actor_queue.extend(requeue)
                 await asyncio.sleep(0.2)
                 self._wake_scheduler.set()
 
@@ -587,8 +621,18 @@ class GcsServer:
             reply = await node.conn.call(
                 "LeaseWorkerForActor", {"spec": actor.spec}, timeout=120
             )
-        except rpc.RpcError as e:
-            logger.warning("actor lease on %s failed: %s", node.node_id[:8], e)
+        except (rpc.RpcError, asyncio.TimeoutError) as e:
+            # On timeout the raylet may still hold the queued lease: cancel
+            # it so the requeued placement can't double-create the actor.
+            try:
+                await node.conn.call(
+                    "CancelWorkerLease",
+                    {"lease_id": "actor:" + actor.spec["actor_id"]},
+                    timeout=10,
+                )
+            except Exception:
+                pass
+            logger.warning("actor lease on %s failed: %r", node.node_id[:8], e)
             return False
         if not reply.get("granted"):
             return False

@@ -143,10 +143,16 @@ class PlasmaClient:
         reply = await self.conn.call("ObjContains", {"oids": oids})
         return reply["contains"]
 
-    async def pull(self, oid: str, from_addr: Tuple[str, int]) -> memoryview:
-        """Ask the local raylet to fetch a remote object, then map it."""
+    async def pull(
+        self, oid: str, from_addr: Tuple[str, int], purpose: str = "get"
+    ) -> memoryview:
+        """Ask the local raylet to fetch a remote object, then map it.
+        purpose feeds the raylet's prioritized pull admission (reference:
+        pull_manager.h): "get" > "wait" > "task_arg"."""
         meta = await self.conn.call(
-            "PullObject", {"oid": oid, "from_addr": list(from_addr)}, timeout=300
+            "PullObject",
+            {"oid": oid, "from_addr": list(from_addr), "purpose": purpose},
+            timeout=300,
         )
         if meta.get("offset") is not None:
             self.held[oid] = self.held.get(oid, 0) + 1

@@ -1,0 +1,86 @@
+"""Prioritized, bandwidth-capped pull admission (reference:
+src/ray/object_manager/pull_manager.h — pulls are queued by purpose
+priority and admitted up to a bytes-in-flight quota, so a burst of bulk
+task-argument transfers cannot starve an interactive ray.get, and a node
+cannot buffer an unbounded number of concurrent inbound transfers).
+
+Priorities (highest first), matching the reference's bundle priority:
+    get      — a caller is blocked in ray.get right now (the driver/worker
+               payload-resolution path, the default)
+    wait     — ray.wait readiness probes (reserved: wait() currently
+               checks readiness without pulling, so nothing produces this
+               class yet)
+    task_arg — a worker resolving a queued task's arguments
+               (worker_main.load_args)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+import itertools
+from typing import List, Tuple
+
+PRIORITY = {"get": 0, "wait": 1, "task_arg": 2}
+
+
+class PullManager:
+    def __init__(self, max_bytes_in_flight: int):
+        self.max_bytes = int(max_bytes_in_flight)
+        self.bytes_in_flight = 0
+        self.active = 0
+        # Heap of (priority, seq, size, future) — seq keeps FIFO order
+        # within a priority class and makes heap entries comparable.
+        self._waiters: List[Tuple[int, int, int, asyncio.Future]] = []
+        self._seq = itertools.count()
+
+    def _admissible(self, size: int) -> bool:
+        # At least one transfer always runs: an object larger than the
+        # whole quota must not deadlock (reference: the quota is soft for
+        # the head-of-line pull).
+        return self.active == 0 or self.bytes_in_flight + size <= self.max_bytes
+
+    async def acquire(self, size: int, purpose: str = "get") -> None:
+        """Wait for admission of a transfer of `size` bytes."""
+        if not self._waiters and self._admissible(size):
+            self.bytes_in_flight += size
+            self.active += 1
+            return
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        heapq.heappush(
+            self._waiters,
+            (PRIORITY.get(purpose, 1), next(self._seq), size, fut),
+        )
+        # A higher-priority arrival may now BE the admissible head (e.g. a
+        # small get behind a queued oversized task_arg): admit from the head
+        # immediately rather than waiting for an unrelated release().
+        self._drain()
+        await fut
+
+    def _drain(self) -> None:
+        # Admit from the head strictly in priority order (no bypass: a
+        # small low-priority pull must not starve a large high-priority
+        # one indefinitely).
+        while self._waiters:
+            prio, seq, size_w, fut = self._waiters[0]
+            if fut.cancelled():
+                heapq.heappop(self._waiters)
+                continue
+            if not self._admissible(size_w):
+                break
+            heapq.heappop(self._waiters)
+            self.bytes_in_flight += size_w
+            self.active += 1
+            fut.set_result(None)
+
+    def release(self, size: int) -> None:
+        self.bytes_in_flight = max(0, self.bytes_in_flight - size)
+        self.active = max(0, self.active - 1)
+        self._drain()
+
+    def stats(self) -> dict:
+        return {
+            "bytes_in_flight": self.bytes_in_flight,
+            "active_pulls": self.active,
+            "queued_pulls": len(self._waiters),
+        }

@@ -22,6 +22,7 @@ import logging
 import os
 import sys
 import time
+from collections import deque
 from typing import Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private import external_storage, rpc, shm
@@ -42,6 +43,168 @@ def detect_tpu_resources() -> Dict[str, float]:
     from ray_tpu._private.accelerators import detect_accelerator_resources
 
     return detect_accelerator_resources()
+
+
+class ZygoteProc:
+    """Process-like shim for a worker forked by the zygote (the asyncio
+    subprocess API surface the raylet uses: pid/returncode/terminate/kill/
+    wait + stdout/stderr StreamReaders). Exits arrive as zygote messages;
+    wait() also polls the pid so a dead zygote cannot wedge teardown."""
+
+    def __init__(self, pid: int, stdout, stderr):
+        self.pid = pid
+        self.returncode: Optional[int] = None
+        self.stdout = stdout
+        self.stderr = stderr
+        self._exit_fut: asyncio.Future = asyncio.get_running_loop().create_future()
+
+    def _report_exit(self, code: int) -> None:
+        self.returncode = code
+        if not self._exit_fut.done():
+            self._exit_fut.set_result(code)
+
+    def _signal(self, sig) -> None:
+        if self.returncode is not None:
+            raise ProcessLookupError(self.pid)
+        os.kill(self.pid, sig)
+
+    def terminate(self) -> None:
+        import signal as _signal
+
+        self._signal(_signal.SIGTERM)
+
+    def kill(self) -> None:
+        import signal as _signal
+
+        self._signal(_signal.SIGKILL)
+
+    async def wait(self) -> int:
+        while self.returncode is None:
+            try:
+                return await asyncio.wait_for(asyncio.shield(self._exit_fut), 0.5)
+            except asyncio.TimeoutError:
+                try:
+                    os.kill(self.pid, 0)
+                except ProcessLookupError:
+                    # Re-parented to init and reaped there (zygote gone).
+                    self._report_exit(-1)
+        return self.returncode
+
+
+class _Zygote:
+    """Owns the zygote process + its control socket; serializes fork
+    requests (the zygote answers in order)."""
+
+    def __init__(self, raylet: "Raylet"):
+        self.raylet = raylet
+        self.proc = None
+        self.sock = None
+        self.reader_task: Optional[asyncio.Task] = None
+        self._pending: deque = deque()  # futures awaiting {"forked": pid}
+        self._by_pid: Dict[int, ZygoteProc] = {}
+        self._lock = asyncio.Lock()
+        self.broken = False
+
+    async def start(self, base_env: Dict[str, str]) -> None:
+        import socket as _socket
+
+        ours, theirs = _socket.socketpair()
+        self.sock = ours
+        self.proc = await asyncio.create_subprocess_exec(
+            sys.executable,
+            "-m",
+            "ray_tpu._private.worker_zygote",
+            str(theirs.fileno()),
+            env=base_env,
+            pass_fds=[theirs.fileno()],
+        )
+        theirs.close()
+        ours.setblocking(False)
+        # Keep the writer referenced: StreamWriter.__del__ closes the
+        # transport, which would EOF both ends of the control socket.
+        reader, self._writer = await asyncio.open_connection(
+            sock=_socket.socket(fileno=os.dup(ours.fileno()))
+        )
+        self.reader_task = rpc.spawn(self._read_loop(reader))
+
+    async def _read_loop(self, reader) -> None:
+        import json as _json
+
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break
+                msg = _json.loads(line)
+                if "forked" in msg:
+                    if self._pending:
+                        fut = self._pending.popleft()
+                        if not fut.done():
+                            fut.set_result(msg["forked"])
+                elif "exit" in msg:
+                    proc = self._by_pid.pop(msg["exit"], None)
+                    if proc is not None:
+                        proc._report_exit(msg.get("code", -1))
+        except Exception:
+            pass
+        finally:
+            self.broken = True
+            while self._pending:
+                fut = self._pending.popleft()
+                if not fut.done():
+                    fut.set_exception(RuntimeError("zygote died"))
+
+    async def fork_worker(self, env_overrides: Dict[str, str]) -> ZygoteProc:
+        from ray_tpu._private.worker_zygote import send_msg
+
+        out_r, out_w = os.pipe()
+        err_r, err_w = os.pipe()
+        try:
+            async with self._lock:
+                fut: asyncio.Future = asyncio.get_running_loop().create_future()
+                self._pending.append(fut)
+                send_msg(self.sock, {"env": env_overrides}, fds=[out_w, err_w])
+            pid = await asyncio.wait_for(fut, timeout=60)
+        except BaseException:
+            os.close(out_r)
+            os.close(err_r)
+            raise
+        finally:
+            os.close(out_w)
+            os.close(err_w)
+        loop = asyncio.get_running_loop()
+
+        async def fd_reader(fd):
+            reader = asyncio.StreamReader()
+            protocol = asyncio.StreamReaderProtocol(reader)
+            await loop.connect_read_pipe(lambda: protocol, os.fdopen(fd, "rb"))
+            return reader
+
+        proc = ZygoteProc(pid, await fd_reader(out_r), await fd_reader(err_r))
+        self._by_pid[pid] = proc
+        return proc
+
+    async def stop(self) -> None:
+        if self.reader_task is not None:
+            self.reader_task.cancel()
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+        if self.proc is not None:
+            try:
+                self.proc.terminate()
+            except ProcessLookupError:
+                pass
+            try:
+                await asyncio.wait_for(self.proc.wait(), 3)
+            except asyncio.TimeoutError:
+                try:
+                    self.proc.kill()
+                except ProcessLookupError:
+                    pass
+                await self.proc.wait()
 
 
 class WorkerHandle:
@@ -150,6 +313,14 @@ class Raylet:
         # budget (reference: push_manager.h); `push_assembly` tracks inbound
         # pushes being written into unsealed spans.
         self.push_manager = PushManager(self)
+        # Inbound transfer admission (reference: pull_manager.h prioritized,
+        # bandwidth-capped pulls).
+        from ray_tpu._private.pull_manager import PullManager
+
+        self.pull_manager = PullManager(config.pull_max_bytes_in_flight)
+        # Preloaded fork server for fast worker spawn (reference:
+        # worker_pool.cc prestart); started lazily on first spawn.
+        self._zygote: Optional[_Zygote] = None
         self.push_assembly: Dict[str, Dict[str, int]] = {}
         # Per-worker stdout/stderr files (reference: session_latest/logs).
         import tempfile
@@ -302,6 +473,12 @@ class Raylet:
                 await asyncio.gather(
                     *(p.wait() for p in procs), return_exceptions=True
                 )
+        if self._zygote is not None:
+            try:
+                await self._zygote.stop()
+            except Exception:
+                pass
+            self._zygote = None
         # Quiesce spill IO before the arena unmaps: pool threads and
         # suspended spill/restore frames hold memoryview slices into it;
         # mmap.close() with exported views raises BufferError.
@@ -477,20 +654,34 @@ class Raylet:
                 "RAY_TPU_SESSION": self.session_name,
             }
         )
-        argv = [sys.executable, "-m", "ray_tpu._private.worker_main"]
+        proc = None
         if container:
             # Containerized worker (reference: runtime_env/container.py):
             # the podman/docker argv wraps the same worker module; host
             # networking + /dev/shm keep RPC and plasma working.
             from ray_tpu.runtime_env.container import build_container_argv
 
-            argv = build_container_argv(container, argv, env)
-        proc = await asyncio.create_subprocess_exec(
-            *argv,
-            env=env,
-            stdout=asyncio.subprocess.PIPE,
-            stderr=asyncio.subprocess.PIPE,
-        )
+            argv = build_container_argv(
+                container, [sys.executable, "-m", "ray_tpu._private.worker_main"], env
+            )
+        elif config.worker_zygote_enabled:
+            # Fork from the preloaded zygote (~10ms) instead of a cold exec
+            # (~0.5-1.5s); fall back to exec if the zygote is broken.
+            try:
+                proc = await self._zygote_fork(env)
+            except Exception as e:
+                logger.warning("zygote fork failed (%r); exec fallback", e)
+                proc = None
+            argv = [sys.executable, "-m", "ray_tpu._private.worker_main"]
+        else:
+            argv = [sys.executable, "-m", "ray_tpu._private.worker_main"]
+        if proc is None:
+            proc = await asyncio.create_subprocess_exec(
+                *argv,
+                env=env,
+                stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.PIPE,
+            )
         handle = WorkerHandle(worker_id, proc)
         self.workers[worker_id] = handle
         # Log pipeline (reference: log_monitor.py tailing session/logs/*):
@@ -500,6 +691,21 @@ class Raylet:
         rpc.spawn(self._pump_worker_logs(handle, proc.stderr, "stderr"))
         rpc.spawn(self._reap_worker(handle))
         return handle
+
+    async def _zygote_fork(self, env: Dict[str, str]) -> ZygoteProc:
+        """Fork one worker from the (lazily started) zygote. env is the
+        full worker environment; the base snapshot rides the zygote's own
+        spawn, the per-worker delta rides the fork request."""
+        z = self._zygote
+        if z is None or z.broken:
+            z = self._zygote = _Zygote(self)
+            await z.start(env)
+        overrides = {
+            k: v
+            for k, v in env.items()
+            if k.startswith("RAY_TPU_") or k not in os.environ
+        }
+        return await z.fork_worker(overrides)
 
     def _log_path(self, worker_id: str, stream: str) -> str:
         return os.path.join(
@@ -1687,6 +1893,17 @@ class Raylet:
             self._add_hold(conn, oid)
             return self._obj_meta(oid, info)
         remote = await rpc.connect(*p["from_addr"], retry=3)
+        # Admission (reference: pull_manager.h): learn the size, then wait
+        # for quota at this request's priority before moving any bytes.
+        probe = await remote.call(
+            "ObjGet", {"oids": [oid], "block": True, "timeout": 30}
+        )
+        probe_meta = probe["found"].get(oid)
+        if probe_meta is None:
+            await remote.close()
+            raise rpc.RpcError(f"object {oid[:12]} not on remote node")
+        pull_size = int(probe_meta.get("size", 0))
+        await self.pull_manager.acquire(pull_size, p.get("purpose", "get"))
         try:
             try:
                 await remote.call(
@@ -1741,6 +1958,7 @@ class Raylet:
             self._add_hold(conn, oid)
             return create
         finally:
+            self.pull_manager.release(pull_size)
             await remote.close()
 
     async def _fetch_chunk(self, conn, p):

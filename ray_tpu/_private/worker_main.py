@@ -416,7 +416,9 @@ class Executor:
                 tuple(wire["owner_addr"]) if wire.get("owner_addr") else None,
                 self.core,
             )
-            payload = await self.core._resolve_payload(ref, None)
+            # Task-argument fetches are below interactive gets in the pull
+            # admission order (reference: pull_manager.h bundle priority).
+            payload = await self.core._resolve_payload(ref, None, purpose="task_arg")
         else:
             payload = wire["args_blob"]
         with serialization.DeserializationContext(

@@ -230,3 +230,57 @@ def test_streaming_generator_failure_propagates(ray_start_regular):
     with pytest.raises(Exception) as ei:
         ray_tpu.get(next(it), timeout=30)
     assert "boom-mid-stream" in str(ei.value)
+
+
+def test_pull_manager_priority_and_quota():
+    """Prioritized bandwidth-capped pull admission (reference:
+    object_manager/pull_manager.h): quota bounds bytes in flight, a
+    head-of-line oversized pull is never deadlocked, and gets outrank
+    task-arg prefetches regardless of arrival order."""
+    import asyncio
+
+    from ray_tpu._private.pull_manager import PullManager
+
+    async def scenario():
+        pm = PullManager(100)
+        order = []
+
+        await pm.acquire(60, "get")       # admitted: 60 in flight
+        await pm.acquire(30, "task_arg")  # admitted: 90 in flight
+
+        async def queued(size, purpose, tag):
+            await pm.acquire(size, purpose)
+            order.append(tag)
+
+        # Over quota now: these queue. task_arg arrives FIRST but the get
+        # and wait must be admitted before it.
+        t1 = asyncio.ensure_future(queued(50, "task_arg", "arg"))
+        await asyncio.sleep(0.01)
+        t2 = asyncio.ensure_future(queued(50, "get", "get"))
+        t3 = asyncio.ensure_future(queued(50, "wait", "wait"))
+        await asyncio.sleep(0.01)
+        assert order == []
+        assert pm.stats()["queued_pulls"] == 3
+
+        pm.release(60)  # 30 in flight; head (get, 50) fits -> 80
+        await asyncio.sleep(0.01)
+        assert order == ["get"]
+        pm.release(30)  # 50 in flight; wait (50) fits -> 100; arg must wait
+        await asyncio.sleep(0.01)
+        assert order == ["get", "wait"]
+        pm.release(50)
+        pm.release(50)
+        await asyncio.sleep(0.01)
+        assert order == ["get", "wait", "arg"]
+        await asyncio.gather(t1, t2, t3)
+        pm.release(50)  # the admitted task_arg pull finishes too
+
+        # Oversized head-of-line pull: admitted alone rather than deadlocked.
+        await pm.acquire(1000, "get")
+        assert pm.stats()["bytes_in_flight"] == 1000
+        pm.release(1000)
+        assert pm.stats() == {
+            "bytes_in_flight": 0, "active_pulls": 0, "queued_pulls": 0,
+        }
+
+    asyncio.run(scenario())

@@ -31,6 +31,8 @@ from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.env.multi_agent_env import MultiAgentEnv
 from ray_tpu.rllib.env.multi_agent_env_runner import MultiAgentEnvRunner
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig
+from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
+from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.core.learner import Learner
 from ray_tpu.rllib.core.learner_group import LearnerGroup
@@ -47,6 +49,10 @@ __all__ = [
     "APPOConfig",
     "BC",
     "BCConfig",
+    "CQL",
+    "CQLConfig",
+    "MARWIL",
+    "MARWILConfig",
     "SAC",
     "SACConfig",
     "DQN",

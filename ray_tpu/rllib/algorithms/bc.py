@@ -19,6 +19,37 @@ from ray_tpu.rllib.core.learner import Learner
 from ray_tpu.rllib.core.rl_module import RLModuleSpec, forward_pi_vf, init_pi_vf
 
 
+def materialize_offline(input_) -> List[dict]:
+    """Rows from a ray_tpu.data Dataset or any iterable of dicts (shared by
+    every offline algorithm: BC, MARWIL, CQL)."""
+    rows = input_.take_all() if hasattr(input_, "take_all") else list(input_)
+    if not rows:
+        raise ValueError("offline dataset is empty")
+    return rows
+
+
+def validate_discrete_actions(acts: np.ndarray, num_actions: int, algo: str) -> np.ndarray:
+    """int64 action indices within [0, num_actions); loud errors for
+    continuous or out-of-range logged actions (silent truncation would
+    train on garbage indices)."""
+    if not np.issubdtype(acts.dtype, np.integer):
+        if not np.allclose(acts, np.round(acts)):
+            raise ValueError(
+                f"{algo} requires discrete integer actions; got continuous "
+                f"values (dtype {acts.dtype}) — this environment/dataset "
+                "combination needs a continuous learner"
+            )
+        acts = np.round(acts)
+    acts = acts.astype(np.int64)
+    if acts.min() < 0 or acts.max() >= num_actions:
+        raise ValueError(
+            f"offline actions outside [0, {num_actions}): "
+            f"min={acts.min()}, max={acts.max()} — dataset logged from a "
+            "different action space?"
+        )
+    return acts
+
+
 class BCConfig(AlgorithmConfig):
     def __init__(self):
         super().__init__(algo_class=BC)
@@ -54,35 +85,16 @@ class BC(Algorithm):
                 "BC requires offline data: config.offline_data(input_=dataset)"
             )
         super().__init__(config)
-        self._rows = self._materialize(config.offline_input)
-        if not self._rows:
-            raise ValueError("offline dataset is empty")
+        self._rows = materialize_offline(config.offline_input)
         self._obs = np.asarray(
             [r["obs"] for r in self._rows], dtype=np.float32
         ).reshape(len(self._rows), -1)
-        acts = np.asarray([r["actions"] for r in self._rows])
-        if not np.issubdtype(acts.dtype, np.integer):
-            if not np.allclose(acts, np.round(acts)):
-                raise ValueError(
-                    "BC requires discrete integer actions; got continuous "
-                    f"values (dtype {acts.dtype}) — this environment/dataset "
-                    "combination needs a continuous imitation learner"
-                )
-            acts = np.round(acts)
-        self._acts = acts.astype(np.int64)
-        if self._acts.min() < 0 or self._acts.max() >= self.num_actions:
-            raise ValueError(
-                f"offline actions outside [0, {self.num_actions}): "
-                f"min={self._acts.min()}, max={self._acts.max()} — dataset "
-                "logged from a different action space?"
-            )
+        self._acts = validate_discrete_actions(
+            np.asarray([r["actions"] for r in self._rows]),
+            self.num_actions,
+            "BC",
+        )
         self._rng = np.random.RandomState(config.seed)
-
-    @staticmethod
-    def _materialize(input_) -> List[dict]:
-        if hasattr(input_, "take_all"):  # ray_tpu.data Dataset
-            return input_.take_all()
-        return list(input_)
 
     def _learner_builder(self, obs_dim: int, num_actions: int) -> Callable[[], Any]:
         cfg = self.config

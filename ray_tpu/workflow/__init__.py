@@ -13,11 +13,14 @@ only the unfinished suffix.
 """
 
 from ray_tpu.workflow.api import (
+    Continuation,
     FunctionNode,
     WorkflowStatus,
+    continuation,
     delete,
     get_metadata,
     get_output,
+    get_step_metadata,
     list_all,
     resume,
     run,
@@ -25,11 +28,14 @@ from ray_tpu.workflow.api import (
 )
 
 __all__ = [
+    "Continuation",
     "FunctionNode",
     "WorkflowStatus",
+    "continuation",
     "delete",
     "get_metadata",
     "get_output",
+    "get_step_metadata",
     "list_all",
     "resume",
     "run",

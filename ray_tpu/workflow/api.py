@@ -36,6 +36,24 @@ class FunctionNode:
         self.remote_fn = remote_fn
         self.args = args
         self.kwargs = kwargs
+        # Step-level execution options (reference: workflow.options()):
+        # retries re-run the step on application exceptions;
+        # catch_exceptions makes the step's value a (result, exception)
+        # pair instead of propagating.
+        self.max_retries = 0
+        self.catch_exceptions = False
+
+    def options(
+        self,
+        *,
+        max_retries: Optional[int] = None,
+        catch_exceptions: Optional[bool] = None,
+    ) -> "FunctionNode":
+        if max_retries is not None:
+            self.max_retries = int(max_retries)
+        if catch_exceptions is not None:
+            self.catch_exceptions = bool(catch_exceptions)
+        return self
 
     def _upstream(self) -> List["FunctionNode"]:
         return [
@@ -43,6 +61,25 @@ class FunctionNode:
             for a in list(self.args) + list(self.kwargs.values())
             if isinstance(a, FunctionNode)
         ]
+
+
+class Continuation:
+    """A step's result that says "my real value is this sub-workflow"
+    (reference: workflow continuations — task_executor.py re-enters the
+    executor with the returned DAG). Return `workflow.continuation(
+    next_step.bind(...))` from inside a step; the executor runs the
+    sub-DAG (checkpointed under the parent step's namespace) and uses its
+    output as the step's value. Recursion-friendly: each nesting level
+    gets its own namespaced steps, so resume lands mid-recursion."""
+
+    def __init__(self, node: FunctionNode):
+        if not isinstance(node, FunctionNode):
+            raise RayTpuError("continuation() expects fn.bind(...)")
+        self.node = node
+
+
+def continuation(node: FunctionNode) -> Continuation:
+    return Continuation(node)
 
 
 class _Storage:
@@ -73,6 +110,36 @@ class _Storage:
         with open(tmp, "w") as f:
             json.dump(meta, f)
         os.replace(tmp, os.path.join(self.dir, "meta.json"))
+
+    # Per-step metadata (reference: workflow_storage.py step metadata
+    # records): attempts, timing, status — queryable per step.
+
+    def _step_meta_path(self, step_id: str) -> str:
+        return os.path.join(self.dir, "steps", f"{step_id}.meta.json")
+
+    def write_step_meta(self, step_id: str, **kw) -> None:
+        meta = self.read_step_meta(step_id)
+        meta.update(kw)
+        tmp = self._step_meta_path(step_id) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, self._step_meta_path(step_id))
+
+    def read_step_meta(self, step_id: str) -> Dict[str, Any]:
+        try:
+            with open(self._step_meta_path(step_id)) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return {}
+
+    def list_step_meta(self) -> Dict[str, Dict[str, Any]]:
+        out = {}
+        steps_dir = os.path.join(self.dir, "steps")
+        for fname in sorted(os.listdir(steps_dir)):
+            if fname.endswith(".meta.json"):
+                sid = fname[: -len(".meta.json")]
+                out[sid] = self.read_step_meta(sid)
+        return out
 
     def read_meta(self) -> Dict[str, Any]:
         try:
@@ -113,8 +180,63 @@ def _step_ids(node: FunctionNode) -> Dict[int, str]:
     return ids
 
 
-def _execute(node: FunctionNode, storage: _Storage) -> Any:
-    ids = _step_ids(node)
+def _run_step(n: FunctionNode, step_id: str, args, kwargs, storage: _Storage) -> Any:
+    """One step with retries / catch_exceptions / continuation handling
+    (reference: task_executor.py — application retries + the continuation
+    re-entry into the executor)."""
+    storage.write_step_meta(
+        step_id,
+        name=getattr(n.remote_fn, "__name__", "step"),
+        status="RUNNING",
+        start_time=time.time(),
+    )
+    attempts = 0
+    caught: Optional[Exception] = None
+    result: Any = None
+    while True:
+        attempts += 1
+        try:
+            result = ray_tpu.get(n.remote_fn.remote(*args, **kwargs))
+            if isinstance(result, Continuation):
+                # The step's real value is a sub-workflow, executed under
+                # this step's namespace so resume lands mid-recursion.
+                # Running it INSIDE the attempt means continuation failures
+                # honor max_retries/catch_exceptions like any other failure
+                # (checkpointed sub-steps are skipped on retry).
+                result = _execute(result.node, storage, prefix=f"{step_id}.")
+            break
+        except Exception as e:
+            if attempts <= n.max_retries:
+                storage.write_step_meta(
+                    step_id, attempts=attempts, last_error=repr(e)
+                )
+                continue
+            if n.catch_exceptions:
+                caught = e
+                break
+            storage.write_step_meta(
+                step_id, status="FAILED", attempts=attempts,
+                last_error=repr(e), end_time=time.time(),
+            )
+            raise
+    if n.catch_exceptions:
+        result = (None, caught) if caught is not None else (result, None)
+    storage.save_step(step_id, result)
+    storage.write_step_meta(
+        step_id, status="SUCCESSFUL", attempts=attempts, end_time=time.time()
+    )
+    return result
+
+
+def _execute(node: FunctionNode, storage: _Storage, prefix: str = "") -> Any:
+    """Dependency-resolved parallel executor: a step is submitted the
+    moment its own upstreams finish — no wave barrier, so a slow branch
+    never delays ready work on an independent branch (reference:
+    workflow_executor.py submits tasks as dependencies resolve). Each
+    finished step is checkpointed before its value feeds downstream. On a
+    step failure, in-flight siblings are drained (never orphaned into the
+    storage directory) before the error propagates."""
+    ids = {k: prefix + v for k, v in _step_ids(node).items()}
     cache: Dict[int, Any] = {}
     order: List[FunctionNode] = []
     seen = set()
@@ -129,20 +251,57 @@ def _execute(node: FunctionNode, storage: _Storage) -> Any:
 
     visit(node)
 
+    import concurrent.futures as cf
+
+    remaining: Dict[int, FunctionNode] = {}
     for n in order:
         step_id = ids[id(n)]
         if storage.has_step(step_id):
             cache[id(n)] = storage.load_step(step_id)
-            continue
+        else:
+            remaining[id(n)] = n
 
-        def resolve(v):
-            return cache[id(v)] if isinstance(v, FunctionNode) else v
+    def resolve(v):
+        return cache[id(v)] if isinstance(v, FunctionNode) else v
 
-        args = [resolve(a) for a in n.args]
-        kwargs = {k: resolve(v) for k, v in n.kwargs.items()}
-        result = ray_tpu.get(n.remote_fn.remote(*args, **kwargs))
-        storage.save_step(step_id, result)
-        cache[id(n)] = result
+    pool = cf.ThreadPoolExecutor(max_workers=8)
+    futs: Dict[Any, int] = {}  # Future -> node id
+    try:
+        def submit_ready():
+            for nid, n in list(remaining.items()):
+                if all(id(up) in cache for up in n._upstream()):
+                    del remaining[nid]
+                    args = [resolve(a) for a in n.args]
+                    kwargs = {k: resolve(v) for k, v in n.kwargs.items()}
+                    futs[
+                        pool.submit(_run_step, n, ids[nid], args, kwargs, storage)
+                    ] = nid
+
+        submit_ready()
+        first_error: Optional[BaseException] = None
+        while futs:
+            done, _pending = cf.wait(futs, return_when=cf.FIRST_COMPLETED)
+            for f in done:
+                nid = futs.pop(f)
+                try:
+                    cache[nid] = f.result()
+                except BaseException as e:  # noqa: BLE001
+                    if first_error is None:
+                        first_error = e
+            if first_error is not None:
+                # Drain in-flight siblings so no thread keeps executing
+                # remote tasks or writing checkpoints after run() raised.
+                for f in cf.as_completed(list(futs)):
+                    try:
+                        f.result()
+                    except BaseException:  # noqa: BLE001 - already failing
+                        pass
+                raise first_error
+            submit_ready()
+        if remaining:
+            raise RayTpuError("workflow graph has a dependency cycle")
+    finally:
+        pool.shutdown(wait=True)
     return cache[id(node)]
 
 
@@ -213,6 +372,14 @@ def get_output(workflow_id: str, *, storage: Optional[str] = None) -> Any:
 
 def get_metadata(workflow_id: str, *, storage: Optional[str] = None) -> Dict:
     return _Storage(workflow_id, storage).read_meta()
+
+
+def get_step_metadata(
+    workflow_id: str, *, storage: Optional[str] = None
+) -> Dict[str, Dict]:
+    """Per-step records: {step_id: {name, status, attempts, start/end_time,
+    last_error?}} (reference: workflow_storage.py step metadata)."""
+    return _Storage(workflow_id, storage).list_step_meta()
 
 
 def list_all(storage: Optional[str] = None) -> List[Tuple[str, str]]:

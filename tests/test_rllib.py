@@ -296,3 +296,121 @@ def test_algorithm_checkpoint_restore(tmp_path):
     result = algo2.train()
     assert result["training_iteration"] == it + 1
     algo2.stop()
+
+
+def _logged_cartpole(n=2000, noise=0.3, seed=0):
+    """Offline rows from a decent-but-noisy scripted CartPole policy
+    (mixed-quality data, the offline-RL setting): full transitions with
+    per-episode reward/done structure."""
+    import gymnasium as gym
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    env = gym.make("CartPole-v1")
+    rows = []
+    obs, _ = env.reset(seed=seed)
+    for _ in range(n):
+        expert = int(obs[2] + 0.3 * obs[3] > 0)
+        action = expert if rng.rand() > noise else rng.randint(2)
+        nxt, rew, term, trunc, _ = env.step(action)
+        rows.append(
+            {
+                "obs": obs.astype(np.float32).tolist(),
+                "actions": action,
+                "rewards": float(rew),
+                "next_obs": nxt.astype(np.float32).tolist(),
+                "dones": bool(term or trunc),
+            }
+        )
+        obs = nxt
+        if term or trunc:
+            obs, _ = env.reset()
+    env.close()
+    return rows
+
+
+def test_marwil_beats_bc_weighting(shutdown_only):
+    """MARWIL's exp(beta*advantage) weighting learns from MIXED-quality
+    logs: the learned policy's action accuracy against the expert rule
+    exceeds the noisy behavior policy's own consistency (reference:
+    rllib/algorithms/marwil learning tests)."""
+    import numpy as np
+
+    import ray_tpu
+    import ray_tpu.data as rd
+    from ray_tpu.rllib import MARWILConfig
+
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    rows = _logged_cartpole(n=3000, noise=0.35, seed=3)
+
+    algo = (
+        MARWILConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=2)
+        .offline_data(input_=rd.from_items(rows))
+        .training(train_batch_size=256, updates_per_iteration=24, lr=2e-3)
+        .debugging(seed=7)
+        .build_algo()
+    )
+    for _ in range(30):
+        result = algo.train()
+    assert "policy_loss" in result and "vf_loss" in result
+    assert result["mean_weight"] > 0.0
+    # Greedy accuracy vs the expert rule on held-out states.
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.core.rl_module import forward_pi_vf
+
+    learner = algo.learner_group._local
+    test_obs = np.asarray([r["obs"] for r in rows[:500]], dtype=np.float32)
+    expert = np.asarray(
+        [int(o[2] + 0.3 * o[3] > 0) for o in test_obs], dtype=np.int64
+    )
+    logits, _ = forward_pi_vf(learner.params, jnp.asarray(test_obs))
+    acc = float(np.mean(np.argmax(np.asarray(logits), axis=-1) == expert))
+    # The behavior policy agrees with the expert only ~65% of the time;
+    # advantage weighting must push past it.
+    assert acc > 0.75, f"MARWIL greedy accuracy {acc:.2f}"
+    algo.stop()
+
+
+def test_cql_conservative_penalty(shutdown_only):
+    """CQL learns from the fixed buffer and its conservative gap shrinks;
+    the penalty keeps logged-action values above the soft-max OOD value
+    (reference: rllib/algorithms/cql learning tests)."""
+    import ray_tpu
+    import ray_tpu.data as rd
+    from ray_tpu.rllib import CQLConfig
+
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    rows = _logged_cartpole(n=2000, noise=0.2, seed=11)
+
+    def train_with(alpha):
+        algo = (
+            CQLConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=0, num_envs_per_env_runner=2)
+            .offline_data(input_=rd.from_items(rows))
+            .training(
+                train_batch_size=64, updates_per_iteration=32, lr=1e-3,
+                cql_alpha=alpha,
+            )
+            .debugging(seed=13)
+            .build_algo()
+        )
+        result = {}
+        for _ in range(15):
+            result = algo.train()
+        algo.stop()
+        return result
+
+    conservative = train_with(1.0)
+    plain = train_with(0.0)
+    assert "td_loss" in conservative and "total_loss" in conservative
+    # The penalty's defining property: logged-action Q values sit closer to
+    # the soft-max over actions than an unpenalized learner's — OOD actions
+    # are pushed DOWN relative to in-distribution ones.
+    assert conservative["cql_gap"] < plain["cql_gap"], (
+        f"penalty had no conservative effect: alpha=1 gap "
+        f"{conservative['cql_gap']:.3f} vs alpha=0 gap {plain['cql_gap']:.3f}"
+    )

@@ -64,6 +64,17 @@ worker):
    so the location of any e2e-vs-step gap is a measurement in
    BENCH_r{N}.json, not a docstring claim.
 
+   Measured composition on this CI host (1 CPU core, chip behind the axon
+   tunnel; 2026-07, round 5): ingest-only 1429 img/s, iter-only 2.28M
+   img/s, raw step 2476 img/s, e2e 439 img/s. With one core there is no
+   parallelism to overlap INTO: decode tasks, the split coordinator, the
+   train worker's batch assembly, and the tunnel h2d all time-share the
+   same core, so e2e ~= 1 / (1/ingest + 1/worker-side) rather than
+   min(ingest, step). The worker-side term (~630 img/s) is dominated by
+   the ~95 MB/s uint8 h2d through the tunnel. On a real TPU VM (dozens of
+   cores, PCIe-attached chips) the same code overlaps: ingest and the
+   step pipeline run on different cores and h2d is not tunneled.
+
 Baseline: the reference's headline Train-ResNet e2e number, 40.7 images/s
 (BASELINE.md). vs_baseline compares the matching e2e phase.
 """

@@ -108,23 +108,28 @@ class _Zygote:
     async def start(self, base_env: Dict[str, str]) -> None:
         import socket as _socket
 
-        ours, theirs = _socket.socketpair()
-        self.sock = ours
+        # Two channels (see worker_zygote.py): requests stay a plain
+        # BLOCKING socket owned by us (asyncio must never flip its file
+        # description to O_NONBLOCK — a nonblocking sendmsg under a fork
+        # burst EAGAINs mid-message and corrupts the protocol); responses
+        # are wrapped in an asyncio reader.
+        req_ours, req_theirs = _socket.socketpair()
+        resp_ours, resp_theirs = _socket.socketpair()
+        self.sock = req_ours
         self.proc = await asyncio.create_subprocess_exec(
             sys.executable,
             "-m",
             "ray_tpu._private.worker_zygote",
-            str(theirs.fileno()),
+            str(req_theirs.fileno()),
+            str(resp_theirs.fileno()),
             env=base_env,
-            pass_fds=[theirs.fileno()],
+            pass_fds=[req_theirs.fileno(), resp_theirs.fileno()],
         )
-        theirs.close()
-        ours.setblocking(False)
+        req_theirs.close()
+        resp_theirs.close()
         # Keep the writer referenced: StreamWriter.__del__ closes the
-        # transport, which would EOF both ends of the control socket.
-        reader, self._writer = await asyncio.open_connection(
-            sock=_socket.socket(fileno=os.dup(ours.fileno()))
-        )
+        # transport, which would EOF the response channel.
+        reader, self._writer = await asyncio.open_connection(sock=resp_ours)
         self.reader_task = rpc.spawn(self._read_loop(reader))
 
     async def _read_loop(self, reader) -> None:
@@ -163,7 +168,22 @@ class _Zygote:
             async with self._lock:
                 fut: asyncio.Future = asyncio.get_running_loop().create_future()
                 self._pending.append(fut)
-                send_msg(self.sock, {"env": env_overrides}, fds=[out_w, err_w])
+                try:
+                    send_msg(
+                        self.sock, {"env": env_overrides}, fds=[out_w, err_w]
+                    )
+                except BaseException:
+                    # A failed/partial send corrupts the request framing and
+                    # desynchronizes response matching: poison this zygote
+                    # (callers fall back to exec spawn; a fresh zygote is
+                    # started lazily) and drop the orphan future so later
+                    # responses cannot misroute.
+                    self.broken = True
+                    try:
+                        self._pending.remove(fut)
+                    except ValueError:
+                        pass
+                    raise
             pid = await asyncio.wait_for(fut, timeout=60)
         except BaseException:
             os.close(out_r)
@@ -208,12 +228,13 @@ class _Zygote:
 
 
 class WorkerHandle:
-    def __init__(self, worker_id: str, proc):
+    def __init__(self, worker_id: str, proc=None):
         self.worker_id = worker_id
         self.proc = proc
         self.conn: Optional[rpc.Connection] = None
         self.addr: Optional[Tuple[str, int]] = None
         self.fp_port: Optional[int] = None  # native fastpath channel port
+        self.kill_requested = False  # kill arrived while fork in flight
         self.registered = asyncio.get_running_loop().create_future()
         self.lease_id: Optional[str] = None
         self.actor_id: Optional[str] = None
@@ -451,7 +472,7 @@ class Raylet:
             await self.gcs.close()  # before anything else: no re-registration
         for t in self._tasks:
             t.cancel()
-        procs = [w.proc for w in list(self.workers.values())]
+        procs = [w.proc for w in list(self.workers.values()) if w.proc is not None]
         for w in list(self.workers.values()):
             self._kill_worker_proc(w)
         # Reap children through the event loop so their subprocess
@@ -667,11 +688,17 @@ class Raylet:
         elif config.worker_zygote_enabled:
             # Fork from the preloaded zygote (~10ms) instead of a cold exec
             # (~0.5-1.5s); fall back to exec if the zygote is broken.
+            # The handle must be in self.workers BEFORE the fork: a forked
+            # worker can connect and register faster than this coroutine
+            # resumes, and _register_worker rejects unknown ids.
+            handle = WorkerHandle(worker_id, None)
+            self.workers[worker_id] = handle
             try:
                 proc = await self._zygote_fork(env)
             except Exception as e:
                 logger.warning("zygote fork failed (%r); exec fallback", e)
                 proc = None
+                del self.workers[worker_id]
             argv = [sys.executable, "-m", "ray_tpu._private.worker_main"]
         else:
             argv = [sys.executable, "-m", "ray_tpu._private.worker_main"]
@@ -682,8 +709,11 @@ class Raylet:
                 stdout=asyncio.subprocess.PIPE,
                 stderr=asyncio.subprocess.PIPE,
             )
-        handle = WorkerHandle(worker_id, proc)
+        handle = self.workers.get(worker_id) or WorkerHandle(worker_id, None)
+        handle.proc = proc
         self.workers[worker_id] = handle
+        if handle.kill_requested:
+            self._kill_worker_proc(handle)
         # Log pipeline (reference: log_monitor.py tailing session/logs/*):
         # worker output goes to per-worker session log files AND streams to
         # the driver via GCS pubsub.
@@ -851,6 +881,11 @@ class Raylet:
             self._kill_worker_proc(handle)
 
     def _kill_worker_proc(self, handle: WorkerHandle) -> None:
+        if handle.proc is None:
+            # Fork still in flight: remember the kill; _start_worker
+            # delivers it the moment the pid is known.
+            handle.kill_requested = True
+            return
         try:
             handle.proc.terminate()
         except ProcessLookupError:
@@ -1199,7 +1234,19 @@ class Raylet:
                 handle = await self._start_worker(container=container)
                 await handle.registered
             else:
-                handle = await self._get_or_start_idle_worker()
+                # A worker dying between spawn and registration is a
+                # transient of process storms, not a property of the lease:
+                # retry with a fresh worker before failing the request.
+                attempt = 0
+                while True:
+                    try:
+                        handle = await self._get_or_start_idle_worker()
+                        break
+                    except rpc.RpcError:
+                        attempt += 1
+                        if attempt >= 3:
+                            raise
+                        await asyncio.sleep(0.1 * attempt)
         except rpc.RpcError as e:
             self.available = self.available + req.demand
             self._mark_dirty()
@@ -1296,7 +1343,10 @@ class Raylet:
         handle = self.workers.get(p["worker_id"])
         if p.get("probe"):
             # Liveness probe only (GCS post-restart actor reconciliation).
-            alive = handle is not None and handle.proc.returncode is None
+            alive = handle is not None and (
+                handle.proc is None  # fork in flight but registered
+                or handle.proc.returncode is None
+            )
             return {"ok": True, "alive": alive}
         if handle is None:
             return {"ok": False}

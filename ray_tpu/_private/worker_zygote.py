@@ -8,10 +8,14 @@ the worker stack; each worker is an os.fork() of it (~10ms, copy-on-write
 imports). At 1000 actors on a small host this is the difference between
 minutes of spawn wall and seconds.
 
-Protocol (over a unix-domain socketpair, one JSON line per message):
-    raylet -> zygote: {"env": {...}} + [stdout_fd, stderr_fd] via SCM_RIGHTS
-    zygote -> raylet: {"forked": pid}
-    zygote -> raylet: {"exit": pid, "code": n}   (zygote reaps its children)
+Protocol (two unix-domain socketpairs, one JSON line per message; separate
+request and response channels so the raylet's asyncio reader — which sets
+O_NONBLOCK on its file description — can never flip the raylet's blocking
+request sends into EAGAIN mid-message):
+    requests  (raylet -> zygote): {"env": {...}} + [stdout_fd, stderr_fd]
+                                  via SCM_RIGHTS
+    responses (zygote -> raylet): {"forked": pid}
+                                  {"exit": pid, "code": n}  (zygote reaps)
 
 The zygote is fork-safe by construction: a single-threaded, loop-free
 process that only blocks in recvmsg. Forked children dup2 the passed fds
@@ -95,7 +99,8 @@ def main() -> None:
     # inherits these imports copy-on-write.
     from ray_tpu._private import worker_main  # noqa: F401  (heavy import)
 
-    sock = socket.socket(fileno=int(sys.argv[1]))
+    sock = socket.socket(fileno=int(sys.argv[1]))  # requests (recv only)
+    resp = socket.socket(fileno=int(sys.argv[2]))  # responses (send only)
     # 1s poll between messages: child exits are reaped and reported within
     # a second even when no fork requests arrive.
     sock.settimeout(1.0)
@@ -106,13 +111,14 @@ def main() -> None:
         except OSError:
             break
         if req is _TIMEOUT:
-            _reap(sock)
+            _reap(resp)
             continue
         if req is None:
             break
-        _reap(sock)
+        _reap(resp)
         pid = os.fork()
         if pid == 0:
+            code = 0
             try:
                 if len(fds) >= 2:
                     os.dup2(fds[0], 1)
@@ -121,6 +127,7 @@ def main() -> None:
                     if fd > 2:
                         os.close(fd)
                 sock.close()
+                resp.close()
                 for k, v in (req.get("env") or {}).items():
                     if v is None:
                         os.environ.pop(k, None)
@@ -130,12 +137,17 @@ def main() -> None:
                 from ray_tpu._private import worker_main as wm
 
                 wm.main()
+            except BaseException:  # noqa: BLE001 - the child must not
+                import traceback   # return into the zygote's serve loop
+
+                traceback.print_exc()
+                code = 1
             finally:
-                os._exit(0)
+                os._exit(code)
         for fd in fds:
             os.close(fd)
         try:
-            send_msg(sock, {"forked": pid})
+            send_msg(resp, {"forked": pid})
         except OSError:
             break
     # Parent exiting: children are re-parented to init; the raylet kills

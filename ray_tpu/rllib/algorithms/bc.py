@@ -17,37 +17,7 @@ from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.core.learner import Learner
 from ray_tpu.rllib.core.rl_module import RLModuleSpec, forward_pi_vf, init_pi_vf
-
-
-def materialize_offline(input_) -> List[dict]:
-    """Rows from a ray_tpu.data Dataset or any iterable of dicts (shared by
-    every offline algorithm: BC, MARWIL, CQL)."""
-    rows = input_.take_all() if hasattr(input_, "take_all") else list(input_)
-    if not rows:
-        raise ValueError("offline dataset is empty")
-    return rows
-
-
-def validate_discrete_actions(acts: np.ndarray, num_actions: int, algo: str) -> np.ndarray:
-    """int64 action indices within [0, num_actions); loud errors for
-    continuous or out-of-range logged actions (silent truncation would
-    train on garbage indices)."""
-    if not np.issubdtype(acts.dtype, np.integer):
-        if not np.allclose(acts, np.round(acts)):
-            raise ValueError(
-                f"{algo} requires discrete integer actions; got continuous "
-                f"values (dtype {acts.dtype}) — this environment/dataset "
-                "combination needs a continuous learner"
-            )
-        acts = np.round(acts)
-    acts = acts.astype(np.int64)
-    if acts.min() < 0 or acts.max() >= num_actions:
-        raise ValueError(
-            f"offline actions outside [0, {num_actions}): "
-            f"min={acts.min()}, max={acts.max()} — dataset logged from a "
-            "different action space?"
-        )
-    return acts
+from ray_tpu.rllib.utils.offline import materialize_offline, validate_discrete_actions
 
 
 class BCConfig(AlgorithmConfig):

@@ -17,7 +17,7 @@ import numpy as np
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
-from ray_tpu.rllib.algorithms.bc import materialize_offline, validate_discrete_actions
+from ray_tpu.rllib.utils.offline import materialize_offline, validate_discrete_actions
 from ray_tpu.rllib.algorithms.dqn import DQNLearner
 
 

@@ -18,7 +18,7 @@ import numpy as np
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
-from ray_tpu.rllib.algorithms.bc import materialize_offline, validate_discrete_actions
+from ray_tpu.rllib.utils.offline import materialize_offline, validate_discrete_actions
 from ray_tpu.rllib.core.learner import Learner
 from ray_tpu.rllib.core.rl_module import RLModuleSpec, forward_pi_vf, init_pi_vf
 

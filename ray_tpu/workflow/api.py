@@ -32,16 +32,18 @@ class FunctionNode:
     """Lazy task node: fn.bind(*args) (reference: dag/function_node.py).
     Args may contain other FunctionNodes."""
 
+    # Step-level execution options (reference: workflow.options()):
+    # retries re-run the step on application exceptions; catch_exceptions
+    # makes the step's value a (result, exception) pair instead of
+    # propagating. CLASS-level defaults so graph.pkl files persisted
+    # before these options existed still unpickle and resume.
+    max_retries = 0
+    catch_exceptions = False
+
     def __init__(self, remote_fn, args: Tuple, kwargs: Dict):
         self.remote_fn = remote_fn
         self.args = args
         self.kwargs = kwargs
-        # Step-level execution options (reference: workflow.options()):
-        # retries re-run the step on application exceptions;
-        # catch_exceptions makes the step's value a (result, exception)
-        # pair instead of propagating.
-        self.max_retries = 0
-        self.catch_exceptions = False
 
     def options(
         self,
@@ -190,40 +192,77 @@ def _run_step(n: FunctionNode, step_id: str, args, kwargs, storage: _Storage) ->
         status="RUNNING",
         start_time=time.time(),
     )
+    root = n
+    root_step_id = step_id
     attempts = 0
+    chain_depth = 0
     caught: Optional[Exception] = None
     result: Any = None
     while True:
         attempts += 1
         try:
             result = ray_tpu.get(n.remote_fn.remote(*args, **kwargs))
-            if isinstance(result, Continuation):
-                # The step's real value is a sub-workflow, executed under
-                # this step's namespace so resume lands mid-recursion.
-                # Running it INSIDE the attempt means continuation failures
-                # honor max_retries/catch_exceptions like any other failure
-                # (checkpointed sub-steps are skipped on retry).
-                result = _execute(result.node, storage, prefix=f"{step_id}.")
+            # Continuations (the step's real value is another workflow).
+            # A chain of single-step continuations — the recursion pattern
+            # (e.g. fact(n) -> fact(n-1)) — iterates IN THIS FRAME: each
+            # link gets its own metadata record under the root step's
+            # namespace, and no threads/pools/stack accumulate with depth.
+            # A continuation that is a full DAG re-enters the executor.
+            # Failures at any link honor the ROOT step's
+            # max_retries/catch_exceptions (checkpointed sub-steps skip on
+            # retry).
+            while isinstance(result, Continuation):
+                sub = result.node
+                if sub._upstream():
+                    result = _execute(
+                        sub, storage, prefix=f"{root_step_id}."
+                    )
+                else:
+                    chain_depth += 1
+                    n = sub
+                    args = list(sub.args)
+                    kwargs = dict(sub.kwargs)
+                    step_id = (
+                        f"{root_step_id}."
+                        f"{chain_depth:04d}_"
+                        f"{getattr(sub.remote_fn, '__name__', 'step')}"
+                    )
+                    storage.write_step_meta(
+                        step_id,
+                        name=getattr(sub.remote_fn, "__name__", "step"),
+                        status="RUNNING",
+                        start_time=time.time(),
+                    )
+                    result = ray_tpu.get(n.remote_fn.remote(*args, **kwargs))
+                    storage.write_step_meta(
+                        step_id, status="SUCCESSFUL", end_time=time.time()
+                    )
             break
         except Exception as e:
-            if attempts <= n.max_retries:
+            if attempts <= root.max_retries:
                 storage.write_step_meta(
-                    step_id, attempts=attempts, last_error=repr(e)
+                    root_step_id, attempts=attempts, last_error=repr(e)
                 )
                 continue
-            if n.catch_exceptions:
+            if root.catch_exceptions:
                 caught = e
                 break
             storage.write_step_meta(
-                step_id, status="FAILED", attempts=attempts,
+                root_step_id, status="FAILED", attempts=attempts,
                 last_error=repr(e), end_time=time.time(),
             )
             raise
-    if n.catch_exceptions:
+    if root.catch_exceptions:
         result = (None, caught) if caught is not None else (result, None)
-    storage.save_step(step_id, result)
+    storage.save_step(root_step_id, result)
     storage.write_step_meta(
-        step_id, status="SUCCESSFUL", attempts=attempts, end_time=time.time()
+        root_step_id,
+        # A caught permanent failure must be distinguishable from a clean
+        # success in the step records.
+        status="CAUGHT_FAILURE" if caught is not None else "SUCCESSFUL",
+        attempts=attempts,
+        end_time=time.time(),
+        **({"last_error": repr(caught)} if caught is not None else {}),
     )
     return result
 

@@ -1,13 +1,27 @@
 """Build script for native extensions.
 
 Usage: python setup.py build_ext --inplace
-Builds ray_tpu/_native/_shm*.so (POSIX shm buffer extension). The framework
-falls back to multiprocessing.shared_memory when the extension is absent, so
-pure-Python installs still work; the native path avoids the resource-tracker
-overhead and gives page-aligned zero-copy buffers.
+Builds ray_tpu/_native/{_shm,_store,_fastpath}*.so. The framework falls
+back to pure Python where an extension is absent (shm via
+multiprocessing.shared_memory, task dispatch via the RPC path), so
+pure-Python installs still work.
+
+Sanitizer builds (reference: the C++ tree's TSAN/ASAN CI configs): set
+RAY_TPU_SANITIZE=address|thread|undefined to compile the extensions with
+the matching -fsanitize instrumentation, then run the native tests under
+it, e.g.
+
+    RAY_TPU_SANITIZE=address python setup.py build_ext --inplace
+    LD_PRELOAD=$(gcc -print-file-name=libasan.so) \\
+        python -m pytest tests/test_store_core.py tests/test_fastpath_native.py
 """
 
+import os
+
 from setuptools import Extension, setup
+
+_SAN = os.environ.get("RAY_TPU_SANITIZE")
+_san_flags = [f"-fsanitize={_SAN}", "-fno-omit-frame-pointer", "-g"] if _SAN else []
 
 setup(
     name="ray-tpu",
@@ -15,18 +29,21 @@ setup(
         Extension(
             "ray_tpu._native._shm",
             sources=["src/shm_buffer.cc"],
-            extra_compile_args=["-O2", "-std=c++17"],
+            extra_compile_args=["-O2", "-std=c++17"] + _san_flags,
+            extra_link_args=list(_san_flags),
             libraries=["rt"],
         ),
         Extension(
             "ray_tpu._native._store",
             sources=["src/store_core.cc"],
-            extra_compile_args=["-O2", "-std=c++17"],
+            extra_compile_args=["-O2", "-std=c++17"] + _san_flags,
+            extra_link_args=list(_san_flags),
         ),
         Extension(
             "ray_tpu._native._fastpath",
             sources=["src/fastpath.cc"],
-            extra_compile_args=["-O2", "-std=c++17"],
+            extra_compile_args=["-O2", "-std=c++17"] + _san_flags,
+            extra_link_args=list(_san_flags),
         ),
     ],
 )

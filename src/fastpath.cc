@@ -35,6 +35,7 @@
 #include <arpa/inet.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <fcntl.h>
 #include <poll.h>
 #include <sys/socket.h>
 #include <unistd.h>
@@ -177,6 +178,10 @@ class Driver {
     (void)!pipe(p);
     notify_rd_ = p[0];
     notify_wr_ = p[1];
+    // Drain() is callable at any time (not just after a readable event):
+    // an empty pipe must return 0, not block the caller.
+    int flags = fcntl(notify_rd_, F_GETFL, 0);
+    fcntl(notify_rd_, F_SETFL, flags | O_NONBLOCK);
   }
 
   int Connect(const char* host, int port) {

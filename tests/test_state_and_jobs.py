@@ -171,6 +171,20 @@ def test_dashboard_endpoints(ray_start_regular):
             urllib.request.urlopen(f"{base}/api/cluster_status").read()
         )
         assert "nodes" in status
+        events = json.loads(
+            urllib.request.urlopen(f"{base}/api/events?limit=50").read()
+        )
+        assert any(e["label"] == "NODE_ADDED" for e in events["events"])
+        sev = json.loads(
+            urllib.request.urlopen(
+                f"{base}/api/events?severity=ERROR"
+            ).read()
+        )
+        assert all(e["severity"] == "ERROR" for e in sev["events"])
+        pgs = json.loads(
+            urllib.request.urlopen(f"{base}/api/placement_groups").read()
+        )
+        assert "pgs" in pgs
     finally:
         worker_mod.global_worker.run_async(dash.stop())
 

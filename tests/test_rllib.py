@@ -91,7 +91,10 @@ def test_dqn_cartpole_improves_local():
         .build_algo()
     )
     best = 0.0
-    for _ in range(350):
+    # Early-exit on success keeps the pass-path fast; the generous budget
+    # absorbs the run-to-run variance of epsilon-greedy exploration (the
+    # environment's episode stream is not fully determined by the seeds).
+    for _ in range(600):
         result = algo.train()
         ret = result.get("episode_return_mean")
         if ret == ret:

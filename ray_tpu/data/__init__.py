@@ -95,6 +95,40 @@ def read_numpy(paths, *, column: str = "data", parallelism: int = 8) -> Dataset:
     )
 
 
+def read_images(
+    paths,
+    *,
+    size=None,
+    mode=None,
+    include_paths: bool = False,
+    parallelism: int = 8,
+) -> Dataset:
+    """PIL-decoded images as an "image" tensor column (reference:
+    ray.data.read_images). size=(H, W) resizes (required for a stacked
+    fixed-shape column over mixed-size files); mode forces a PIL convert
+    ("RGB", "L", ...)."""
+    return Dataset(
+        [
+            Read(
+                read_tasks=_src.image_read_tasks(
+                    paths, size, mode, include_paths, parallelism
+                )
+            )
+        ],
+        parallelism,
+    )
+
+
+def read_webdataset(paths, *, parallelism: int = 8) -> Dataset:
+    """WebDataset tar shards -> one row per sample keyed by "__key__", with
+    a column per field; .txt/.cls/.json fields are decoded (reference:
+    ray.data.read_webdataset)."""
+    return Dataset(
+        [Read(read_tasks=_src.webdataset_read_tasks(paths, parallelism))],
+        parallelism,
+    )
+
+
 __all__ = [
     "Dataset",
     "GroupedData",
@@ -111,4 +145,6 @@ __all__ = [
     "read_text",
     "read_binary_files",
     "read_numpy",
+    "read_images",
+    "read_webdataset",
 ]

@@ -53,7 +53,14 @@ def _to_arrow_array(values: List[Any]):
             # (zero-copy through serialization and back to numpy), not
             # per-row Arrow lists.
             return ArrowTensorArray.from_numpy(np.stack(values))
-        return pa.array([np.asarray(v).tolist() for v in values])
+        try:
+            return pa.array([np.asarray(v).tolist() for v in values])
+        except (pa.ArrowInvalid, pa.ArrowTypeError, pa.ArrowNotImplementedError):
+            # Mixed nesting depth (e.g. (H, W) grayscale next to (H, W, 3)
+            # RGB) cannot become one Arrow list column; pickle per row.
+            import cloudpickle
+
+            return pa.array([cloudpickle.dumps(v) for v in values])
     try:
         return pa.array(values)
     except (pa.ArrowInvalid, pa.ArrowTypeError, pa.ArrowNotImplementedError):

@@ -204,3 +204,120 @@ def write_block_json(table: pa.Table, path: str, idx: int) -> str:
         for row in B.block_to_rows(table):
             f.write(json.dumps(row) + "\n")
     return out
+
+
+def image_read_tasks(
+    paths,
+    size: Optional[tuple] = None,
+    mode: Optional[str] = None,
+    include_paths: bool = False,
+    parallelism: int = 8,
+) -> List[Callable[[], pa.Table]]:
+    """PIL-decoded images, one tensor-column block per file group
+    (reference: ray.data.read_images / datasource/image_datasource.py).
+    size=(H, W) resizes — required for a stacked fixed-shape tensor column
+    when the files vary; mode forces a PIL conversion ("RGB", "L", ...)."""
+    files = [
+        f
+        for f in _expand_paths(paths)
+        if f.lower().endswith((".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp"))
+    ]
+    if not files:
+        raise FileNotFoundError(f"no image files matched {paths}")
+    parallelism = max(1, min(parallelism, len(files)))
+    tasks = []
+    for i in range(parallelism):
+        chunk = files[i::parallelism]
+
+        def task(chunk=tuple(chunk), size=size, mode=mode, include=include_paths):
+            from PIL import Image
+
+            from ray_tpu.data.tensor_extension import ArrowTensorArray
+
+            arrs, names = [], []
+            for path in chunk:
+                with Image.open(path) as im:
+                    if mode:
+                        im = im.convert(mode)
+                    if size:
+                        im = im.resize((size[1], size[0]))  # PIL is (W, H)
+                    arrs.append(np.asarray(im))
+                names.append(path)
+            shapes = {a.shape for a in arrs}
+            if len(shapes) != 1:
+                # A deterministic dataset-wide representation is impossible
+                # with heterogeneous shapes (blocks would disagree on the
+                # column type depending on file striping): fail loudly with
+                # the fix, like the reference's image datasource.
+                raise ValueError(
+                    f"images have differing shapes {sorted(shapes)}; pass "
+                    "size=(H, W) (and mode=) to read_images to decode into "
+                    "a uniform tensor column"
+                )
+            col = ArrowTensorArray.from_numpy(np.stack(arrs))
+            cols = {"image": col}
+            if include:
+                cols["path"] = pa.array(names)
+            return pa.table(cols)
+
+        tasks.append(task)
+    return tasks
+
+
+def webdataset_read_tasks(
+    paths, parallelism: int = 8
+) -> List[Callable[[], pa.Table]]:
+    """WebDataset-style tar shards (reference: ray.data.read_webdataset):
+    files inside each tar are grouped into samples by basename — everything
+    up to the first dot is the sample key, the rest is the field name. Each
+    row gets "__key__" plus one bytes column per field; .txt/.cls/.json
+    fields are decoded to str/int/object like the webdataset defaults."""
+    files = _expand_paths(paths)
+    tars = [f for f in files if f.endswith((".tar", ".tar.gz", ".tgz"))]
+    if not tars:
+        raise FileNotFoundError(f"no tar shards matched {paths}")
+    parallelism = max(1, min(parallelism, len(tars)))
+    tasks = []
+    for i in range(parallelism):
+        chunk = tars[i::parallelism]
+
+        def task(chunk=tuple(chunk)):
+            import json as _json
+            import tarfile
+
+            rows: List[dict] = []
+            for tar_path in chunk:
+                # Samples group PER SHARD, keyed by the tar-internal path
+                # stem (directory included): equal keys in different shards
+                # or directories are different samples, never merged
+                # (reference read_webdataset semantics).
+                samples: dict = {}
+                order: List[str] = []
+                with tarfile.open(tar_path) as tf:
+                    for member in tf:
+                        if not member.isfile():
+                            continue
+                        base = os.path.basename(member.name)
+                        if base.startswith("."):
+                            continue  # AppleDouble/.DS_Store and kin
+                        stem, _, field = base.partition(".")
+                        if not field:
+                            continue
+                        key = os.path.join(os.path.dirname(member.name), stem)
+                        data = tf.extractfile(member).read()
+                        if key not in samples:
+                            samples[key] = {"__key__": key}
+                            order.append(key)
+                        if field in ("txt", "text"):
+                            samples[key][field] = data.decode("utf-8")
+                        elif field == "cls":
+                            samples[key][field] = int(data.decode().strip())
+                        elif field == "json":
+                            samples[key][field] = _json.loads(data)
+                        else:
+                            samples[key][field] = data
+                rows.extend(samples[k] for k in order)
+            return B.rows_to_block(rows)
+
+        tasks.append(task)
+    return tasks

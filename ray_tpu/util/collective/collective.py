@@ -17,6 +17,18 @@ backends instead of NCCL/Gloo:
 
 Like NCCL, all ranks must issue collectives in the same order; a per-group
 sequence number enforces matching.
+
+PERFORMANCE NOTE (read this before putting col.allreduce in a loop): on
+TPU, collectives only ride ICI when they execute INSIDE one compiled SPMD
+program. These module-level functions are host-mediated per call — each
+builds a global array and runs a freshly dispatched jitted reduce — which
+is exactly right for rendezvous, bootstrap, and occasional small tensors
+(it is how JaxTrainer seeds its mesh), and ~1000x too slow for per-step
+gradient traffic. The gradient path is: get the group's mesh
+(`get_group_mesh`) and write the training step as one jit/shard_map
+program whose `jax.lax.psum/all_gather/psum_scatter/ppermute` ops XLA
+schedules over ICI; see ray_tpu.parallel.mesh and models/transformer.py's
+make_train_step for the pattern.
 """
 
 from __future__ import annotations

@@ -1,0 +1,42 @@
+"""Offline RL from logged transitions: MARWIL's advantage-weighted
+imitation (or swap in CQLConfig / BCConfig — same offline_data input).
+
+Run:  python examples/offline_rl.py
+"""
+
+import gymnasium as gym
+import numpy as np
+
+import ray_tpu
+import ray_tpu.data as rd
+from ray_tpu.rllib import MARWILConfig
+
+if __name__ == "__main__":
+    ray_tpu.init()
+    env = gym.make("CartPole-v1")
+    rows, (obs, _) = [], env.reset(seed=0)
+    for _ in range(2000):
+        action = int(obs[2] + 0.3 * obs[3] > 0)  # scripted demonstrator
+        nxt, rew, term, trunc, _ = env.step(action)
+        rows.append({
+            "obs": obs.astype(np.float32).tolist(), "actions": action,
+            "rewards": float(rew),
+            "next_obs": nxt.astype(np.float32).tolist(),
+            "dones": bool(term or trunc),
+        })
+        obs = nxt if not (term or trunc) else env.reset()[0]
+
+    algo = (
+        MARWILConfig()
+        .environment("CartPole-v1")
+        .offline_data(input_=rd.from_items(rows))
+        .training(train_batch_size=256, updates_per_iteration=16)
+        .build_algo()
+    )
+    for i in range(20):
+        metrics = algo.train()
+        print(i, {k: round(v, 3) for k, v in metrics.items()
+                  if isinstance(v, float)})
+    print("eval:", algo.evaluate(num_steps=500))
+    algo.stop()
+    ray_tpu.shutdown()

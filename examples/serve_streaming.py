@@ -1,0 +1,27 @@
+"""Serve a streaming deployment: SSE over HTTP via the Accept header, plus
+the typed gRPC PredictStreaming rpc from the same replica.
+
+Run:  python examples/serve_streaming.py
+Then: curl -N -H 'Accept: text/event-stream' -d 'ignored' \
+        http://127.0.0.1:8000/tokens
+"""
+
+import time
+
+import ray_tpu
+from ray_tpu import serve
+
+if __name__ == "__main__":
+    ray_tpu.init()
+    serve.start(http_options={"host": "127.0.0.1", "port": 8000})
+
+    @serve.deployment(num_replicas=2)
+    class Tokens:
+        def __call__(self, request):
+            for tok in ["hello", "from", "ray_tpu", "serve"]:
+                yield tok
+
+    serve.run(Tokens.bind(), name="tokens", route_prefix="/tokens")
+    print("serving on http://127.0.0.1:8000/tokens (ctrl-c to exit)")
+    while True:
+        time.sleep(5)

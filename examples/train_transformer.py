@@ -1,0 +1,53 @@
+"""Distributed GPT-style training: JaxTrainer gangs one worker per TPU
+host, rendezvous over the xla collective backend, and runs ONE
+jit/shard_map program over the pod mesh (DP/FSDP/TP/SP are mesh axes).
+
+Run:  python examples/train_transformer.py
+"""
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.air import RunConfig, ScalingConfig
+from ray_tpu.train.jax import JaxTrainer
+
+
+def train_fn(config):
+    from ray_tpu.models import TransformerConfig, make_train_step
+    from ray_tpu.parallel import make_mesh
+
+    cfg = TransformerConfig(
+        vocab_size=1024, d_model=256, n_layers=4, n_heads=8,
+        max_seq_len=256, dtype=jnp.bfloat16, remat=True,
+    )
+    mesh = make_mesh({"data": jax.device_count()})
+    init_state, step, shardings = make_train_step(cfg, mesh, optax.adamw(3e-4))
+    state = init_state(jax.random.PRNGKey(0))
+
+    rng = jax.random.PRNGKey(1)
+    for i in range(config.get("steps", 20)):
+        rng, k = jax.random.split(rng)
+        raw = jax.random.randint(k, (8, 257), 0, cfg.vocab_size)
+        batch = {
+            "tokens": jax.device_put(raw[:, :-1], shardings["tokens"]),
+            "targets": jax.device_put(raw[:, 1:], shardings["tokens"]),
+        }
+        state, metrics = step(state, batch)
+        if i % 5 == 0:
+            train.report({"step": i, "loss": float(metrics["loss"])})
+    train.report({"final_loss": float(metrics["loss"])})
+
+
+if __name__ == "__main__":
+    ray_tpu.init()
+    result = JaxTrainer(
+        train_fn,
+        train_loop_config={"steps": 20},
+        scaling_config=ScalingConfig(num_workers=1),  # one per TPU host
+        run_config=RunConfig(name="gpt_demo", storage_path="/tmp/rt_demo"),
+    ).fit()
+    print("metrics:", result.metrics)
+    ray_tpu.shutdown()

@@ -1,0 +1,39 @@
+# Developer entry points. CI (.github/workflows/ci.yml) runs the same
+# commands; keep the two in sync.
+
+PYTHON ?= python
+SANITIZER ?= address
+
+.PHONY: lint test sanitize wire-docs build
+
+lint:
+	$(PYTHON) -m ray_tpu.devtools.lint
+
+test:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+		-p no:randomly
+
+build:
+	$(PYTHON) setup.py build_ext --inplace
+
+# Rebuild the C++ extensions with -fsanitize=$(SANITIZER) and run the
+# native-path tests under the instrumented .so files. ASan needs its
+# runtime loaded before python, hence the LD_PRELOAD (gcc resolves the
+# right libasan for the toolchain); UBSan links its runtime statically.
+sanitize:
+	RAY_TPU_SANITIZE=$(SANITIZER) $(PYTHON) setup.py build_ext --inplace
+	@if [ "$(SANITIZER)" = "address" ]; then \
+		env LD_PRELOAD=$$(gcc -print-file-name=libasan.so) \
+			ASAN_OPTIONS=detect_leaks=0 JAX_PLATFORMS=cpu \
+			$(PYTHON) -m pytest tests/test_store_core.py \
+			tests/test_fastpath_native.py -q -p no:cacheprovider; \
+	else \
+		env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
+			tests/test_store_core.py tests/test_fastpath_native.py \
+			-q -p no:cacheprovider; \
+	fi
+	$(PYTHON) setup.py build_ext --inplace  # restore uninstrumented .so
+
+wire-docs:
+	$(PYTHON) -m ray_tpu.devtools.rpc_check --markdown > docs/wire_protocol.md

@@ -1,0 +1,234 @@
+"""Runtime asyncio interleaving probe (``RAY_TPU_AIOCHECK=1``).
+
+A lightweight dynamic race detector for the single-loop control plane: the
+GCS and raylet wrap their shared-state dicts in :class:`TrackedDict`, which
+attributes every read/write to the ``asyncio.Task`` performing it.
+:func:`conflicts` then reports the two hazard shapes the static
+``await-interleave`` lint rule targets, observed for real:
+
+- **read-await-write** (lost update): task A reads key K, task B writes K
+  while A is suspended at an await, then A writes K back — A's write is
+  based on a stale view.
+- **write-write**: two different tasks write the same key with no
+  intervening read by the later writer — last-writer-wins with neither
+  side seeing the other.
+
+Everything is loop-local and sequential (asyncio interleaves only at
+awaits), so plain event recording with a global sequence number is exact —
+no clocks or locks needed. Overhead when disabled is zero: ``track()``
+returns the original dict unless ``RAY_TPU_AIOCHECK=1`` was set at process
+start. Tests use this probe to validate the static pass: a seeded
+interleaving bug must show up here (see tests/test_devtools_lint.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import os
+from dataclasses import dataclass
+from typing import Any, Dict, List, MutableMapping, Optional, Tuple
+
+
+def enabled() -> bool:
+    return os.environ.get("RAY_TPU_AIOCHECK") == "1"
+
+
+_seq = itertools.count()
+# (seq, task_label, op, dict_name, key); op is "r" or "w".
+_events: List[Tuple[int, str, str, str, Any]] = []
+
+
+def _task_label() -> str:
+    try:
+        task = asyncio.current_task()
+    except RuntimeError:
+        task = None
+    if task is None:
+        return "<no-task>"
+    return task.get_name()
+
+
+def _record(op: str, name: str, key: Any) -> None:
+    try:
+        hash(key)
+    except TypeError:
+        return
+    _events.append((next(_seq), _task_label(), op, name, key))
+
+
+class TrackedDict(dict):
+    """dict proxy recording per-key reads/writes attributed to the current
+    asyncio task. Whole-dict operations (iteration, len, values) are not
+    treated as key reads — the hazard shapes are per-key."""
+
+    def __init__(self, name: str, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._aiocheck_name = name
+
+    # -- reads --------------------------------------------------------------
+
+    def __getitem__(self, key):
+        _record("r", self._aiocheck_name, key)
+        return super().__getitem__(key)
+
+    def get(self, key, default=None):
+        _record("r", self._aiocheck_name, key)
+        return super().get(key, default)
+
+    def __contains__(self, key):
+        _record("r", self._aiocheck_name, key)
+        return super().__contains__(key)
+
+    # -- writes -------------------------------------------------------------
+
+    def __setitem__(self, key, value):
+        _record("w", self._aiocheck_name, key)
+        super().__setitem__(key, value)
+
+    def __delitem__(self, key):
+        _record("w", self._aiocheck_name, key)
+        super().__delitem__(key)
+
+    def pop(self, key, *default):
+        _record("w", self._aiocheck_name, key)
+        return super().pop(key, *default)
+
+    def setdefault(self, key, default=None):
+        # Read-or-write in one atomic step; record as both.
+        _record("r", self._aiocheck_name, key)
+        if key not in dict.keys(self):
+            _record("w", self._aiocheck_name, key)
+        return super().setdefault(key, default)
+
+    def update(self, *args, **kwargs):
+        other = dict(*args, **kwargs)
+        for key in other:
+            _record("w", self._aiocheck_name, key)
+        super().update(other)
+
+    def clear(self):
+        for key in list(dict.keys(self)):
+            _record("w", self._aiocheck_name, key)
+        super().clear()
+
+
+def track(name: str, mapping: Optional[MutableMapping] = None) -> MutableMapping:
+    """Wrap ``mapping`` in a TrackedDict when the probe is enabled; return
+    it unchanged (or a fresh plain dict) otherwise."""
+    if mapping is None:
+        mapping = {}
+    if not enabled():
+        return mapping
+    return TrackedDict(name, mapping)
+
+
+@dataclass
+class Conflict:
+    kind: str  # "read-await-write" | "write-write"
+    dict_name: str
+    key: Any
+    task: str  # the task whose write is hazardous
+    other_task: str  # the task it raced with
+    read_seq: Optional[int]
+    write_seq: int
+    other_seq: int
+
+    def __str__(self) -> str:
+        if self.kind == "read-await-write":
+            return (
+                f"read-await-write on {self.dict_name}[{self.key!r}]: "
+                f"{self.task} read at #{self.read_seq}, {self.other_task} "
+                f"wrote at #{self.other_seq}, {self.task} wrote back at "
+                f"#{self.write_seq} (stale view)"
+            )
+        return (
+            f"write-write on {self.dict_name}[{self.key!r}]: {self.other_task} "
+            f"wrote at #{self.other_seq}, then {self.task} overwrote at "
+            f"#{self.write_seq} without reading it"
+        )
+
+
+def reset() -> None:
+    _events.clear()
+
+
+def events() -> List[Tuple[int, str, str, str, Any]]:
+    return list(_events)
+
+
+def conflicts() -> List[Conflict]:
+    """Analyze the recorded trace for cross-task hazards."""
+    out: List[Conflict] = []
+    # Per (dict, key): ordered history of (seq, task, op).
+    history: Dict[Tuple[str, Any], List[Tuple[int, str, str]]] = {}
+    for seq, task, op, name, key in _events:
+        history.setdefault((name, key), []).append((seq, task, op))
+    for (name, key), ops in history.items():
+        for i, (seq, task, op) in enumerate(ops):
+            if op != "w" or task == "<no-task>":
+                continue
+            # Last op by this task before this write.
+            last_read = None
+            last_own_write = None
+            for pseq, ptask, pop in reversed(ops[:i]):
+                if ptask == task:
+                    if pop == "r" and last_read is None:
+                        last_read = pseq
+                    if pop == "w":
+                        last_own_write = pseq
+                    break_after = last_read is not None or last_own_write is not None
+                    if break_after:
+                        break
+            if last_read is not None:
+                # Foreign write between our read and our write?
+                for pseq, ptask, pop in ops[:i]:
+                    if (
+                        pop == "w"
+                        and ptask not in (task, "<no-task>")
+                        and last_read < pseq < seq
+                    ):
+                        out.append(
+                            Conflict(
+                                "read-await-write",
+                                name,
+                                key,
+                                task,
+                                ptask,
+                                last_read,
+                                seq,
+                                pseq,
+                            )
+                        )
+                        break
+            else:
+                # No prior read by this task: write-write if the immediately
+                # preceding write came from another task.
+                for pseq, ptask, pop in reversed(ops[:i]):
+                    if pop != "w":
+                        continue
+                    if ptask not in (task, "<no-task>"):
+                        out.append(
+                            Conflict(
+                                "write-write",
+                                name,
+                                key,
+                                task,
+                                ptask,
+                                None,
+                                seq,
+                                pseq,
+                            )
+                        )
+                    break
+    out.sort(key=lambda c: c.write_seq)
+    return out
+
+
+def report() -> str:
+    cs = conflicts()
+    if not cs:
+        return "aiocheck: no cross-task conflicts observed"
+    lines = [f"aiocheck: {len(cs)} cross-task conflict(s) observed"]
+    lines.extend(f"  {c}" for c in cs)
+    return "\n".join(lines)

@@ -24,7 +24,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 import msgpack
 
-from ray_tpu._private import rpc
+from ray_tpu._private import aiocheck, rpc
 from ray_tpu._private.pubsub import Publisher
 from ray_tpu._private.common import PlacementGroupSpec, ResourceSet, config
 
@@ -123,14 +123,23 @@ class GcsServer:
 
         self.server = rpc.Server(host, port)
         self.session_name = session_name
-        self.nodes: Dict[str, NodeInfo] = {}
-        self.actors: Dict[str, ActorInfo] = {}
-        self.named_actors: Dict[Tuple[str, str], str] = {}  # (ns, name) -> actor_id
-        self.kv: Dict[Tuple[str, str], bytes] = {}
+        # Shared single-loop state: every handler below may touch these
+        # across awaits. aiocheck.track is a no-op unless RAY_TPU_AIOCHECK=1,
+        # in which case mutations are attributed to their asyncio task so
+        # cross-task interleaving hazards surface at runtime.
+        self.nodes: Dict[str, NodeInfo] = aiocheck.track("gcs.nodes")
+        self.actors: Dict[str, ActorInfo] = aiocheck.track("gcs.actors")
+        # (ns, name) -> actor_id
+        self.named_actors: Dict[Tuple[str, str], str] = aiocheck.track(
+            "gcs.named_actors"
+        )
+        self.kv: Dict[Tuple[str, str], bytes] = aiocheck.track("gcs.kv")
         # Bounded per-subscriber pubsub (reference: pubsub/publisher.h).
         self.publisher = Publisher()
-        self.jobs: Dict[str, dict] = {}
-        self.placement_groups: Dict[str, PlacementGroupInfo] = {}
+        self.jobs: Dict[str, dict] = aiocheck.track("gcs.jobs")
+        self.placement_groups: Dict[str, PlacementGroupInfo] = aiocheck.track(
+            "gcs.placement_groups"
+        )
         self.task_events: List[dict] = []  # ring buffer of task state events
         # Monotonic cluster-view version; every membership/resource change
         # bumps it and broadcasts a delta (reference: ray_syncer.h:88

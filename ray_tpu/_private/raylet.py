@@ -25,7 +25,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Set, Tuple
 
-from ray_tpu._private import external_storage, rpc, shm
+from ray_tpu._private import aiocheck, external_storage, rpc, shm
 from ray_tpu._private.push_manager import PushManager
 from ray_tpu._private.common import ResourceSet, config
 from ray_tpu._private.gcs import GcsClient
@@ -358,14 +358,16 @@ class Raylet:
         # freed/evicted, whatever their age.
         self.obj_holds: Dict[str, Dict[int, int]] = {}
 
-        # Workers.
-        self.workers: Dict[str, WorkerHandle] = {}
+        # Workers. Shared single-loop state mutated from many handlers;
+        # aiocheck.track attributes mutations to asyncio tasks under
+        # RAY_TPU_AIOCHECK=1 (no-op otherwise).
+        self.workers: Dict[str, WorkerHandle] = aiocheck.track("raylet.workers")
         self.idle_workers: List[WorkerHandle] = []
         self.pending_leases: List[LeaseRequest] = []
         # Cluster-wide-infeasible leases parked off the FIFO grant queue
         # until the cluster scales (autoscaler demand input).
         self.infeasible_leases: List[LeaseRequest] = []
-        self.leases: Dict[str, WorkerHandle] = {}
+        self.leases: Dict[str, WorkerHandle] = aiocheck.track("raylet.leases")
 
         # Placement group bundles committed on this node:
         # pg_id -> {"base": ResourceSet deducted, "group": ResourceSet added}
@@ -474,6 +476,13 @@ class Raylet:
             t.cancel()
         procs = [w.proc for w in list(self.workers.values()) if w.proc is not None]
         for w in list(self.workers.values()):
+            # Graceful first: the worker's Exit handler flushes and exits 0;
+            # SIGTERM right behind it is the backstop for a wedged loop.
+            if w.conn is not None and not w.conn.closed:
+                try:
+                    w.conn.push_nowait("Exit", {})
+                except rpc.ConnectionLost:
+                    pass
             self._kill_worker_proc(w)
         # Reap children through the event loop so their subprocess
         # transports close while the loop is alive — otherwise transport
@@ -565,7 +574,6 @@ class Raylet:
         s.register("ObjRelease", self._obj_release)
         s.register("ObjDelete", self._obj_delete)
         s.register("ObjContains", self._obj_contains)
-        s.register("ObjPin", self._obj_pin)
         s.register("PullObject", self._pull_object)
         s.register("FetchChunk", self._fetch_chunk)
         s.register("PushObject", self._push_object)
@@ -603,12 +611,20 @@ class Raylet:
             raise rpc.RpcError("GetLog needs a valid filename or worker_id")
         path = os.path.join(self.log_dir, filename)
         tail = int(p.get("tail") or 1000)
-        try:
+
+        def _read_tail() -> bytes:
             with open(path, "rb") as f:
                 f.seek(0, os.SEEK_END)
                 size = f.tell()
                 f.seek(max(0, size - max(tail, 1) * 200))
-                data = f.read()
+                return f.read()
+
+        try:
+            # Log files can be large and live on slow disks; don't stall the
+            # scheduler loop on the read.
+            data = await asyncio.get_running_loop().run_in_executor(
+                None, _read_tail
+            )
         except OSError:
             return {"lines": [], "found": False}
         lines = data.decode("utf-8", "replace").splitlines()
@@ -783,7 +799,10 @@ class Raylet:
 
         carry = b""
         try:
-            with open(path, "ab", buffering=0) as f:
+            # Unbuffered append of already-read chunks to a local log file:
+            # O(chunk) writes, and per-chunk executor hops would reorder the
+            # pump. Accepted sync I/O.
+            with open(path, "ab", buffering=0) as f:  # aio-lint: disable=blocking-call
                 while True:
                     # Chunked read (not readline): immune to asyncio's 64 KiB
                     # line limit — a worker print()ing a huge repr must never
@@ -1350,7 +1369,16 @@ class Raylet:
             return {"ok": True, "alive": alive}
         if handle is None:
             return {"ok": False}
-        self._kill_worker_proc(handle)
+        if p.get("force") and handle.proc is not None:
+            # ray.kill(): SIGKILL, no atexit handlers (wire.py: KillWorker).
+            # The wire checker surfaced that producers set force=True but the
+            # handler always soft-terminated.
+            try:
+                handle.proc.kill()
+            except ProcessLookupError:
+                pass
+        else:
+            self._kill_worker_proc(handle)
         return {"ok": True}
 
     # -- object store --------------------------------------------------------
@@ -1838,11 +1866,6 @@ class Raylet:
             if self.store.lookup(oid) is not None:
                 self.store.touch(oid)
                 self.obj_last_access[oid] = time.monotonic()
-        return {"ok": True}
-
-    async def _obj_pin(self, conn, p):
-        for oid in p["oids"]:
-            self.store.pin(oid)
         return {"ok": True}
 
     async def _obj_delete(self, conn, p):

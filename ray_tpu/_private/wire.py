@@ -1,0 +1,88 @@
+"""Wire-protocol schema registry for the msgpack RPC layer.
+
+Frames on the wire are ``[msgid, kind, method, payload]`` (rpc.py) and the
+payloads are plain msgpack dicts. This registry is the single versioned
+description of the payload shape for the high-traffic message types: each
+entry declares the keys a producer must send (``required``) and the keys a
+consumer may additionally read (``optional``). It has no runtime cost — the
+RPC layer never imports it; ``ray_tpu.devtools.rpc_check`` cross-checks
+every literal payload dict at call sites and every ``p["k"]``/``p.get("k")``
+in handler bodies against it at lint time, so a renamed field fails CI
+instead of silently returning ``None`` from ``p.get`` on the other side.
+
+Adding a new RPC method
+-----------------------
+1. Register the handler (``server.register("MyMethod", ...)``) and add the
+   call site.
+2. If the method carries a structured payload, add a ``WireSchema`` entry
+   here. Required = keys every producer always sends; optional = everything
+   any consumer may read. Reply shapes are not checked (replies are built
+   and consumed in one file in practice).
+3. Run ``python -m ray_tpu.devtools.lint`` — drift in either direction
+   (producer missing a required key / sending an undeclared one, consumer
+   reading an undeclared one) fails the gate.
+
+Compat story: a key can be *added* by first declaring it ``optional`` and
+shipping consumers that ``p.get`` it, then promoting it to ``required``
+once every producer sends it. Removal is the reverse. The registry makes
+each step reviewable.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, FrozenSet, Iterable
+
+
+@dataclass(frozen=True)
+class WireSchema:
+    """Payload-key contract for one RPC method."""
+
+    required: FrozenSet[str] = frozenset()
+    optional: FrozenSet[str] = frozenset()
+
+
+def _s(required: Iterable[str] = (), optional: Iterable[str] = ()) -> WireSchema:
+    return WireSchema(frozenset(required), frozenset(optional))
+
+
+# The top message types by control/data-plane traffic. Methods not listed
+# here still get method-name cross-checking, just not key checking.
+SCHEMAS: Dict[str, WireSchema] = {
+    # -- GCS control plane ---------------------------------------------------
+    "RegisterNode": _s(["node_id", "addr", "resources"], ["labels"]),
+    "UpdateResources": _s(["node_id", "available"], ["total", "version"]),
+    "CreateActor": _s(["spec"], ["wait_alive", "get_if_exists"]),
+    "GetActor": _s(["actor_id"]),
+    "ReportActorReady": _s(
+        ["actor_id"], ["addr", "worker_id", "node_id", "error"]
+    ),
+    "ReportWorkerDied": _s(["actor_ids"], ["cause", "worker_id"]),
+    "KillActor": _s(["actor_id"], ["no_restart"]),
+    "KVPut": _s(["key", "value"], ["ns", "overwrite"]),
+    "KVGet": _s(["key"], ["ns"]),
+    "Subscribe": _s(["channel"]),
+    "Publish": _s(["channel", "msg"]),
+    # Server->client pubsub delivery push.
+    "Pub": _s(["channel", "msg"]),
+    # -- raylet scheduling ---------------------------------------------------
+    "RequestWorkerLease": _s(
+        ["lease_id", "resources"],
+        ["strategy", "pg_id", "bundle_index", "spilled_from", "job_id"],
+    ),
+    "CancelWorkerLease": _s(["lease_id"]),
+    "ReturnWorker": _s(["lease_id"], ["dirty"]),
+    "LeaseWorkerForActor": _s(["spec"]),
+    "KillWorker": _s(["worker_id"], ["probe", "force"]),
+    # -- task dispatch -------------------------------------------------------
+    "PushTask": _s(["spec"]),
+    "PushActorTask": _s(["spec"]),
+    # -- object plane --------------------------------------------------------
+    "ObjCreate": _s(["oid", "size"], ["pin"]),
+    "ObjSeal": _s(["oid"]),
+    "WaitObject": _s(["oid"], ["timeout"]),
+    "PushStart": _s(["oid", "size"]),
+    "PushChunk": _s(["oid", "offset", "data"]),
+    # -- logs / observability ------------------------------------------------
+    "GetLog": _s([], ["filename", "worker_id", "stream", "tail"]),
+}

@@ -519,7 +519,7 @@ class Executor:
 
                     raise asyncio.CancelledError("task cancelled")
                 if asyncio.iscoroutinefunction(fn):
-                    coro_task = asyncio.ensure_future(fn(*args, **kwargs))
+                    coro_task = rpc.spawn(fn(*args, **kwargs))
                     track["async_task"] = coro_task
                     result = await coro_task
                 else:
@@ -570,7 +570,7 @@ class Executor:
                              "return_ids": [self._dyn_oid(wire, idx)]},
                             item,
                         )
-                        inflight.append(asyncio.ensure_future(
+                        inflight.append(rpc.spawn(
                             self._send_generator_item(
                                 conn, wire["task_id"], idx, ret[0]
                             )
@@ -687,9 +687,7 @@ class Executor:
         # Readiness/failure flows to the GCS via ReportActorReady, which is
         # what gates task submission (reference: GcsActorScheduler pushes the
         # creation task asynchronously and tracks readiness separately).
-        self._creation_task = asyncio.get_running_loop().create_task(
-            self._run_actor_creation(wire)
-        )
+        self._creation_task = rpc.spawn(self._run_actor_creation(wire))
         return {"ok": True}
 
     async def _run_actor_creation(self, wire) -> None:
@@ -878,7 +876,7 @@ class Executor:
                         # consumer throttles the producer instead of the
                         # owner buffering the whole stream (reference:
                         # _generator_backpressure_num_objects).
-                        inflight.append(asyncio.ensure_future(
+                        inflight.append(rpc.spawn(
                             self._send_generator_item(
                                 conn, wire["task_id"], idx, ret[0]
                             )

@@ -1,0 +1,17 @@
+"""Repo-specific static analysis for the asyncio control plane.
+
+Three passes (run all of them via ``python -m ray_tpu.devtools.lint``):
+
+- :mod:`ray_tpu.devtools.aio_lint` — AST linter for asyncio hazards
+  (blocking calls in ``async def``, raw ``create_task`` outside
+  ``rpc.spawn()``, unawaited coroutines, await-interleaving TOCTOU).
+- :mod:`ray_tpu.devtools.rpc_check` — wire-protocol cross-checker for the
+  msgpack RPC layer (call-site method names vs. handler registries, payload
+  key drift against the :mod:`ray_tpu._private.wire` schema registry).
+- :mod:`ray_tpu._private.aiocheck` — runtime interleaving probe enabled by
+  ``RAY_TPU_AIOCHECK=1``; validates the static pass dynamically in tests.
+
+Every static rule supports inline suppression with
+``# aio-lint: disable=<rule>[,<rule>...]`` on the flagged line or the line
+directly above it.
+"""

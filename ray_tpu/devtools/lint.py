@@ -1,0 +1,39 @@
+"""Unified static-analysis gate: ``python -m ray_tpu.devtools.lint``.
+
+Runs the asyncio hazard linter (aio_lint) and the RPC wire cross-checker
+(rpc_check) over the package and exits non-zero on any finding. This is the
+CI lint job's entry point; ``make lint`` wraps it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from ray_tpu.devtools import aio_lint, rpc_check
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m ray_tpu.devtools.lint",
+        description="run all ray_tpu static-analysis passes",
+    )
+    parser.add_argument("paths", nargs="*", default=None)
+    args = parser.parse_args(argv)
+    paths = args.paths or [aio_lint._default_root()]
+
+    findings = list(aio_lint.lint_paths(paths))
+    findings.extend(rpc_check.check(paths))
+    findings.sort(key=lambda f: (f.path, f.line, f.col))
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"lint: {len(findings)} finding(s) across aio-lint + rpc-check")
+        return 1
+    print("lint: clean (aio-lint + rpc-check)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

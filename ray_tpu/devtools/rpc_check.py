@@ -1,0 +1,505 @@
+"""Wire-protocol cross-checker for the msgpack RPC layer.
+
+The RPC layer (:mod:`ray_tpu._private.rpc`) dispatches untyped
+``[msgid, kind, method, payload]`` frames by *string* method name, and the
+payloads are ad-hoc msgpack dicts — a method-name typo or a renamed payload
+key fails at runtime (or worse, silently returns ``None`` from ``p.get``).
+This pass makes the wire protocol checkable at lint time:
+
+1. **Method inventory** — every literal method string at a
+   ``call``/``call_nowait``/``call_cb``/``push``/``push_nowait`` call site is
+   cross-checked against every handler registration
+   (``Server.register``/``register_sync``, ``@server.handler(...)``, literal
+   ``handlers={...}`` dicts passed to ``rpc.connect``/``Connection``, and
+   ``_handlers["X"] = fn`` / ``_handlers.setdefault("X", fn)``). Call sites
+   naming a method no server registers are errors; registered handlers no
+   client ever calls are reported as orphans.
+2. **Payload-key drift** — for the message types declared in
+   :mod:`ray_tpu._private.wire`, producer payload dict literals must carry
+   every required key and nothing undeclared, and consumer handler bodies
+   (``p["k"]`` / ``p.get("k")`` on the payload parameter) must only touch
+   declared keys.
+
+Non-literal method names (e.g. the dashboard's generic proxy
+``conn.call(method, ...)``) are outside the static horizon and skipped.
+Suppression: ``# aio-lint: disable=<rule>`` with rules
+``unknown-rpc-method``, ``orphan-rpc-handler``, ``payload-key-drift``.
+
+Run: ``python -m ray_tpu.devtools.rpc_check [--markdown] [paths]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu.devtools.aio_lint import (
+    Finding,
+    _suppressions,
+    iter_py_files,
+    _default_root,
+)
+
+RULE_UNKNOWN = "unknown-rpc-method"
+RULE_ORPHAN = "orphan-rpc-handler"
+RULE_DRIFT = "payload-key-drift"
+
+_CALL_METHODS = {"call", "call_nowait", "call_cb", "push", "push_nowait"}
+_REGISTER_METHODS = {"register", "register_sync", "handler"}
+
+
+@dataclass
+class CallSite:
+    method: str
+    path: str
+    line: int
+    # Literal payload keys when the payload is a dict display with constant
+    # keys; None when the payload is dynamic (or **expanded).
+    payload_keys: Optional[Set[str]] = None
+    via: str = "call"
+
+
+@dataclass
+class Registration:
+    method: str
+    path: str
+    line: int
+    handler_name: Optional[str] = None  # simple function/method name if known
+    kind: str = "register"
+
+
+@dataclass
+class Inventory:
+    calls: List[CallSite] = field(default_factory=list)
+    regs: List[Registration] = field(default_factory=list)
+    # (path, handler_name) -> payload keys the handler body touches.
+    handler_keys: Dict[Tuple[str, str], Set[str]] = field(default_factory=dict)
+    # Every other string literal in the tree, for lenient orphan detection:
+    # state/dashboard wrappers pass method names through one indirection
+    # (``_call_gcs("ListActors")``), so "no other literal mentions this
+    # method" is the actual dead-handler signal.
+    str_literals: Set[str] = field(default_factory=set)
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _payload_keys(node: Optional[ast.AST]) -> Optional[Set[str]]:
+    """Keys of a dict-display payload, or None when not fully literal."""
+    if not isinstance(node, ast.Dict):
+        return None
+    keys: Set[str] = set()
+    for k in node.keys:
+        if k is None:  # **spread
+            return None
+        s = _const_str(k)
+        if s is None:
+            return None
+        keys.add(s)
+    return keys
+
+
+def _fn_simple_name(node: ast.AST) -> Optional[str]:
+    """``self._foo`` / ``foo`` -> the trailing identifier."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+class _FileScanner(ast.NodeVisitor):
+    def __init__(self, path: str, inv: Inventory):
+        self.path = path
+        self.inv = inv
+        self._fn_stack: List[ast.AST] = []
+
+    # -- handler payload-key usage ------------------------------------------
+
+    def _scan_handler_body(self, fn) -> None:
+        """Record ``p["k"]``/``p.get("k")`` key usage for handler-shaped
+        functions ``(conn, p)`` / ``(self, conn, p)``."""
+        args = fn.args.args
+        if not args:
+            return
+        pname = args[-1].arg
+        if pname in ("self", "conn"):
+            return
+        keys: Set[str] = set()
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Subscript)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == pname
+            ):
+                s = _const_str(node.slice)
+                if s is not None:
+                    keys.add(s)
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("get", "pop")
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == pname
+                and node.args
+            ):
+                s = _const_str(node.args[0])
+                if s is not None:
+                    keys.add(s)
+        if keys:
+            self.inv.handler_keys[(self.path, fn.name)] = keys
+
+    def visit_FunctionDef(self, node) -> None:
+        self._scan_handler_body(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node) -> None:
+        self._scan_handler_body(node)
+        self.generic_visit(node)
+
+    # -- call sites and registrations ---------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        attr = fn.attr if isinstance(fn, ast.Attribute) else None
+        if attr in _CALL_METHODS and node.args:
+            method = _const_str(node.args[0])
+            if method is not None:
+                payload = node.args[1] if len(node.args) > 1 else None
+                for kw in node.keywords:
+                    if kw.arg == "payload":
+                        payload = kw.value
+                self.inv.calls.append(
+                    CallSite(
+                        method,
+                        self.path,
+                        node.lineno,
+                        _payload_keys(payload),
+                        via=attr,
+                    )
+                )
+        elif attr in _REGISTER_METHODS and node.args:
+            method = _const_str(node.args[0])
+            if method is not None:
+                handler = (
+                    _fn_simple_name(node.args[1]) if len(node.args) > 1 else None
+                )
+                self.inv.regs.append(
+                    Registration(method, self.path, node.lineno, handler, attr)
+                )
+        elif attr == "setdefault" and len(node.args) == 2:
+            # GcsClient-style: conn._handlers.setdefault("Pub", self._on_pub)
+            if self._targets_handlers_dict(fn.value):
+                method = _const_str(node.args[0])
+                if method is not None:
+                    self.inv.regs.append(
+                        Registration(
+                            method,
+                            self.path,
+                            node.lineno,
+                            _fn_simple_name(node.args[1]),
+                            "setdefault",
+                        )
+                    )
+        # Literal handlers= dicts passed to rpc.connect()/Connection().
+        for kw in node.keywords:
+            if kw.arg in ("handlers", "sync_handlers") and isinstance(
+                kw.value, ast.Dict
+            ):
+                for k, v in zip(kw.value.keys, kw.value.values):
+                    s = _const_str(k) if k is not None else None
+                    if s is not None:
+                        self.inv.regs.append(
+                            Registration(
+                                s, self.path, k.lineno, _fn_simple_name(v), kw.arg
+                            )
+                        )
+        self.generic_visit(node)
+
+    def _targets_handlers_dict(self, node: ast.AST) -> bool:
+        name = None
+        if isinstance(node, ast.Attribute):
+            name = node.attr
+        elif isinstance(node, ast.Name):
+            name = node.id
+        return name is not None and "handlers" in name
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # _handlers["Name"] = fn
+        for tgt in node.targets:
+            if (
+                isinstance(tgt, ast.Subscript)
+                and self._targets_handlers_dict(tgt.value)
+            ):
+                method = _const_str(tgt.slice)
+                if method is not None:
+                    self.inv.regs.append(
+                        Registration(
+                            method,
+                            self.path,
+                            node.lineno,
+                            _fn_simple_name(node.value),
+                            "subscript",
+                        )
+                    )
+        self.generic_visit(node)
+
+
+def build_inventory(paths: List[str]) -> Inventory:
+    inv = Inventory()
+    files: List[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            files.extend(iter_py_files(path))
+        else:
+            files.append(path)
+    for f in files:
+        with open(f, "r", encoding="utf-8") as fh:
+            src = fh.read()
+        try:
+            tree = ast.parse(src, filename=f)
+        except SyntaxError:
+            continue
+        _FileScanner(f, inv).visit(tree)
+        reg_lines = {(r.path, r.line) for r in inv.regs}
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and (f, node.lineno) not in reg_lines
+            ):
+                inv.str_literals.add(node.value)
+    return inv
+
+
+def _rpc_module_path() -> str:
+    from ray_tpu._private import rpc
+
+    return os.path.abspath(rpc.__file__)
+
+
+def check(paths: Optional[List[str]] = None) -> List[Finding]:
+    paths = paths or [_default_root()]
+    inv = build_inventory(paths)
+    rpc_path = _rpc_module_path()
+
+    registered = {r.method for r in inv.regs}
+    called: Dict[str, List[CallSite]] = {}
+    for c in inv.calls:
+        # The rpc module's own wrappers (call() delegating to call_nowait())
+        # pass variables, never literals, but keep the guard explicit.
+        if os.path.abspath(c.path) == rpc_path:
+            continue
+        called.setdefault(c.method, []).append(c)
+
+    findings: List[Finding] = []
+
+    for method, sites in sorted(called.items()):
+        if method not in registered:
+            for c in sites:
+                findings.append(
+                    Finding(
+                        c.path,
+                        c.line,
+                        0,
+                        RULE_UNKNOWN,
+                        f"RPC {c.via}({method!r}) has no registered handler "
+                        "anywhere in the tree — typo or dead protocol?",
+                    )
+                )
+
+    seen_reg: Set[str] = set()
+    for r in sorted(inv.regs, key=lambda r: (r.path, r.line)):
+        if r.method in called or r.method in seen_reg:
+            continue
+        if r.method in inv.str_literals:
+            continue  # referenced through a wrapper indirection
+        seen_reg.add(r.method)
+        findings.append(
+            Finding(
+                r.path,
+                r.line,
+                0,
+                RULE_ORPHAN,
+                f"handler {r.method!r} is registered but no client call "
+                "site names it (dead handler, or callers build the method "
+                "name dynamically — suppress if so)",
+            )
+        )
+
+    findings.extend(_check_payload_drift(inv))
+
+    # Apply inline suppressions from the source files involved.
+    sup_cache: Dict[str, Dict[int, Set[str]]] = {}
+
+    def suppressed(f: Finding) -> bool:
+        if f.path not in sup_cache:
+            try:
+                with open(f.path, "r", encoding="utf-8") as fh:
+                    sup_cache[f.path] = _suppressions(fh.read())
+            except OSError:
+                sup_cache[f.path] = {}
+        for line in (f.line, f.line - 1):
+            rules = sup_cache[f.path].get(line)
+            if rules and ("all" in rules or f.rule in rules):
+                return True
+        return False
+
+    return [f for f in findings if not suppressed(f)]
+
+
+def _check_payload_drift(inv: Inventory) -> List[Finding]:
+    from ray_tpu._private import wire
+
+    findings: List[Finding] = []
+    # Producer side: literal payload dicts at call sites.
+    for c in inv.calls:
+        schema = wire.SCHEMAS.get(c.method)
+        if schema is None or c.payload_keys is None:
+            continue
+        missing = schema.required - c.payload_keys
+        unknown = c.payload_keys - schema.required - schema.optional
+        if missing:
+            findings.append(
+                Finding(
+                    c.path,
+                    c.line,
+                    0,
+                    RULE_DRIFT,
+                    f"{c.method} payload is missing required key(s) "
+                    f"{sorted(missing)} (wire.py schema)",
+                )
+            )
+        if unknown:
+            findings.append(
+                Finding(
+                    c.path,
+                    c.line,
+                    0,
+                    RULE_DRIFT,
+                    f"{c.method} payload carries key(s) {sorted(unknown)} "
+                    "not declared in wire.py — field-name drift, or extend "
+                    "the schema",
+                )
+            )
+    # Consumer side: key usage inside the registered handler bodies.
+    for r in inv.regs:
+        schema = wire.SCHEMAS.get(r.method)
+        if schema is None or r.handler_name is None:
+            continue
+        keys = inv.handler_keys.get((r.path, r.handler_name))
+        if not keys:
+            continue
+        unknown = keys - schema.required - schema.optional
+        if unknown:
+            findings.append(
+                Finding(
+                    r.path,
+                    r.line,
+                    0,
+                    RULE_DRIFT,
+                    f"handler for {r.method} ({r.handler_name}) reads "
+                    f"payload key(s) {sorted(unknown)} not declared in "
+                    "wire.py — producer/consumer drift",
+                )
+            )
+    return findings
+
+
+def markdown_table(paths: Optional[List[str]] = None) -> str:
+    """The versioned wire-protocol inventory committed to docs/."""
+    from ray_tpu._private import wire
+
+    paths = paths or [_default_root()]
+    inv = build_inventory(paths)
+    root = os.path.dirname(_default_root())
+
+    def rel(p: str) -> str:
+        return os.path.relpath(p, root)
+
+    by_method: Dict[str, Dict[str, List]] = {}
+    for r in inv.regs:
+        by_method.setdefault(r.method, {"regs": [], "calls": []})["regs"].append(r)
+    for c in inv.calls:
+        if os.path.abspath(c.path) == _rpc_module_path():
+            continue
+        by_method.setdefault(c.method, {"regs": [], "calls": []})["calls"].append(c)
+
+    lines = [
+        "# RPC wire-protocol inventory",
+        "",
+        "Generated by `python -m ray_tpu.devtools.rpc_check --markdown`.",
+        "Frames are msgpack `[msgid, kind, method, payload]`"
+        " (see `ray_tpu/_private/rpc.py`). Schemas for the starred methods",
+        "live in `ray_tpu/_private/wire.py`; the lint gate fails on drift.",
+        "",
+        "| Method | Schema | Servers (handler) | Client call sites | Payload keys |",
+        "|---|---|---|---|---|",
+    ]
+    for method in sorted(by_method):
+        info = by_method[method]
+        schema = wire.SCHEMAS.get(method)
+        servers = ", ".join(
+            sorted(
+                {
+                    f"`{os.path.basename(r.path)}`:{r.handler_name or '?'}"
+                    for r in info["regs"]
+                }
+            )
+        ) or "—"
+        nsites = len(info["calls"])
+        files = sorted({os.path.basename(c.path) for c in info["calls"]})
+        callers = f"{nsites} site(s) in {', '.join(files)}" if nsites else "—"
+        if schema is not None:
+            keys = ", ".join(
+                sorted(schema.required)
+                + [f"{k}?" for k in sorted(schema.optional)]
+            ) or "(empty)"
+            star = "★"
+        else:
+            keys, star = "", ""
+        lines.append(f"| `{method}` | {star} | {servers} | {callers} | {keys} |")
+    lines.append("")
+    lines.append(
+        f"{len(by_method)} methods; ★ = schema-checked "
+        f"({len(wire.SCHEMAS)} declared)."
+    )
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m ray_tpu.devtools.rpc_check",
+        description="RPC wire cross-checker (methods + payload schemas)",
+    )
+    parser.add_argument("paths", nargs="*", default=None)
+    parser.add_argument(
+        "--markdown",
+        action="store_true",
+        help="emit the method-inventory markdown table instead of checking",
+    )
+    args = parser.parse_args(argv)
+    paths = args.paths or None
+    if args.markdown:
+        print(markdown_table(paths))
+        return 0
+    findings = check(paths)
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"rpc-check: {len(findings)} finding(s)")
+        return 1
+    print("rpc-check: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -107,7 +107,7 @@ class ServeController:
 
     async def start(self) -> bool:
         if self._loop_task is None:
-            self._loop_task = asyncio.ensure_future(self._run_control_loop())
+            self._loop_task = _spawn(self._run_control_loop())
             if self._http_options.get("enabled", True):
                 await self._ensure_proxy()
         return True
@@ -369,7 +369,7 @@ class ServeController:
 
     def _start_replica(self, state: _DeploymentState) -> None:
         replica_id = ReplicaID.generate(state.dep_id)
-        task = asyncio.ensure_future(self._create_replica(state, replica_id))
+        task = _spawn(self._create_replica(state, replica_id))
         state.starting[replica_id.unique_id] = task
 
     async def _create_replica(
@@ -417,7 +417,7 @@ class ServeController:
             rec = _ReplicaRecord(replica_id, actor_id, cfg.max_ongoing_requests)
             rec.ready = True
             state.replicas[replica_id.unique_id] = rec
-            rec.health_task = asyncio.ensure_future(self._health_loop(state, rec))
+            rec.health_task = _spawn(self._health_loop(state, rec))
             state.message = ""
             state.consecutive_start_failures = 0
             state.backoff_until = 0.0
@@ -498,7 +498,7 @@ class ServeController:
             rec.health_task = None
         state.replicas.pop(rec.replica_id.unique_id, None)
         self._broadcast_replicas(str(state.dep_id))
-        task = asyncio.ensure_future(self._stop_replica(state, rec))
+        task = _spawn(self._stop_replica(state, rec))
         state.stopping[rec.replica_id.unique_id] = task
 
     async def _stop_replica(self, state: _DeploymentState, rec: _ReplicaRecord) -> None:

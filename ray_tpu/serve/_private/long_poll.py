@@ -79,7 +79,7 @@ class LongPollClient:
         self._stopped = False
 
     def start(self) -> None:
-        self._task = asyncio.ensure_future(self._run())
+        self._task = _spawn(self._run())
 
     def stop(self) -> None:
         self._stopped = True
@@ -96,7 +96,9 @@ class LongPollClient:
                 await asyncio.sleep(0.2)
                 continue
             for key, (sid, value) in (updates or {}).items():
-                self._snapshot_ids[key] = sid
+                # Single-writer: _run() is the only task that mutates this
+                # client's _snapshot_ids, so the read-await-write is benign.
+                self._snapshot_ids[key] = sid  # aio-lint: disable=await-interleave
                 cb = self._key_listeners.get(key)
                 if cb is not None:
                     cb(value)

@@ -94,8 +94,15 @@ def remove_placement_group(pg: PlacementGroup) -> None:
     )
 
 
-def placement_group_table() -> List[dict]:
+def placement_group_table(pg: Optional[PlacementGroup] = None) -> List[dict]:
+    """State of every placement group, or of just ``pg`` when given (a
+    targeted GetPlacementGroup instead of listing the whole table)."""
     core = worker_mod._core()
+    if pg is not None:
+        reply = worker_mod.global_worker.run_async(
+            core.gcs.call("GetPlacementGroup", {"pg_id": pg.id_hex})
+        )
+        return [reply["pg"]] if reply.get("pg") else []
     return worker_mod.global_worker.run_async(core.gcs.call("ListPlacementGroups"))[
         "pgs"
     ]

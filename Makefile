@@ -4,7 +4,7 @@
 PYTHON ?= python
 SANITIZER ?= address
 
-.PHONY: lint test sanitize wire-docs build
+.PHONY: lint test sanitize wire-docs build chaos
 
 lint:
 	$(PYTHON) -m ray_tpu.devtools.lint
@@ -37,3 +37,12 @@ sanitize:
 
 wire-docs:
 	$(PYTHON) -m ray_tpu.devtools.rpc_check --markdown > docs/wire_protocol.md
+
+# Deterministic fault injection (docs/chaos.md). SEEDS seeds per scenario;
+# failing seeds land in chaos_corpus.jsonl for replay.
+SEEDS ?= 20
+chaos:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m ray_tpu.chaos --check-determinism \
+		--suite full --seeds $(SEEDS)
+	env JAX_PLATFORMS=cpu $(PYTHON) -m ray_tpu.chaos --suite smoke \
+		--seeds $(SEEDS)

@@ -94,6 +94,14 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     # (reference: pull_manager.h bandwidth-capped pulls). Head-of-line
     # pulls exceed it rather than deadlock.
     "pull_max_bytes_in_flight": 256 * 1024 * 1024,
+    # Inbound push-stream stall detection: a pull whose chunk assembly makes
+    # no progress for this long (source died mid-push, chunks lost on a bad
+    # link) aborts the assembly and re-requests the push instead of waiting
+    # out the full blocking-get timeout + the 60s assembly janitor.
+    "pull_stall_timeout_s": 5.0,
+    # How many times a stalled push stream is re-requested before the pull
+    # falls back to the request/reply chunk loop.
+    "pull_max_rerequests": 2,
     # Fork workers from a preloaded zygote process (reference:
     # worker_pool.cc prestart) instead of cold `python -m` spawns —
     # ~10ms vs ~0.5-1.5s per worker, the difference between seconds and

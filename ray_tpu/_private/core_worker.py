@@ -626,6 +626,13 @@ class LeasePool:
                 pool.inflight_ids.discard(lease_id)
                 if reply.get("cancelled"):
                     pool.inflight -= 1
+                    # A cancel can cross new work: we asked to cancel this
+                    # request while the queue was empty, and a task was
+                    # submitted before the cancelled reply landed. Without a
+                    # re-pump that task would sit pending with no request in
+                    # flight, forever.
+                    if pool.pending:
+                        self._pump(key, pool)
                     return
                 if reply.get("granted"):
                     conn = await self.core.connect_to(tuple(reply["worker_addr"]))

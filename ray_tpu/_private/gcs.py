@@ -154,6 +154,11 @@ class GcsServer:
         self._wake_scheduler = asyncio.Event()
         self._scheduler_task: Optional[asyncio.Task] = None
         self._bg_tasks: List[asyncio.Task] = []
+        # True while stop() tears the server down. Connection drops during a
+        # deliberate shutdown are us leaving, not peers dying — reacting to
+        # them would persist bogus node-death state (actors marked
+        # RESTARTING/DEAD) that a restarted GCS then faithfully reloads.
+        self._stopping = False
         # Persistence (reference: StoreClient, store_client.h:33). The live
         # state above stays the source of truth; mutations write through to
         # the store, and a restarted GCS reloads it (GCS fault tolerance).
@@ -331,6 +336,7 @@ class GcsServer:
                 )
 
     async def stop(self) -> None:
+        self._stopping = True
         if self._scheduler_task:
             self._scheduler_task.cancel()
         for t in self._bg_tasks:
@@ -446,6 +452,8 @@ class GcsServer:
         return {"ok": True}
 
     def _on_disconnect(self, conn: rpc.Connection) -> None:
+        if self._stopping:
+            return
         node_id = conn.context.get("node_id")
         if node_id and node_id in self.nodes:
             try:

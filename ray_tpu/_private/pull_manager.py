@@ -24,11 +24,29 @@ from typing import List, Tuple
 PRIORITY = {"get": 0, "wait": 1, "task_arg": 2}
 
 
+class PullStalled(Exception):
+    """A chunk stream stopped making progress (source dropped mid-push or
+    chunks were lost); the caller should abort the assembly and re-request."""
+
+
 class PullManager:
-    def __init__(self, max_bytes_in_flight: int):
+    def __init__(
+        self,
+        max_bytes_in_flight: int,
+        stall_timeout_s: float = 5.0,
+        max_rerequests: int = 2,
+    ):
         self.max_bytes = int(max_bytes_in_flight)
         self.bytes_in_flight = 0
         self.active = 0
+        # Chunk-stream supervision: a push assembly with no byte progress
+        # for stall_timeout_s is declared stalled; the pull path re-requests
+        # the push up to max_rerequests times before falling back to the
+        # request/reply chunk loop.
+        self.stall_timeout_s = float(stall_timeout_s)
+        self.max_rerequests = int(max_rerequests)
+        self.stalled_streams = 0
+        self.rerequested_streams = 0
         # Heap of (priority, seq, size, future) — seq keeps FIFO order
         # within a priority class and makes heap entries comparable.
         self._waiters: List[Tuple[int, int, int, asyncio.Future]] = []
@@ -78,9 +96,41 @@ class PullManager:
         self.active = max(0, self.active - 1)
         self._drain()
 
+    async def watch_stream(self, progress, done, timeout: float) -> None:
+        """Supervise one inbound chunk stream until ``done()`` is truthy.
+
+        ``progress()`` returns an opaque monotone marker (bytes received);
+        when it stops changing for ``stall_timeout_s`` — the source died
+        mid-push, or one-way chunks were dropped so the tail never arrives —
+        raise :class:`PullStalled` so the caller can abort the half-written
+        assembly and re-request instead of blocking until the 60s assembly
+        janitor. ``timeout`` bounds the whole wait (healthy streams included).
+        """
+        loop = asyncio.get_running_loop()
+        last = progress()
+        last_change = loop.time()
+        deadline = last_change + timeout
+        while not done():
+            await asyncio.sleep(0.05)
+            now = loop.time()
+            cur = progress()
+            if cur != last:
+                last, last_change = cur, now
+            elif now - last_change >= self.stall_timeout_s:
+                self.stalled_streams += 1
+                raise PullStalled(
+                    f"chunk stream stalled at {cur!r} for "
+                    f"{now - last_change:.1f}s"
+                )
+            if now >= deadline:
+                self.stalled_streams += 1
+                raise PullStalled(f"chunk stream incomplete after {timeout}s")
+
     def stats(self) -> dict:
         return {
             "bytes_in_flight": self.bytes_in_flight,
             "active_pulls": self.active,
             "queued_pulls": len(self._waiters),
+            "stalled_streams": self.stalled_streams,
+            "rerequested_streams": self.rerequested_streams,
         }

@@ -22,10 +22,11 @@ import logging
 import os
 import sys
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private import aiocheck, external_storage, rpc, shm
+from ray_tpu._private.pull_manager import PullStalled
 from ray_tpu._private.push_manager import PushManager
 from ray_tpu._private.common import ResourceSet, config
 from ray_tpu._private.gcs import GcsClient
@@ -338,7 +339,11 @@ class Raylet:
         # bandwidth-capped pulls).
         from ray_tpu._private.pull_manager import PullManager
 
-        self.pull_manager = PullManager(config.pull_max_bytes_in_flight)
+        self.pull_manager = PullManager(
+            config.pull_max_bytes_in_flight,
+            stall_timeout_s=config.pull_stall_timeout_s,
+            max_rerequests=config.pull_max_rerequests,
+        )
         # Preloaded fork server for fast worker spawn (reference:
         # worker_pool.cc prestart); started lazily on first spawn.
         self._zygote: Optional[_Zygote] = None
@@ -368,6 +373,25 @@ class Raylet:
         # until the cluster scales (autoscaler demand input).
         self.infeasible_leases: List[LeaseRequest] = []
         self.leases: Dict[str, WorkerHandle] = aiocheck.track("raylet.leases")
+        # Exactly-once grant ledger: every lease id this raylet has COMMITTED
+        # to granting (recorded synchronously with the resource deduction,
+        # before the async _grant task runs). A duplicated RequestWorkerLease
+        # frame (retry, wire-level duplication — reproduced by the
+        # RAY_TPU_AIOCHECK probe as a cross-task write-write on raylet.leases)
+        # queues the same lease id twice; without the ledger the second grant
+        # overwrites the first's leases[] entry and leaks that worker +
+        # its resources forever. Bounded LRU: ids only need to outlive the
+        # duplicate-arrival window, not the session.
+        self.granted_lease_ids: "OrderedDict[str, bool]" = OrderedDict()
+        # Actor lease ids whose grant+CreateActor is currently in flight:
+        # distinguishes a wire-duplicated placement (mirror the original)
+        # from a GCS re-placement of a completed lease (supersede it).
+        self.actor_creations_in_flight: set = set()
+        self.duplicate_lease_grants_avoided = 0
+        # Grants spawned but not yet resolved: their resources are deducted
+        # but the lease is not in `leases` yet, so ledger observers must
+        # treat the node as busy while this is nonzero.
+        self.grants_in_flight = 0
 
         # Placement group bundles committed on this node:
         # pg_id -> {"base": ResourceSet deducted, "group": ResourceSet added}
@@ -847,6 +871,7 @@ class Raylet:
             self.idle_workers.remove(handle)
         if handle.lease_id and handle.lease_id in self.leases:
             del self.leases[handle.lease_id]
+            self._mark_lease_released(handle.lease_id)
             self._free_lease_resources(handle)
         if not handle.registered.done():
             handle.registered.set_exception(rpc.RpcError(f"worker died: {cause}"))
@@ -938,6 +963,11 @@ class Raylet:
         return ResourceSet.from_units(units)
 
     async def _request_worker_lease(self, conn, p):
+        if self._is_duplicate_grant(p["lease_id"]):
+            # Duplicate of a lease this raylet already committed to granting
+            # (wire-level frame duplication or a client retry): answer
+            # idempotently instead of double-granting.
+            return await self._duplicate_lease_reply(p["lease_id"])
         demand = ResourceSet.from_units(p.get("resources") or {})
         demand = self._translate_pg_demand(
             demand, p.get("pg_id"), p.get("bundle_index")
@@ -1218,11 +1248,94 @@ class Raylet:
         """Cancel a queued (ungranted) lease request: the surplus-request
         drain that keeps recycled-lease pools from pinning the raylet queue
         (reference: NodeManagerService CancelWorkerLease)."""
+        lease_id = p["lease_id"]
+        if self.granted_lease_ids.get(lease_id):
+            # Already committed to granting: too late to cancel. Any queued
+            # duplicate of this id mirrors the grant reply instead — setting
+            # it "cancelled" here could beat the grant reply to the shared
+            # msgid and strand a granted worker the client abandoned.
+            return {"ok": True}
         for req in list(self.pending_leases) + list(self.infeasible_leases):
-            if req.lease_id == p["lease_id"] and not req.fut.done():
+            # Resolve EVERY queued copy: wire duplication can queue the same
+            # lease id twice, and a survivor would be granted to a client
+            # that has moved on.
+            if req.lease_id == lease_id and not req.fut.done():
                 req.fut.set_result({"cancelled": True})
-                break
+        # Burn the id so a late-arriving duplicate frame cannot re-queue a
+        # grantable request for it.
+        self._burn_lease_id(lease_id)
         return {"ok": True}
+
+    _GRANT_LEDGER_CAP = 4096
+
+    def _record_granted(self, lease_id: str) -> None:
+        self.granted_lease_ids[lease_id] = True  # True = live (not released)
+        while len(self.granted_lease_ids) > self._GRANT_LEDGER_CAP:
+            self.granted_lease_ids.popitem(last=False)
+
+    def _mark_lease_released(self, lease_id: str) -> None:
+        if lease_id in self.granted_lease_ids:
+            self.granted_lease_ids[lease_id] = False
+
+    def _burn_lease_id(self, lease_id: str) -> None:
+        """Record a lease id as spent without a live grant (cancelled): task
+        ids are single-use, so any later request for it is a duplicate and
+        resolves ``cancelled`` instead of granting."""
+        self.granted_lease_ids[lease_id] = False
+        while len(self.granted_lease_ids) > self._GRANT_LEDGER_CAP:
+            self.granted_lease_ids.popitem(last=False)
+
+    def _is_duplicate_grant(self, lease_id: str) -> bool:
+        """True when granting this id (again) would double-grant. Task lease
+        ids are unique per request, so any ledger entry — live or released —
+        marks a duplicate. Actor lease ids are legitimately reused on
+        restart, so only a LIVE entry counts."""
+        state = self.granted_lease_ids.get(lease_id)
+        if state is None:
+            return False
+        return state if lease_id.startswith("actor:") else True
+
+    async def _duplicate_lease_reply(self, lease_id: str) -> dict:
+        """Reply for a duplicate request for an already-committed lease id.
+
+        The committed grant may still be in flight (worker spawning), and
+        duplicated frames share a msgid — whichever reply lands first wins at
+        the client. Answering ``cancelled`` while the real grant resolves
+        would make the client abandon a lease the raylet then completes
+        (wedged task + leaked worker), so wait for the outcome: reply
+        idempotently with the granted worker, or ``cancelled`` once the
+        grant failed or the lease was already released.
+        """
+        self.duplicate_lease_grants_avoided += 1
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + 30.0
+        while loop.time() < deadline:
+            handle = self.leases.get(lease_id)
+            if handle is not None and handle.addr is not None:
+                # Mirror only a grant whose worker link is still up. A leased
+                # worker that died keeps its leases[] entry until the reaper
+                # runs, but callers learn of the death sooner (its GCS/raylet
+                # conns drop on exit) and re-request the lease; mirroring the
+                # doomed grant would hand them a dead worker they wait on
+                # forever (seen as a GCS actor parked in RESTARTING).
+                if (
+                    handle.worker_id in self.workers
+                    and handle.conn is not None
+                    and not handle.conn.closed
+                ):
+                    return self._grant_reply(handle, lease_id)
+            if not self.granted_lease_ids.get(lease_id, False):
+                break  # grant failed, or lease released: nothing to mirror
+            await asyncio.sleep(0.01)
+        return {"cancelled": True}
+
+    def _resolve_duplicate_lease(self, req: LeaseRequest) -> None:
+        rpc.spawn(self._resolve_duplicate_lease_async(req))
+
+    async def _resolve_duplicate_lease_async(self, req: LeaseRequest) -> None:
+        reply = await self._duplicate_lease_reply(req.lease_id)
+        if not req.fut.done():
+            req.fut.set_result(reply)
 
     def _try_grant_leases(self) -> None:
         granted_any = True
@@ -1233,14 +1346,36 @@ class Raylet:
                 self.pending_leases.pop(0)
                 granted_any = True
                 continue
+            if self._is_duplicate_grant(req.lease_id):
+                # Already committed to granting this id (duplicated frame or
+                # client retry): granting again would double-deduct resources
+                # and overwrite leases[id], leaking the first worker.
+                self.pending_leases.pop(0)
+                self._resolve_duplicate_lease(req)
+                granted_any = True
+                continue
             if req.demand.is_subset_of(self.available):
                 self.pending_leases.pop(0)
                 self.available = self.available - req.demand
                 self._mark_dirty()
+                # Record the commitment BEFORE the async grant runs so a
+                # same-id request queued behind us in this very loop pass is
+                # already visible as a duplicate.
+                self._record_granted(req.lease_id)
+                self.grants_in_flight += 1
                 rpc.spawn(self._grant(req))
                 granted_any = True
 
     async def _grant(self, req: LeaseRequest) -> None:
+        try:
+            await self._grant_inner(req)
+        finally:
+            # Resources are deducted at spawn time but only visible in
+            # `leases` once the grant resolves; the counter lets observers
+            # (quiescence checks, stats) see the in-between state.
+            self.grants_in_flight -= 1
+
+    async def _grant_inner(self, req: LeaseRequest) -> None:
         container = (
             ((req.payload.get("spec") or {}).get("runtime_env") or {})
             .get("container")
@@ -1269,8 +1404,26 @@ class Raylet:
         except rpc.RpcError as e:
             self.available = self.available + req.demand
             self._mark_dirty()
+            # The grant never happened: clear the ledger entry so a genuine
+            # client retry with the same id is not refused forever.
+            self.granted_lease_ids.pop(req.lease_id, None)
             if not req.fut.done():
                 req.fut.set_exception(e)
+            return
+        if req.lease_id in self.leases:
+            # Double grant (two _grant tasks raced to the same lease id —
+            # the write-write the AIOCHECK probe caught live). The first
+            # write owns the lease; this grant is a no-op: re-credit the
+            # demand and return the just-acquired worker to the pool.
+            self.available = self.available + req.demand
+            self._mark_dirty()
+            if container:
+                # Dedicated containerized worker: not pool-reusable.
+                self._kill_worker_proc(handle)
+            else:
+                self._return_worker_to_pool(handle)
+            self._resolve_duplicate_lease(req)
+            self._try_grant_leases()
             return
         handle.lease_id = req.lease_id
         handle.demand = req.demand  # type: ignore[attr-defined]
@@ -1278,17 +1431,35 @@ class Raylet:
         handle.job_id = req.payload.get("job_id") or handle.job_id
         self.leases[req.lease_id] = handle
         if not req.fut.done():
-            req.fut.set_result(
-                {
-                    "granted": True,
-                    "worker_id": handle.worker_id,
-                    "worker_addr": list(handle.addr),
-                    "lease_id": req.lease_id,
-                    "fp_port": handle.fp_port,
-                }
-            )
+            req.fut.set_result(self._grant_reply(handle, req.lease_id))
         else:  # caller gave up; return resources
             self._release_lease(req.lease_id, dirty=False)
+
+    def _grant_reply(self, handle: WorkerHandle, lease_id: str) -> dict:
+        return {
+            "granted": True,
+            "worker_id": handle.worker_id,
+            "worker_addr": list(handle.addr),
+            "lease_id": lease_id,
+            "fp_port": handle.fp_port,
+        }
+
+    def _return_worker_to_pool(self, handle: WorkerHandle) -> None:
+        """Return a worker acquired for a grant that will not happen (the
+        duplicate-grant no-op path). Mirrors the clean half of
+        _release_lease without touching the lease table."""
+        handle.lease_id = None
+        handle.job_id = None
+        if (
+            handle.actor_id is None
+            and handle.worker_id in self.workers
+            and handle.conn is not None
+            and not handle.conn.closed
+        ):
+            handle.idle_since = time.monotonic()
+            self.idle_workers.append(handle)
+        else:
+            self._kill_worker_proc(handle)
 
     def _free_lease_resources(self, handle: WorkerHandle) -> None:
         demand = getattr(handle, "demand", None)
@@ -1300,6 +1471,7 @@ class Raylet:
 
     def _release_lease(self, lease_id: str, dirty: bool) -> Optional[WorkerHandle]:
         handle = self.leases.pop(lease_id, None)
+        self._mark_lease_released(lease_id)
         if handle is None:
             return None
         handle.lease_id = None
@@ -1342,21 +1514,45 @@ class Raylet:
         )
         if not demand.is_subset_of(self.total):
             return {"granted": False}
-        req = LeaseRequest("actor:" + spec["actor_id"], demand, p)
-        self.pending_leases.append(req)
-        self._try_grant_leases()
-        reply = await req.fut
-        if not reply.get("granted"):
-            return reply
-        handle = self.leases[req.lease_id]
-        handle.actor_id = spec["actor_id"]
-        handle.job_id = spec.get("job_id")
+        lease_id = "actor:" + spec["actor_id"]
+        if lease_id in self.actor_creations_in_flight:
+            # A wire-duplicated/retried placement racing the original: the
+            # first grant (and its CreateActor) owns the worker — mirror its
+            # outcome rather than double-granting.
+            return await self._duplicate_lease_reply(lease_id)
+        if self._is_duplicate_grant(lease_id):
+            # No creation in flight, yet the id has a live lease: this is a
+            # GCS-driven RE-placement (restart FSM, or post-failover
+            # reconciliation that declared our node dead), not a duplicate
+            # frame. The new placement is authoritative — reclaim the stale
+            # instance and grant fresh. Detach actor_id first so reaping the
+            # old proc isn't reported as an actor death (it moved, it didn't
+            # die — a report would trigger a spurious second restart).
+            stale = self.leases.get(lease_id)
+            if stale is not None:
+                stale.actor_id = None
+                self._release_lease(lease_id, dirty=True)
+            else:
+                self._burn_lease_id(lease_id)
+        self.actor_creations_in_flight.add(lease_id)
         try:
-            await handle.conn.call("CreateActor", {"spec": spec}, timeout=300)
-        except rpc.RpcError as e:
-            self._release_lease(req.lease_id, dirty=True)
-            return {"granted": False, "error": str(e)}
-        return {"granted": True, "worker_id": handle.worker_id}
+            req = LeaseRequest(lease_id, demand, p)
+            self.pending_leases.append(req)
+            self._try_grant_leases()
+            reply = await req.fut
+            if not reply.get("granted"):
+                return reply
+            handle = self.leases[req.lease_id]
+            handle.actor_id = spec["actor_id"]
+            handle.job_id = spec.get("job_id")
+            try:
+                await handle.conn.call("CreateActor", {"spec": spec}, timeout=300)
+            except rpc.RpcError as e:
+                self._release_lease(req.lease_id, dirty=True)
+                return {"granted": False, "error": str(e)}
+            return {"granted": True, "worker_id": handle.worker_id}
+        finally:
+            self.actor_creations_in_flight.discard(lease_id)
 
     async def _kill_worker(self, conn, p):
         handle = self.workers.get(p["worker_id"])
@@ -1978,18 +2174,58 @@ class Raylet:
         pull_size = int(probe_meta.get("size", 0))
         await self.pull_manager.acquire(pull_size, p.get("purpose", "get"))
         try:
-            try:
-                await remote.call(
-                    "PushObject", {"oid": oid, "to": list(self.addr)}, timeout=120
-                )
-                got = await self._obj_get(
-                    conn, {"oids": [oid], "block": True, "timeout": 30}
-                )
-                found = got["found"].get(oid)
-                if found is not None:
-                    return found  # _obj_get already holds it for this conn
-            except (rpc.RpcError, asyncio.TimeoutError, OSError) as e:
-                logger.debug("push-based pull of %s failed (%s); falling back", oid[:12], e)
+            def _recv_progress():
+                st = self.push_assembly.get(oid)
+                # Track the assembly's byte counter; before PushStart lands
+                # (or after a seal removed the entry) report a sentinel so
+                # only a *stuck mid-assembly* counter reads as no-progress.
+                return -1 if st is None else st["recv"]
+
+            def _sealed():
+                info = self.store.lookup(oid)
+                return info is not None and info[2] and oid not in self.condemned
+
+            rerequests = 0
+            while True:
+                try:
+                    await remote.call(
+                        "PushObject", {"oid": oid, "to": list(self.addr)}, timeout=120
+                    )
+                    # Supervise the one-way chunk stream: a stream that stops
+                    # mid-assembly (source death, chunk loss) is aborted and
+                    # re-requested instead of riding out the blocking-get
+                    # timeout + the 60s assembly janitor.
+                    await self.pull_manager.watch_stream(
+                        _recv_progress, _sealed, timeout=30
+                    )
+                    got = await self._obj_get(
+                        conn, {"oids": [oid], "block": True, "timeout": 5}
+                    )
+                    found = got["found"].get(oid)
+                    if found is not None:
+                        return found  # _obj_get already holds it for this conn
+                    break  # sealed then deleted underneath us: fall back
+                except PullStalled as e:
+                    self._abort_push_assembly(oid)
+                    if rerequests >= self.pull_manager.max_rerequests:
+                        logger.warning(
+                            "push stream for %s stalled %d times (%s); "
+                            "falling back to chunk pull",
+                            oid[:12], rerequests + 1, e,
+                        )
+                        break
+                    rerequests += 1
+                    self.pull_manager.rerequested_streams += 1
+                    logger.info(
+                        "push stream for %s stalled (%s); re-requesting "
+                        "(%d/%d)",
+                        oid[:12], e, rerequests, self.pull_manager.max_rerequests,
+                    )
+                except (rpc.RpcError, asyncio.TimeoutError, OSError) as e:
+                    logger.debug(
+                        "push-based pull of %s failed (%s); falling back", oid[:12], e
+                    )
+                    break
             # block briefly: the owner's seal may still be in flight on its
             # raylet connection (puts seal via one-way push).
             reply = await remote.call(

@@ -68,6 +68,27 @@ _KIND_PUSH = 3
 
 _MAX_FRAME = 1 << 31
 
+# Fault-injection hook (ray_tpu.chaos): when set, every outbound frame from
+# this process is offered to the interceptor BEFORE packing. The interceptor
+# returns True to consume the frame (drop it, or re-deliver it later /
+# duplicated / reordered via ``Connection._send_direct``) and False to let it
+# flow normally. One module-global — not per-Connection — so a chaos schedule
+# covers every link in the process (GCS, raylets, driver core) without the
+# daemons knowing chaos exists. None (the default) costs one global read per
+# frame on the hot path. Loop thread only, like every send.
+_send_interceptor: Optional[Callable[["Connection", list], bool]] = None
+
+
+def set_send_interceptor(fn: Optional[Callable[["Connection", list], bool]]) -> None:
+    """Install (or clear, with None) the process-wide outbound-frame
+    interceptor. Test/chaos tooling only; never used in production paths."""
+    global _send_interceptor
+    _send_interceptor = fn
+
+
+def get_send_interceptor() -> Optional[Callable[["Connection", list], bool]]:
+    return _send_interceptor
+
 
 # Sentinel error string delivered to call_cb callbacks on connection loss
 # (distinguishes transport death from a handler-level error reply).
@@ -179,6 +200,19 @@ class Connection:
     def _send_nowait(self, msg) -> None:
         if self._closed:
             raise ConnectionLost("connection closed")
+        if _send_interceptor is not None and _send_interceptor(self, msg):
+            return  # consumed by fault injection (dropped/held/delayed)
+        self._out.append(_packb(msg))
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            self._loop.call_soon(self._flush)
+
+    def _send_direct(self, msg) -> None:
+        """Enqueue a frame bypassing the interceptor: the delivery half of a
+        delayed/duplicated/reordered fault. No-op on a closed connection (a
+        delay timer may outlive the link)."""
+        if self._closed:
+            return
         self._out.append(_packb(msg))
         if not self._flush_scheduled:
             self._flush_scheduled = True

@@ -1,0 +1,5 @@
+import sys
+
+from ray_tpu.chaos.runner import main
+
+sys.exit(main())

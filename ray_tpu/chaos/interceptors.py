@@ -1,0 +1,149 @@
+"""RPC-layer fault interceptor.
+
+Installs on :mod:`ray_tpu._private.rpc`'s process-wide send hook
+(``rpc.set_send_interceptor``) and applies a :class:`FaultSchedule` to every
+outbound frame from this process — GCS, raylets, and the driver core all
+share the hook, so one schedule can delay control-plane calls, drop one-way
+``PushChunk`` frames mid-object-transfer, duplicate a lease request, or swap
+the order of adjacent matching frames, without any daemon knowing chaos is
+installed.
+
+Scope: SEND-side only. Frames arriving from out-of-process peers (worker
+subprocesses) are not intercepted; in the in-process cluster harness that
+still covers every raylet<->raylet, raylet<->GCS and driver->anything frame.
+
+All methods run on the event-loop thread (every ``_send_nowait`` does).
+"""
+
+from __future__ import annotations
+
+import logging
+from fnmatch import fnmatch
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._private import rpc
+from ray_tpu.chaos.schedule import FaultEvent, FaultLog, FaultSchedule, FaultSpec
+
+logger = logging.getLogger(__name__)
+
+_KIND_TO_CLASS = {0: "request", 1: "reply", 2: "reply", 3: "push"}
+
+
+class ChaosInterceptor:
+    """Applies a schedule's decisions to outbound frames.
+
+    Decision semantics per matched frame:
+
+    - ``drop``     — the frame is consumed and never sent. For a one-way push
+                     that is silent loss; for a request the caller rides its
+                     timeout; for a reply the peer does.
+    - ``delay t``  — the frame is sent after ``t`` seconds via the
+                     interceptor-bypassing ``_send_direct`` (so the delayed
+                     copy is not re-faulted).
+    - ``dup``      — the frame is sent now AND once more in the same loop
+                     tick (the duplicate bypasses the interceptor).
+    - ``reorder``  — the frame is held; the NEXT frame matching the same spec
+                     is sent first, then the held one (adjacent swap). Held
+                     frames are flushed by ``flush_held`` at uninstall.
+    """
+
+    def __init__(self, schedule: FaultSchedule):
+        self.schedule = schedule
+        self.log = FaultLog()
+        self._match_counts: Dict[str, int] = {s.name: 0 for s in schedule.specs}
+        self._held: Dict[str, Tuple[rpc.Connection, list]] = {}
+        self._timers: List = []
+
+    # -- frame hook (rpc._send_nowait) --------------------------------------
+
+    def __call__(self, conn: rpc.Connection, msg: list) -> bool:
+        """Return True when the frame was consumed (rpc must not send it)."""
+        try:
+            method = msg[2]
+            frame_class = _KIND_TO_CLASS.get(msg[1], "request")
+        except Exception:
+            return False
+        spec = self._match(method, frame_class)
+        if spec is None:
+            return False
+        idx = self._match_counts[spec.name]
+        self._match_counts[spec.name] = idx + 1
+        action = self.schedule.decision(spec.name, idx)
+        if action is None:
+            return self._passthrough_reorder(spec, conn, msg)
+        self.log.record(FaultEvent(spec.name, idx, action, method, msg[1]))
+        kind = action[0]
+        if kind == "drop":
+            return True
+        if kind == "delay":
+            loop = conn._loop
+            timer = loop.call_later(action[1], conn._send_direct, msg)
+            self._timers.append(timer)
+            return True
+        if kind == "dup":
+            # One extra copy, bypassing the interceptor; the original flows
+            # normally (return False) so both land in the same flush.
+            conn._send_direct(msg)
+            return False
+        if kind == "reorder":
+            held = self._held.pop(spec.name, None)
+            if held is not None:
+                # Two holds back to back: release the older one first.
+                held[0]._send_direct(held[1])
+            self._held[spec.name] = (conn, msg)
+            return True
+        return False
+
+    def _passthrough_reorder(
+        self, spec: FaultSpec, conn: rpc.Connection, msg: list
+    ) -> bool:
+        """A non-fired match still releases a frame held by a reorder on the
+        same spec — the adjacent swap: current frame first, held frame
+        right behind it."""
+        held = self._held.pop(spec.name, None)
+        if held is None:
+            return False
+        conn._send_direct(msg)
+        held[0]._send_direct(held[1])
+        return True
+
+    def _match(self, method: str, frame_class: str) -> Optional[FaultSpec]:
+        for spec in self.schedule.specs:
+            if spec.frame not in ("any", frame_class):
+                continue
+            if fnmatch(method, spec.method):
+                return spec
+        return None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def flush_held(self) -> None:
+        """Deliver every held (reorder) frame and cancel pending delay
+        timers' bookkeeping list. Called at uninstall so no frame is lost to
+        schedule teardown (delay timers themselves still fire; _send_direct
+        no-ops on closed connections)."""
+        held, self._held = self._held, {}
+        for conn, msg in held.values():
+            conn._send_direct(msg)
+        self._timers = [t for t in self._timers if not t.cancelled()]
+
+
+def install(schedule: FaultSchedule) -> ChaosInterceptor:
+    """Install a schedule process-wide. Returns the live interceptor (its
+    ``log`` fills as faults fire). Loop thread only."""
+    if rpc.get_send_interceptor() is not None:
+        raise RuntimeError("a chaos interceptor is already installed")
+    interceptor = ChaosInterceptor(schedule)
+    rpc.set_send_interceptor(interceptor)
+    return interceptor
+
+
+def uninstall() -> Optional[ChaosInterceptor]:
+    """Remove the installed interceptor (if any), flushing held frames so
+    in-flight reorders complete. Loop thread only."""
+    interceptor = rpc.get_send_interceptor()
+    rpc.set_send_interceptor(None)
+    if isinstance(interceptor, ChaosInterceptor):
+        interceptor.flush_held()
+        return interceptor
+    return None

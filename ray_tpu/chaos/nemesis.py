@@ -1,0 +1,95 @@
+"""Process-level nemesis actions (Jepsen's nemesis, scoped to the
+in-process cluster harness): kill a worker process, kill a raylet, restart
+the GCS. Victim selection is by plan-provided pick index over a *sorted*
+candidate list so a replayed seed attacks the same victim whenever cluster
+membership at the fire point matches.
+
+Every action is something the runtime promises to survive: killed workers
+are re-leased and their tasks retried, killed raylets trigger lineage
+reconstruction on surviving nodes, a restarted GCS resumes from its
+persisted tables.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+logger = logging.getLogger(__name__)
+
+ACTIONS = ("kill_worker", "kill_raylet", "restart_gcs")
+
+
+class Nemesis:
+    """Fires plan points against a live :class:`~ray_tpu.cluster_utils.Cluster`.
+
+    ``protect_head``: the head raylet hosts the driver's object store in the
+    smoke scenarios, so kill_raylet targets non-head nodes when any exist.
+    """
+
+    def __init__(self, cluster, protect_head: bool = True):
+        self.cluster = cluster
+        self.protect_head = protect_head
+        self.actions_fired: List[str] = []
+
+    async def fire(self, action: str, pick: int) -> Optional[str]:
+        """Run one action; returns a human-readable description (or None if
+        no eligible target existed — e.g. no spawned workers yet)."""
+        if action == "kill_worker":
+            return self._kill_worker(pick)
+        if action == "kill_raylet":
+            return await self._kill_raylet(pick)
+        if action == "restart_gcs":
+            return await self._restart_gcs()
+        raise ValueError(f"unknown nemesis action {action!r}")
+
+    def _kill_worker(self, pick: int) -> Optional[str]:
+        candidates = []
+        for node_id in sorted(self.cluster.raylets):
+            raylet = self.cluster.raylets[node_id]
+            for worker_id in sorted(raylet.workers):
+                handle = raylet.workers[worker_id]
+                if handle.proc is not None and handle.proc.returncode is None:
+                    candidates.append((node_id, worker_id, handle))
+        if not candidates:
+            return None
+        node_id, worker_id, handle = candidates[pick % len(candidates)]
+        try:
+            handle.proc.kill()  # SIGKILL: no atexit, no farewell RPC
+        except ProcessLookupError:
+            return None
+        self.actions_fired.append("kill_worker")
+        logger.info("nemesis: killed worker %s on %s", worker_id[:8], node_id[:8])
+        return f"kill_worker {worker_id[:8]}@{node_id[:8]}"
+
+    async def _kill_raylet(self, pick: int) -> Optional[str]:
+        head_id = (
+            self.cluster.head_node.raylet.node_id
+            if self.cluster.head_node is not None
+            else None
+        )
+        candidates = [
+            nid
+            for nid in sorted(self.cluster.raylets)
+            if not (self.protect_head and nid == head_id)
+        ]
+        if not candidates:
+            return None
+        node_id = candidates[pick % len(candidates)]
+        raylet = self.cluster.raylets.pop(node_id)
+        await raylet.stop()
+        self.actions_fired.append("kill_raylet")
+        logger.info("nemesis: killed raylet %s", node_id[:8])
+        return f"kill_raylet {node_id[:8]}"
+
+    async def _restart_gcs(self) -> Optional[str]:
+        node = self.cluster.head_node
+        if node is None or node.gcs_server is None:
+            return None
+        await node.kill_gcs()
+        await node.restart_gcs()
+        # cluster_utils keeps its own reference for shutdown(); refresh it.
+        self.cluster.gcs_server = node.gcs_server
+        self.actions_fired.append("restart_gcs")
+        logger.info("nemesis: restarted GCS")
+        return "restart_gcs"

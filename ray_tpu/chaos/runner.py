@@ -1,0 +1,553 @@
+"""Scenario runner + ``python -m ray_tpu.chaos`` CLI.
+
+A *scenario* names a cluster shape, a workload, a set of fault specs, and
+optional nemesis actions; a *run* executes one scenario under one seed's
+:class:`FaultSchedule`, then drives the cluster to quiescence and checks the
+convergence invariants plus two functional probes (old refs still ``get``
+correctly — reconstruction allowed — and a fresh task still runs). Failing
+seeds are appended to a JSONL replay corpus; ``--replay`` re-runs them.
+
+Within one scenario the cluster is reused across seeds (boot cost is paid
+once); any seed that fails invariants gets the cluster rebuilt so one bad
+seed cannot poison the next. Scenario env overrides (chunk size, stall
+timeout) are applied before cluster boot and restored after.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ray_tpu.chaos.schedule import FaultSchedule, FaultSpec, NemesisPlan
+
+# -- workload helpers --------------------------------------------------------
+
+TRANSFER_BLOB_SIZE = 300_000  # > max_direct_call_object_size -> plasma
+
+
+def _blob(tag) -> bytes:
+    """Deterministic payload for a tag; verified bytewise after transfer."""
+    h = hashlib.sha256(repr(tag).encode()).digest()
+    return (h * (TRANSFER_BLOB_SIZE // len(h) + 1))[:TRANSFER_BLOB_SIZE]
+
+
+def _produce_blob(tag):
+    import hashlib as _hashlib
+
+    h = _hashlib.sha256(repr(tag).encode()).digest()
+    return (h * (300_000 // len(h) + 1))[:300_000]
+
+
+def _add(a, b):
+    return a + b
+
+
+# -- scenario catalog --------------------------------------------------------
+
+
+@dataclass
+class Scenario:
+    name: str
+    description: str
+    specs: List[FaultSpec]
+    workload: str  # "tasks" | "transfer"
+    steps: int = 3
+    nemesis: List[str] = field(default_factory=list)
+    remote_node: bool = False  # add a {"victim": 2} node for cross-node work
+    env: Dict[str, str] = field(default_factory=dict)
+    # Re-add a victim node at the end of a seed run if nemesis removed one.
+    repair: bool = False
+
+
+_TRANSFER_ENV = {
+    # Small chunks so one blob is many PushChunk frames; quick stall
+    # detection so dropped tails re-request within the step, not after 30s.
+    "RAY_TPU_OBJECT_CHUNK_SIZE": "32768",
+    "RAY_TPU_PULL_STALL_TIMEOUT_S": "1.0",
+    "RAY_TPU_WORKER_LEASE_IDLE_KEEP_S": "0.2",
+}
+
+_TASKS_ENV = {"RAY_TPU_WORKER_LEASE_IDLE_KEEP_S": "0.2"}
+
+
+SCENARIOS: Dict[str, Scenario] = {
+    s.name: s
+    for s in [
+        Scenario(
+            name="rpc_delay",
+            description="control-plane latency: lease requests and object "
+            "lookups delayed 5-40ms",
+            specs=[
+                FaultSpec("delay-lease", "delay", "RequestWorkerLease",
+                          frame="request", p=0.7, delay_s=(0.005, 0.04)),
+                FaultSpec("delay-objget", "delay", "ObjGet",
+                          frame="reply", p=0.5, delay_s=(0.005, 0.04)),
+            ],
+            workload="tasks",
+            env=dict(_TASKS_ENV),
+        ),
+        Scenario(
+            name="dup_lease",
+            description="wire-level duplication of RequestWorkerLease frames "
+            "(the raylet.leases write-write reproducer)",
+            specs=[
+                FaultSpec("dup-lease", "dup", "RequestWorkerLease",
+                          frame="request", p=1.0, max_fires=3),
+            ],
+            workload="tasks",
+            env=dict(_TASKS_ENV),
+        ),
+        Scenario(
+            name="chunk_loss",
+            description="one-way PushChunk loss mid object transfer; pulls "
+            "must stall-detect and re-request",
+            specs=[
+                FaultSpec("lose-chunks", "drop", "PushChunk",
+                          frame="push", p=0.25),
+            ],
+            workload="transfer",
+            remote_node=True,
+            env=dict(_TRANSFER_ENV),
+        ),
+        Scenario(
+            name="reorder_push",
+            description="adjacent PushChunk reordering; destination aborts "
+            "the corrupt assembly and the pull recovers",
+            specs=[
+                FaultSpec("swap-chunks", "reorder", "PushChunk",
+                          frame="push", p=0.15),
+            ],
+            workload="transfer",
+            remote_node=True,
+            env=dict(_TRANSFER_ENV),
+        ),
+        Scenario(
+            name="kill_worker",
+            description="SIGKILL a live worker between steps; tasks retry on "
+            "a fresh lease",
+            specs=[],
+            workload="tasks",
+            steps=4,
+            nemesis=["kill_worker"],
+            env=dict(_TASKS_ENV),
+        ),
+        Scenario(
+            name="gcs_restart",
+            description="kill + restart the GCS mid-workload; raylets "
+            "re-register and work resumes",
+            specs=[],
+            workload="tasks",
+            steps=4,
+            nemesis=["restart_gcs"],
+            env=dict(_TASKS_ENV),
+        ),
+        Scenario(
+            name="kill_raylet",
+            description="kill the node holding transferred objects; refs "
+            "recover via lineage reconstruction",
+            specs=[],
+            workload="transfer",
+            steps=3,
+            nemesis=["kill_raylet"],
+            remote_node=True,
+            repair=True,
+            env=dict(_TRANSFER_ENV),
+        ),
+    ]
+}
+
+SUITES: Dict[str, List[str]] = {
+    # Interceptor-only faults: fast, no process churn — the CI 20-seed gate.
+    "smoke": ["rpc_delay", "dup_lease", "chunk_loss", "reorder_push"],
+    # Process-level nemesis: heavier, run over fewer seeds.
+    "recovery": ["kill_worker", "gcs_restart", "kill_raylet"],
+    "full": [
+        "rpc_delay", "dup_lease", "chunk_loss", "reorder_push",
+        "kill_worker", "gcs_restart", "kill_raylet",
+    ],
+}
+
+
+# -- seed result -------------------------------------------------------------
+
+
+@dataclass
+class SeedResult:
+    scenario: str
+    seed: int
+    ok: bool
+    schedule_digest: str
+    fault_log_digest: str
+    faults_fired: int
+    violations: List[str]
+    duplicate_grants_avoided: int = 0
+    stalled_streams: int = 0
+    rerequested_streams: int = 0
+
+    def to_wire(self) -> dict:
+        return {
+            "scenario": self.scenario,
+            "seed": self.seed,
+            "ok": self.ok,
+            "schedule_digest": self.schedule_digest,
+            "fault_log_digest": self.fault_log_digest,
+            "faults_fired": self.faults_fired,
+            "violations": self.violations,
+            "duplicate_grants_avoided": self.duplicate_grants_avoided,
+            "stalled_streams": self.stalled_streams,
+            "rerequested_streams": self.rerequested_streams,
+        }
+
+
+# -- cluster/session plumbing ------------------------------------------------
+
+
+class _Session:
+    """One scenario's cluster + driver connection, reusable across seeds."""
+
+    def __init__(self, scenario: Scenario):
+        self.scenario = scenario
+        self._saved_env: Dict[str, Optional[str]] = {}
+        for k, v in scenario.env.items():
+            self._saved_env[k] = os.environ.get(k)
+            os.environ[k] = v
+        from ray_tpu.cluster_utils import Cluster
+
+        self.cluster = Cluster(
+            head_node_args={"num_cpus": 2, "num_tpus": 0}
+        )
+        if scenario.remote_node:
+            self.cluster.add_node(num_cpus=2, resources={"victim": 2})
+        self.cluster.connect()
+        import ray_tpu
+        from ray_tpu._private import worker as worker_mod
+
+        self.ray = ray_tpu
+        self.w = worker_mod.global_worker
+        self.add = ray_tpu.remote(max_retries=3)(_add)
+        self.produce = ray_tpu.remote(
+            max_retries=3, resources={"victim": 1} if scenario.remote_node else None
+        )(_produce_blob)
+
+    def run_async(self, coro, timeout=60):
+        return self.w.run_async(coro, timeout=timeout)
+
+    def repair_victim_node(self) -> None:
+        have_victim = any(
+            "victim" in r.total.to_dict() for r in self.cluster.raylets.values()
+        )
+        if not have_victim:
+            self.cluster.add_node(num_cpus=2, resources={"victim": 2})
+
+    def close(self) -> None:
+        try:
+            self.cluster.shutdown()
+        finally:
+            for k, old in self._saved_env.items():
+                if old is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = old
+
+
+# -- the seed loop -----------------------------------------------------------
+
+
+def run_seed(session: _Session, scenario: Scenario, seed: int,
+             verbose: bool = False) -> SeedResult:
+    from ray_tpu.chaos import interceptors, invariants
+    from ray_tpu.chaos.nemesis import Nemesis
+
+    schedule = FaultSchedule(seed, scenario.specs)
+    plan = NemesisPlan(seed, scenario.nemesis, scenario.steps)
+    nemesis = Nemesis(session.cluster)
+    violations: List[str] = []
+    probe_refs = []  # (ref, expected_digest)
+
+    async def _install():
+        # Start from a drained cluster (the previous seed's probe lease may
+        # still be warm): every seed then re-requests leases and re-transfers
+        # objects, so its schedule actually sees traffic to fault.
+        await invariants.quiesce(session.cluster, timeout=15.0)
+        return interceptors.install(schedule)
+
+    async def _uninstall():
+        return interceptors.uninstall()
+
+    interceptor = session.run_async(_install(), timeout=20)
+    try:
+        for step in range(scenario.steps):
+            for action, pick in plan.at_step(step):
+                async def _fire(action=action, pick=pick):
+                    return await nemesis.fire(action, pick)
+
+                fired = session.run_async(_fire(), timeout=60)
+                if verbose and fired:
+                    print(f"      nemesis: {fired}")
+                if scenario.repair and fired:
+                    # Autoscaler analog: replace the killed node right away
+                    # so queued infeasible work and reconstruction proceed.
+                    session.repair_victim_node()
+            try:
+                if scenario.workload == "tasks":
+                    refs = [
+                        session.add.remote(seed * 1000 + step * 10 + i, i)
+                        for i in range(4)
+                    ]
+                    got = session.ray.get(refs, timeout=120)
+                    expect = [seed * 1000 + step * 10 + 2 * i for i in range(4)]
+                    if got != expect:
+                        violations.append(
+                            f"workload: step {step} returned {got}, "
+                            f"expected {expect}"
+                        )
+                else:  # transfer
+                    tag = (scenario.name, seed, step)
+                    ref = session.produce.remote(tag)
+                    data = session.ray.get(ref, timeout=120)
+                    if data != _blob(tag):
+                        violations.append(
+                            f"workload: step {step} transfer corrupt "
+                            f"({len(data)} bytes)"
+                        )
+                    probe_refs.append(
+                        (ref, hashlib.sha256(_blob(tag)).hexdigest())
+                    )
+            except Exception as e:
+                violations.append(
+                    f"workload: step {step} failed: {type(e).__name__}: {e}"
+                )
+    finally:
+        session.run_async(_uninstall())
+
+    # Belt and braces: if the in-step repair was skipped (nemesis found no
+    # target), make sure the cluster shape is whole before quiescing.
+    if scenario.repair:
+        session.repair_victim_node()
+
+    # Convergence: quiesce, then invariants, then functional probes.
+    async def _converge():
+        await invariants.quiesce(session.cluster, timeout=30.0)
+        return await invariants.check(session.cluster)
+
+    try:
+        violations.extend(str(v) for v in session.run_async(_converge(), timeout=45))
+    except Exception as e:
+        violations.append(f"convergence: {type(e).__name__}: {e}")
+
+    # Probe 1: previously transferred objects still resolve correctly
+    # (reconstruction allowed — kill_raylet relies on it).
+    for ref, digest in probe_refs:
+        try:
+            data = session.ray.get(ref, timeout=120)
+            if hashlib.sha256(data).hexdigest() != digest:
+                violations.append("probe: re-get returned corrupt bytes")
+        except Exception as e:
+            violations.append(
+                f"probe: owned object not reconstructable: "
+                f"{type(e).__name__}: {e}"
+            )
+    # Probe 2: the cluster still runs fresh work.
+    try:
+        if session.ray.get(session.add.remote(seed, 1), timeout=60) != seed + 1:
+            violations.append("probe: fresh task returned wrong value")
+    except Exception as e:
+        violations.append(f"probe: fresh task failed: {type(e).__name__}: {e}")
+
+    dup_avoided = sum(
+        r.duplicate_lease_grants_avoided for r in session.cluster.raylets.values()
+    )
+    stalled = sum(
+        r.pull_manager.stalled_streams for r in session.cluster.raylets.values()
+    )
+    rereq = sum(
+        r.pull_manager.rerequested_streams
+        for r in session.cluster.raylets.values()
+    )
+    return SeedResult(
+        scenario=scenario.name,
+        seed=seed,
+        ok=not violations,
+        schedule_digest=schedule.digest(),
+        fault_log_digest=interceptor.log.digest(),
+        faults_fired=interceptor.log.count(),
+        violations=violations,
+        duplicate_grants_avoided=dup_avoided,
+        stalled_streams=stalled,
+        rerequested_streams=rereq,
+    )
+
+
+def run_scenario(scenario: Scenario, seeds: List[int], corpus: Optional[str],
+                 verbose: bool = False) -> List[SeedResult]:
+    results: List[SeedResult] = []
+    session = _Session(scenario)
+    try:
+        for seed in seeds:
+            result = run_seed(session, scenario, seed, verbose=verbose)
+            results.append(result)
+            status = "ok" if result.ok else "FAIL"
+            print(
+                f"    seed {seed:>4} {status}  faults={result.faults_fired}"
+                f"  schedule={result.schedule_digest[:12]}"
+            )
+            if not result.ok:
+                for v in result.violations:
+                    print(f"      {v}")
+                if corpus:
+                    _append_corpus(corpus, result)
+                # One bad seed must not poison the next: fresh cluster.
+                session.close()
+                session = _Session(scenario)
+    finally:
+        session.close()
+    return results
+
+
+def _append_corpus(path: str, result: SeedResult) -> None:
+    with open(path, "a") as f:
+        f.write(json.dumps(result.to_wire(), sort_keys=True) + "\n")
+
+
+def _load_corpus(path: str) -> List[dict]:
+    entries = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                entries.append(json.loads(line))
+    return entries
+
+
+# -- determinism gate --------------------------------------------------------
+
+
+def check_determinism(names: List[str], seeds: List[int]) -> int:
+    """Rebuild every (scenario, seed) schedule twice and compare bytes; the
+    CI proof that replaying a seed reproduces the identical fault plan."""
+    failures = 0
+    for name in names:
+        scenario = SCENARIOS[name]
+        for seed in seeds:
+            a = FaultSchedule(seed, scenario.specs)
+            b = FaultSchedule(seed, scenario.specs)
+            pa = NemesisPlan(seed, scenario.nemesis, scenario.steps)
+            pb = NemesisPlan(seed, scenario.nemesis, scenario.steps)
+            same = a.to_bytes() == b.to_bytes() and pa.to_wire() == pb.to_wire()
+            if not same:
+                failures += 1
+                print(f"  {name} seed {seed}: NON-DETERMINISTIC SCHEDULE")
+            else:
+                print(f"  {name} seed {seed}: {a.digest()[:16]} deterministic")
+    return failures
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m ray_tpu.chaos",
+        description="deterministic seeded fault injection with convergence "
+        "invariants",
+    )
+    parser.add_argument("--suite", choices=sorted(SUITES), default=None,
+                        help="named scenario suite")
+    parser.add_argument("--scenario", action="append", default=None,
+                        help="individual scenario (repeatable)")
+    parser.add_argument("--seeds", type=int, default=5,
+                        help="number of consecutive seeds (default 5)")
+    parser.add_argument("--seed-base", type=int, default=0,
+                        help="first seed (default 0)")
+    parser.add_argument("--seed", action="append", type=int, default=None,
+                        help="explicit seed (repeatable; overrides --seeds)")
+    parser.add_argument("--corpus", default="chaos_corpus.jsonl",
+                        help="JSONL replay corpus for failing seeds")
+    parser.add_argument("--no-corpus", action="store_true",
+                        help="do not record failing seeds")
+    parser.add_argument("--replay", metavar="PATH",
+                        help="re-run the (scenario, seed) entries of a corpus")
+    parser.add_argument("--check-determinism", action="store_true",
+                        help="only verify seed -> schedule determinism "
+                        "(no cluster)")
+    parser.add_argument("--list", action="store_true",
+                        help="list scenarios and suites")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list:
+        print("scenarios:")
+        for name in sorted(SCENARIOS):
+            s = SCENARIOS[name]
+            nem = f" nemesis={','.join(s.nemesis)}" if s.nemesis else ""
+            print(f"  {name:<14} {s.description}{nem}")
+        print("suites:")
+        for name in sorted(SUITES):
+            print(f"  {name:<14} {' '.join(SUITES[name])}")
+        return 0
+
+    if args.replay:
+        entries = _load_corpus(args.replay)
+        if not entries:
+            print(f"replay corpus {args.replay} is empty")
+            return 0
+        pairs = [(e["scenario"], e["seed"]) for e in entries]
+        names = sorted({s for s, _ in pairs})
+        rc = 0
+        for name in names:
+            scenario = SCENARIOS[name]
+            seeds = sorted({seed for s, seed in pairs if s == name})
+            print(f"  replay {name} seeds {seeds}")
+            results = run_scenario(scenario, seeds, corpus=None,
+                                   verbose=args.verbose)
+            rc |= int(any(not r.ok for r in results))
+        return rc
+
+    names = list(args.scenario or [])
+    if args.suite:
+        names.extend(SUITES[args.suite])
+    if not names:
+        names = SUITES["smoke"]
+    unknown = [n for n in names if n not in SCENARIOS]
+    if unknown:
+        print(f"unknown scenario(s): {unknown}; see --list")
+        return 2
+    seeds = args.seed if args.seed else list(
+        range(args.seed_base, args.seed_base + args.seeds)
+    )
+
+    if args.check_determinism:
+        failures = check_determinism(names, seeds)
+        print(
+            "determinism: "
+            + ("FAILED" if failures else f"ok ({len(names) * len(seeds)} schedules)")
+        )
+        return 1 if failures else 0
+
+    corpus = None if args.no_corpus else args.corpus
+    total_fail = 0
+    for name in names:
+        scenario = SCENARIOS[name]
+        print(f"chaos scenario {name}: {scenario.description}")
+        results = run_scenario(scenario, seeds, corpus, verbose=args.verbose)
+        failed = [r for r in results if not r.ok]
+        total_fail += len(failed)
+        print(
+            f"  {name}: {len(results) - len(failed)}/{len(results)} seeds "
+            "converged"
+        )
+    if total_fail:
+        print(f"chaos: {total_fail} failing seed(s)"
+              + (f" recorded to {corpus}" if corpus else ""))
+        return 1
+    print("chaos: all seeds converged; every invariant held")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

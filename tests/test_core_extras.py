@@ -281,6 +281,7 @@ def test_pull_manager_priority_and_quota():
         pm.release(1000)
         assert pm.stats() == {
             "bytes_in_flight": 0, "active_pulls": 0, "queued_pulls": 0,
+            "stalled_streams": 0, "rerequested_streams": 0,
         }
 
     asyncio.run(scenario())

@@ -4,7 +4,7 @@
 PYTHON ?= python
 SANITIZER ?= address
 
-.PHONY: lint test sanitize wire-docs build chaos
+.PHONY: lint test sanitize wire-docs protocols build chaos
 
 lint:
 	$(PYTHON) -m ray_tpu.devtools.lint
@@ -37,6 +37,11 @@ sanitize:
 
 wire-docs:
 	$(PYTHON) -m ray_tpu.devtools.rpc_check --markdown > docs/wire_protocol.md
+
+# Regenerate the FSM reference from the machine-readable spec; CI fails if
+# the checked-in copy is stale.
+protocols:
+	$(PYTHON) -m ray_tpu.devtools.protocols --markdown > docs/protocols.md
 
 # Deterministic fault injection (docs/chaos.md). SEEDS seeds per scenario;
 # failing seeds land in chaos_corpus.jsonl for replay.

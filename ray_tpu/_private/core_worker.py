@@ -2115,16 +2115,14 @@ class CoreWorker:
         strategy = wire.get("scheduling_strategy")
         lease = await self.lease_pool.acquire(resources, pg_id, bundle_index, strategy)
         dirty = False
-        entry = self._inflight_tasks.get(wire["task_id"])
-        if entry is not None:
-            if entry["cancelled"]:
-                # Cancellation landed while we were queued for a lease.
-                await self.lease_pool.release(
-                    lease, resources, pg_id, bundle_index, strategy=strategy
-                )
-                raise TaskCancelledError(f"task {wire['name']} was cancelled")
-            entry["conn"] = lease.conn
+        entry = None
         try:
+            entry = self._inflight_tasks.get(wire["task_id"])
+            if entry is not None:
+                if entry["cancelled"]:
+                    # Cancellation landed while we were queued for a lease.
+                    raise TaskCancelledError(f"task {wire['name']} was cancelled")
+                entry["conn"] = lease.conn
             self.record_task_event(wire["task_id"], wire["name"], "RUNNING")
             return await lease.conn.call("PushTask", {"spec": wire}, timeout=None)
         except rpc.ConnectionLost:

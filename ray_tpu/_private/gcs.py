@@ -30,12 +30,27 @@ from ray_tpu._private.common import PlacementGroupSpec, ResourceSet, config
 
 logger = logging.getLogger(__name__)
 
-# Actor FSM states (reference: gcs_actor_manager.cc).
+# Actor FSM states (reference: gcs_actor_manager.cc). The legal transitions
+# are declared machine-readably in ray_tpu/devtools/protocols.py and every
+# assignment is checked against them at lint time.
 DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
 PENDING_CREATION = "PENDING_CREATION"
 ALIVE = "ALIVE"
 RESTARTING = "RESTARTING"
 DEAD = "DEAD"
+
+# Node FSM states (reference: gcs_node_manager.cc). Same wire strings as the
+# actor ALIVE/DEAD, but a separate two-state machine — keep distinct names so
+# the protocol checker can tell the machines apart.
+NODE_ALIVE = "ALIVE"
+NODE_DEAD = "DEAD"
+
+# Placement-group FSM states (reference: gcs_placement_group_mgr.cc).
+PG_PENDING = "PENDING"
+PG_CREATED = "CREATED"
+PG_RESCHEDULING = "RESCHEDULING"
+PG_REMOVED = "REMOVED"
+PG_INFEASIBLE = "INFEASIBLE"
 
 
 class NodeInfo:
@@ -46,7 +61,7 @@ class NodeInfo:
         self.available = dict(resources)
         self.labels = labels or {}
         self.conn: rpc.Connection = conn
-        self.state = "ALIVE"
+        self.state = NODE_ALIVE
         self.last_seen = time.monotonic()
         # Health-check manager state (reference: gcs_health_check_manager.cc).
         self.health_misses = 0
@@ -104,7 +119,7 @@ class ActorInfo:
 class PlacementGroupInfo:
     def __init__(self, spec: PlacementGroupSpec):
         self.spec = spec
-        self.state = "PENDING"  # PENDING | CREATED | REMOVED | RESCHEDULING
+        self.state = PG_PENDING
         self.bundle_nodes: List[Optional[str]] = [None] * len(spec.bundles)
         self.pending: List[asyncio.Future] = []
 
@@ -219,7 +234,9 @@ class GcsServer:
         for actor_id, blob in self.store.get_all("actors").items():
             rec = msgpack.unpackb(blob, raw=False)
             actor = ActorInfo(actor_id, rec["spec"])
-            actor.state = rec["state"]
+            # Restart restore: the persisted state was validated as a legal
+            # FSM state when it was written, not re-derivable statically.
+            actor.state = rec["state"]  # protocol: disable=protocol-unresolvable
             actor.addr = tuple(rec["addr"]) if rec.get("addr") else None
             actor.worker_id = rec.get("worker_id")
             actor.node_id = rec.get("node_id")
@@ -232,7 +249,8 @@ class GcsServer:
         for pg_id, blob in self.store.get_all("pgs").items():
             rec = msgpack.unpackb(blob, raw=False)
             pg = PlacementGroupInfo(PlacementGroupSpec.from_wire(rec["spec"]))
-            pg.state = rec["state"]
+            # Restart restore (see actor restore above).
+            pg.state = rec["state"]  # protocol: disable=protocol-unresolvable
             pg.bundle_nodes = rec.get("bundle_nodes") or pg.bundle_nodes
             self.placement_groups[pg_id] = pg
         if self._pending_actor_queue:
@@ -250,7 +268,7 @@ class GcsServer:
         # scheduling loop, and actors recorded ALIVE are reconciled against
         # the nodes that actually re-register.
         for pg in self.placement_groups.values():
-            if pg.state in ("PENDING", "RESCHEDULING"):
+            if pg.state in (PG_PENDING, PG_RESCHEDULING):
                 self._spawn(self._schedule_pg(pg))
         if any(a.state == ALIVE for a in self.actors.values()):
             self._spawn(self._reconcile_restored_actors())
@@ -268,7 +286,7 @@ class GcsServer:
         while True:
             await asyncio.sleep(config.health_check_period_s)
             for node in list(self.nodes.values()):
-                if node.state != "ALIVE" or node.health_probe_inflight:
+                if node.state != NODE_ALIVE or node.health_probe_inflight:
                     continue
                 node.health_probe_inflight = True
                 rpc.spawn(self._probe_node(node))
@@ -290,7 +308,7 @@ class GcsServer:
             )
             if (
                 node.health_misses >= config.health_check_failure_threshold
-                and node.state == "ALIVE"
+                and node.state == NODE_ALIVE
             ):
                 logger.error(
                     "node %s failed %d consecutive health checks: marking DEAD",
@@ -318,7 +336,7 @@ class GcsServer:
             if actor.state != ALIVE:
                 continue
             node = self.nodes.get(actor.node_id) if actor.node_id else None
-            dead = node is None or node.state != "ALIVE"
+            dead = node is None or node.state != NODE_ALIVE
             if not dead and actor.addr:
                 try:
                     conn = node.conn
@@ -465,9 +483,9 @@ class GcsServer:
 
     async def _handle_node_death(self, node_id: str, graceful: bool = False) -> None:
         node = self.nodes.get(node_id)
-        if node is None or node.state == "DEAD":
+        if node is None or node.state == NODE_DEAD:
             return
-        node.state = "DEAD"
+        node.state = NODE_DEAD
         if graceful:
             logger.info("node %s unregistered (graceful shutdown)", node_id[:8])
         else:
@@ -487,8 +505,8 @@ class GcsServer:
                 await self._on_actor_worker_death(actor, f"node {node_id[:8]} died")
         # PGs with bundles there go back to pending.
         for pg in self.placement_groups.values():
-            if pg.state == "CREATED" and node_id in pg.bundle_nodes:
-                pg.state = "RESCHEDULING"
+            if pg.state == PG_CREATED and node_id in pg.bundle_nodes:
+                pg.state = PG_RESCHEDULING
                 self._spawn(self._schedule_pg(pg))
 
     # -- actor FSM ----------------------------------------------------------
@@ -587,7 +605,7 @@ class GcsServer:
     async def _try_place_actor(self, actor: ActorInfo) -> bool:
         demand = ResourceSet.from_units(actor.spec.get("resources") or {})
         strategy = actor.spec.get("scheduling_strategy") or {}
-        candidates = [n for n in self.nodes.values() if n.state == "ALIVE"]
+        candidates = [n for n in self.nodes.values() if n.state == NODE_ALIVE]
         if strategy.get("node_id"):
             candidates = [n for n in candidates if n.node_id == strategy["node_id"]]
         labels = strategy.get("labels")
@@ -608,7 +626,7 @@ class GcsServer:
                 candidates = preferred or candidates
         if actor.spec.get("pg_id"):
             pg = self.placement_groups.get(actor.spec["pg_id"])
-            if pg is None or pg.state != "CREATED":
+            if pg is None or pg.state != PG_CREATED:
                 return False
             idx = actor.spec.get("bundle_index", -1)
             nodes_ok = set(
@@ -779,7 +797,7 @@ class GcsServer:
             actor.max_restarts = actor.num_restarts  # exhaust restarts
             self._persist_actor(actor)
         node = self.nodes.get(actor.node_id) if actor.node_id else None
-        if node is not None and node.state == "ALIVE" and actor.worker_id:
+        if node is not None and node.state == NODE_ALIVE and actor.worker_id:
             try:
                 await node.conn.call(
                     "KillWorker", {"worker_id": actor.worker_id, "force": True}, timeout=10
@@ -885,17 +903,17 @@ class GcsServer:
     async def _schedule_pg(self, pg: PlacementGroupInfo) -> None:
         spec = pg.spec
         deadline = time.monotonic() + 120
-        while pg.state in ("PENDING", "RESCHEDULING"):
+        while pg.state in (PG_PENDING, PG_RESCHEDULING):
             placement = self._place_bundles(spec)
             if placement is not None:
                 ok = await self._try_commit_pg(pg, placement)
-                if pg.state == "REMOVED":
+                if pg.state == PG_REMOVED:
                     # Removed while the 2PC was in flight: drop the fresh
                     # reservations instead of resurrecting the PG.
                     if ok:
                         for nid in set(placement):
                             node = self.nodes.get(nid)
-                            if node and node.state == "ALIVE":
+                            if node and node.state == NODE_ALIVE:
                                 try:
                                     await node.conn.call(
                                         "ReleasePGBundles",
@@ -905,23 +923,23 @@ class GcsServer:
                                     pass
                     return
                 if ok:
-                    pg.state = "CREATED"
+                    pg.state = PG_CREATED
                     pg.bundle_nodes = placement
                     self._persist_pg(pg)
                     for fut in pg.pending:
                         if not fut.done():
-                            fut.set_result({"pg_id": spec.pg_id, "state": "CREATED"})
+                            fut.set_result({"pg_id": spec.pg_id, "state": PG_CREATED})
                     pg.pending.clear()
-                    self._publish_msg(f"pg:{spec.pg_id}", {"state": "CREATED"})
+                    self._publish_msg(f"pg:{spec.pg_id}", {"state": PG_CREATED})
                     self._wake_scheduler.set()
                     return
             if time.monotonic() > deadline:
                 break
             await asyncio.sleep(0.2)
-        if pg.state in ("PENDING", "RESCHEDULING"):
+        if pg.state in (PG_PENDING, PG_RESCHEDULING):
             # Record terminal state so later WaitPlacementGroupReady calls
             # fail fast instead of parking a future nothing will resolve.
-            pg.state = "INFEASIBLE"
+            pg.state = PG_INFEASIBLE
             self._persist_pg(pg)
             for fut in pg.pending:
                 if not fut.done():
@@ -933,7 +951,7 @@ class GcsServer:
     def _place_bundles(self, spec: PlacementGroupSpec) -> Optional[List[str]]:
         """Map bundles to nodes per strategy against the current resource view.
         Reference: bundle_scheduling_policy.cc (PACK/SPREAD/STRICT_*)."""
-        alive = [n for n in self.nodes.values() if n.state == "ALIVE"]
+        alive = [n for n in self.nodes.values() if n.state == NODE_ALIVE]
         if not alive:
             return None
         avail = {n.node_id: ResourceSet.from_units(n.available) for n in alive}
@@ -989,7 +1007,7 @@ class GcsServer:
         prepared: List[str] = []
         for nid, idxs in by_node.items():
             node = self.nodes.get(nid)
-            if node is None or node.state != "ALIVE":
+            if node is None or node.state != NODE_ALIVE:
                 break
             try:
                 reply = await node.conn.call(
@@ -1030,12 +1048,12 @@ class GcsServer:
         pg = self.placement_groups.get(p["pg_id"])
         if pg is None:
             raise rpc.RpcError(f"unknown placement group {p['pg_id'][:12]}")
-        if pg.state == "CREATED":
-            return {"pg_id": p["pg_id"], "state": "CREATED"}
-        if pg.state == "REMOVED":
+        if pg.state == PG_CREATED:
+            return {"pg_id": p["pg_id"], "state": PG_CREATED}
+        if pg.state == PG_REMOVED:
             raise rpc.RpcError("placement group was removed")
-        if pg.state == "INFEASIBLE":
-            return {"pg_id": p["pg_id"], "state": "INFEASIBLE"}
+        if pg.state == PG_INFEASIBLE:
+            return {"pg_id": p["pg_id"], "state": PG_INFEASIBLE}
         fut = asyncio.get_running_loop().create_future()
         pg.pending.append(fut)
         if p.get("timeout") is not None:
@@ -1051,7 +1069,7 @@ class GcsServer:
         pg = self.placement_groups.get(p["pg_id"])
         if pg is None:
             return {"ok": False}
-        pg.state = "REMOVED"
+        pg.state = PG_REMOVED
         self._persist_pg(pg)
         # Wake any WaitPlacementGroupReady waiters parked while pending.
         for fut in pg.pending:
@@ -1060,7 +1078,7 @@ class GcsServer:
         pg.pending.clear()
         for nid in set(n for n in pg.bundle_nodes if n):
             node = self.nodes.get(nid)
-            if node and node.state == "ALIVE":
+            if node and node.state == NODE_ALIVE:
                 try:
                     await node.conn.call("ReleasePGBundles", {"pg_id": p["pg_id"]}, timeout=30)
                 except rpc.RpcError:
@@ -1109,7 +1127,7 @@ class GcsServer:
             "nodes": [n.to_wire() for n in self.nodes.values()],
             "actors": sum(1 for a in self.actors.values() if a.state == ALIVE),
             "placement_groups": sum(
-                1 for g in self.placement_groups.values() if g.state == "CREATED"
+                1 for g in self.placement_groups.values() if g.state == PG_CREATED
             ),
             "jobs": list(self.jobs.values()),
         }

@@ -73,7 +73,17 @@ class PullManager:
         # small get behind a queued oversized task_arg): admit from the head
         # immediately rather than waiting for an unrelated release().
         self._drain()
-        await fut
+        try:
+            await fut
+        except asyncio.CancelledError:
+            # Cancellation can land *after* _drain() admitted this waiter
+            # (future resolved, quota already charged) but before the task
+            # resumed; the caller will never call release(), so undo the
+            # admission here. A still-pending future means the quota was
+            # never charged — _drain() skips cancelled entries.
+            if not fut.cancelled():
+                self.release(size)
+            raise
 
     def _drain(self) -> None:
         # Admit from the head strictly in priority order (no bypass: a
@@ -92,8 +102,15 @@ class PullManager:
             fut.set_result(None)
 
     def release(self, size: int) -> None:
-        self.bytes_in_flight = max(0, self.bytes_in_flight - size)
-        self.active = max(0, self.active - 1)
+        self.bytes_in_flight -= size
+        self.active -= 1
+        # No clamping: an underflow here means a double release (or a
+        # release without a matching acquire) upstream, and clamping would
+        # silently widen the quota. Fail loudly so chaos seeds catch it.
+        assert self.bytes_in_flight >= 0 and self.active >= 0, (
+            f"pull quota underflow: bytes_in_flight={self.bytes_in_flight} "
+            f"active={self.active} after release({size})"
+        )
         self._drain()
 
     async def watch_stream(self, progress, done, timeout: float) -> None:

@@ -42,7 +42,11 @@ TRANSIENT_QUALNAMES = {
     "PushManager._do_push",
 }
 
-# GCS actor states that may legitimately persist after quiescence.
+# GCS actor states that may legitimately persist after quiescence. This set
+# must equal the actor machine's quiescent states declared in
+# ray_tpu/devtools/protocols.py — the protocol checker (part of `make lint`)
+# fails with protocol-invariant-drift if the two ever diverge, so a spec
+# change here forces the matching FSM spec/doc update and vice versa.
 TERMINAL_ACTOR_STATES = {"ALIVE", "DEAD"}
 
 

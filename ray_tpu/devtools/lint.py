@@ -1,8 +1,10 @@
 """Unified static-analysis gate: ``python -m ray_tpu.devtools.lint``.
 
-Runs the asyncio hazard linter (aio_lint) and the RPC wire cross-checker
-(rpc_check) over the package and exits non-zero on any finding. This is the
-CI lint job's entry point; ``make lint`` wraps it.
+Runs every static pass over the package and exits non-zero on any finding:
+the asyncio hazard linter (aio_lint), the RPC wire cross-checker
+(rpc_check), the paired-resource lifecycle pass (lifecycle), and the
+protocol FSM checker (protocols). This is the CI lint job's entry point;
+``make lint`` wraps it.
 """
 
 from __future__ import annotations
@@ -11,7 +13,9 @@ import argparse
 import sys
 from typing import List, Optional
 
-from ray_tpu.devtools import aio_lint, rpc_check
+from ray_tpu.devtools import aio_lint, lifecycle, protocols, rpc_check
+
+_PASSES = "aio-lint + rpc-check + lifecycle + protocols"
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -25,13 +29,15 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     findings = list(aio_lint.lint_paths(paths))
     findings.extend(rpc_check.check(paths))
+    findings.extend(lifecycle.lint_paths(paths))
+    findings.extend(protocols.check(paths))
     findings.sort(key=lambda f: (f.path, f.line, f.col))
     for f in findings:
         print(f)
     if findings:
-        print(f"lint: {len(findings)} finding(s) across aio-lint + rpc-check")
+        print(f"lint: {len(findings)} finding(s) across {_PASSES}")
         return 1
-    print("lint: clean (aio-lint + rpc-check)")
+    print(f"lint: clean ({_PASSES})")
     return 0
 
 

@@ -44,10 +44,14 @@ protocols:
 	$(PYTHON) -m ray_tpu.devtools.protocols --markdown > docs/protocols.md
 
 # Deterministic fault injection (docs/chaos.md). SEEDS seeds per scenario;
-# failing seeds land in chaos_corpus.jsonl for replay.
+# failing seeds land in chaos_corpus.jsonl for replay. The latency suite
+# exercises the RPC resilience layer (docs/resilience.md) over fewer seeds.
 SEEDS ?= 20
+LATENCY_SEEDS ?= 10
 chaos:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m ray_tpu.chaos --check-determinism \
 		--suite full --seeds $(SEEDS)
 	env JAX_PLATFORMS=cpu $(PYTHON) -m ray_tpu.chaos --suite smoke \
 		--seeds $(SEEDS)
+	env JAX_PLATFORMS=cpu $(PYTHON) -m ray_tpu.chaos --suite latency \
+		--seeds $(LATENCY_SEEDS)

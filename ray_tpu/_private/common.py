@@ -138,6 +138,49 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     # How long a caller waits for a PENDING/RESTARTING actor to come up
     # before failing the call (reference: gcs_client actor resolution).
     "actor_resolve_timeout_s": 300.0,
+    # ---- RPC resilience budgets (reference: retryable_grpc_client.h +
+    # gcs_rpc_client.h; every knob below replaces a former call-site
+    # literal, enforced by the rpc-magic-timeout lint rule). ----
+    # Control-plane probes and cancels (KillWorker, CancelWorkerLease,
+    # CancelTask): quick request/reply, fail fast.
+    "rpc_control_timeout_s": 10.0,
+    # GCS-driven actor placement round trip (LeaseWorkerForActor): covers
+    # lease queueing + worker spawn + CreateActor on the worker.
+    "rpc_lease_timeout_s": 120.0,
+    # Placement-group 2PC legs (Prepare/Commit/ReleasePGBundles).
+    "rpc_pg_timeout_s": 30.0,
+    # Raylet -> worker CreateActor (cold spawn + user __init__).
+    "rpc_actor_create_timeout_s": 300.0,
+    # Whole-object push between raylets (PushObject request/reply).
+    "rpc_transfer_timeout_s": 120.0,
+    # Per-chunk / per-stream-start transfers (FetchChunk, PushStart).
+    "rpc_chunk_timeout_s": 60.0,
+    # Client -> local raylet pull of a remote object (PullObject).
+    "rpc_pull_timeout_s": 300.0,
+    # Optional per-attempt cap on the retryable GCS channel: a lost reply
+    # is re-issued (idempotent methods only) after this long instead of
+    # riding out the caller's whole budget. 0 disables (production
+    # default — the GCS channel carries long-polls like CreateActor
+    # wait_alive); the chaos latency suite enables it.
+    "rpc_default_timeout_s": 0.0,
+    # Dial backoff (rpc.connect): full-jitter exponential, total-time cap.
+    "rpc_dial_initial_backoff_s": 0.05,
+    "rpc_dial_max_backoff_s": 1.0,
+    "rpc_dial_total_s": 3.0,
+    # Call-retry backoff (RetryableConnection) and the total budget a
+    # caller waits out a GCS restart before the error surfaces.
+    "rpc_retry_initial_backoff_s": 0.05,
+    "rpc_retry_max_backoff_s": 2.0,
+    "rpc_backoff_multiplier": 2.0,
+    "rpc_reconnect_timeout_s": 30.0,
+    # Deadline enforcement slack: a handler may finish (or unwind its
+    # cancellation) this long past its wire deadline before the chaos
+    # no-call-outlives-deadline invariant flags it.
+    "rpc_deadline_grace_s": 0.5,
+    # Driver-side loop-thread bridge budgets (worker.py run_async): whole
+    # cluster bring-up, and graceful shutdown before the loop is abandoned.
+    "driver_bringup_timeout_s": 120.0,
+    "driver_shutdown_timeout_s": 30.0,
 }
 
 

@@ -2036,7 +2036,9 @@ class CoreWorker:
         if conn is not None and not conn.closed:
             try:
                 await conn.call(
-                    "CancelTask", {"task_id": task_id, "force": force}, timeout=10
+                    "CancelTask",
+                    {"task_id": task_id, "force": force},
+                    timeout=config.rpc_control_timeout_s,
                 )
             except rpc.RpcError:
                 pass

@@ -24,7 +24,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 import msgpack
 
-from ray_tpu._private import aiocheck, rpc
+from ray_tpu._private import aiocheck, rpc, wire
 from ray_tpu._private.pubsub import Publisher
 from ray_tpu._private.common import PlacementGroupSpec, ResourceSet, config
 
@@ -343,7 +343,7 @@ class GcsServer:
                     reply = await conn.call(
                         "KillWorker",
                         {"worker_id": actor.worker_id, "probe": True},
-                        timeout=10,
+                        timeout=config.rpc_control_timeout_s,
                     )
                     dead = not reply.get("alive", False)
                 except rpc.RpcError:
@@ -654,7 +654,9 @@ class GcsServer:
         node = max(available, key=lambda n: _utilization(n))
         try:
             reply = await node.conn.call(
-                "LeaseWorkerForActor", {"spec": actor.spec}, timeout=120
+                "LeaseWorkerForActor",
+                {"spec": actor.spec},
+                timeout=config.rpc_lease_timeout_s,
             )
         except (rpc.RpcError, asyncio.TimeoutError) as e:
             # On timeout the raylet may still hold the queued lease: cancel
@@ -663,7 +665,7 @@ class GcsServer:
                 await node.conn.call(
                     "CancelWorkerLease",
                     {"lease_id": "actor:" + actor.spec["actor_id"]},
-                    timeout=10,
+                    timeout=config.rpc_control_timeout_s,
                 )
             except Exception:
                 pass
@@ -800,7 +802,9 @@ class GcsServer:
         if node is not None and node.state == NODE_ALIVE and actor.worker_id:
             try:
                 await node.conn.call(
-                    "KillWorker", {"worker_id": actor.worker_id, "force": True}, timeout=10
+                    "KillWorker",
+                    {"worker_id": actor.worker_id, "force": True},
+                    timeout=config.rpc_control_timeout_s,
                 )
             except rpc.RpcError:
                 pass
@@ -917,7 +921,8 @@ class GcsServer:
                                 try:
                                     await node.conn.call(
                                         "ReleasePGBundles",
-                                        {"pg_id": spec.pg_id}, timeout=30,
+                                        {"pg_id": spec.pg_id},
+                                        timeout=config.rpc_pg_timeout_s,
                                     )
                                 except rpc.RpcError:
                                     pass
@@ -1016,7 +1021,7 @@ class GcsServer:
                         "pg_id": spec.pg_id,
                         "bundles": {str(i): spec.bundles[i] for i in idxs},
                     },
-                    timeout=30,
+                    timeout=config.rpc_pg_timeout_s,
                 )
             except rpc.RpcError:
                 break
@@ -1028,7 +1033,9 @@ class GcsServer:
             for nid in prepared:
                 try:
                     await self.nodes[nid].conn.call(
-                        "CommitPGBundles", {"pg_id": spec.pg_id}, timeout=30
+                        "CommitPGBundles",
+                        {"pg_id": spec.pg_id},
+                        timeout=config.rpc_pg_timeout_s,
                     )
                 except rpc.RpcError:
                     committed = False  # node died mid-commit: roll back all
@@ -1038,7 +1045,9 @@ class GcsServer:
         for nid in prepared:  # rollback
             try:
                 await self.nodes[nid].conn.call(
-                    "ReleasePGBundles", {"pg_id": spec.pg_id}, timeout=30
+                    "ReleasePGBundles",
+                    {"pg_id": spec.pg_id},
+                    timeout=config.rpc_pg_timeout_s,
                 )
             except rpc.RpcError:
                 pass
@@ -1080,7 +1089,11 @@ class GcsServer:
             node = self.nodes.get(nid)
             if node and node.state == NODE_ALIVE:
                 try:
-                    await node.conn.call("ReleasePGBundles", {"pg_id": p["pg_id"]}, timeout=30)
+                    await node.conn.call(
+                        "ReleasePGBundles",
+                        {"pg_id": p["pg_id"]},
+                        timeout=config.rpc_pg_timeout_s,
+                    )
                 except rpc.RpcError:
                     pass
         return {"ok": True}
@@ -1147,68 +1160,76 @@ def _utilization(node: NodeInfo) -> float:
 class GcsClient:
     """Typed async client for the GCS (used by raylets, workers, drivers).
 
-    Reconnecting: when the GCS restarts (fault-tolerance mode), calls redial
-    the same address, re-subscribe pubsub channels, and fire registered
-    ``on_reconnect`` callbacks (raylets re-register their node there).
-    Analog of the reference's reconnect protocol around GCS restarts
-    (NotifyGCSRestart, node_manager.proto:373; retryable gRPC client)."""
-
-    # How long callers wait out a GCS restart before giving up.
-    RECONNECT_TIMEOUT_S = 30.0
+    Reconnecting: when the GCS restarts (fault-tolerance mode), the
+    underlying ``rpc.RetryableConnection`` redials the same address with
+    jittered backoff (``RetryPolicy.for_calls``), re-subscribes pubsub
+    channels, fires registered ``on_reconnect`` callbacks (raylets
+    re-register their node there), and transparently retries calls whose
+    wire retry class permits it — every GCS handler is an idempotent
+    upsert/read against keyed state, so the channel's default retry class
+    is "safe". Analog of the reference's reconnect protocol around GCS
+    restarts (NotifyGCSRestart, node_manager.proto:373; retryable gRPC
+    client + gcs_rpc_client.h failover call queue)."""
 
     def __init__(self, conn: rpc.Connection):
         self.conn = conn
         self._sub_handlers: Dict[str, List] = {}
         self._handlers = conn._handlers
         self._handlers.setdefault("Pub", self._on_pub)
-        self._reconnect_lock: Optional[asyncio.Lock] = None
         self._on_reconnect: List = []
-        self._closed = False
+        self._rc = rpc.RetryableConnection(
+            self._redial,
+            conn=conn,
+            policy=rpc.RetryPolicy.for_calls(),
+            default_retry=wire.RETRY_SAFE,
+            on_reconnect=self._post_reconnect,
+            name="gcs",
+        )
 
     def on_reconnect(self, fn) -> None:
         """Register ``async fn(client)`` run after every successful redial."""
         self._on_reconnect.append(fn)
 
+    @property
+    def _closed(self) -> bool:
+        return self._rc.closed
+
     async def close(self) -> None:
         """Terminal close: no reconnection afterwards. A stopping raylet must
         call this first, or a straggler RPC resurrects the 'dead' node in the
         GCS by re-registering through the reconnect path."""
-        self._closed = True
-        await self.conn.close()
+        await self._rc.close()
+
+    async def _redial(self) -> rpc.Connection:
+        addr = self.conn.remote_addr or self.conn.peername
+        if addr is None:
+            raise rpc.ConnectionLost("gcs connection lost (no address to redial)")
+        conn = await rpc.connect(
+            addr[0],
+            addr[1],
+            handlers=self._handlers,
+            policy=rpc.RetryPolicy.for_calls(),
+        )
+        conn.remote_addr = tuple(addr)
+        return conn
+
+    async def _post_reconnect(self, conn: rpc.Connection) -> None:
+        # self.conn must point at the fresh link before the callbacks run:
+        # they issue calls through this client (raylet re-registration).
+        self.conn = conn
+        for channel in self._sub_handlers:
+            await conn.call("Subscribe", {"channel": channel})
+        for fn in self._on_reconnect:
+            try:
+                await fn(self)
+            except Exception:
+                logger.exception("gcs on_reconnect callback failed")
+        addr = conn.remote_addr or conn.peername
+        if addr is not None:
+            logger.info("reconnected to gcs at %s:%s", *addr)
 
     async def _ensure_connected(self) -> rpc.Connection:
-        if self._closed:
-            raise rpc.ConnectionLost("gcs client closed")
-        if not self.conn.closed:
-            return self.conn
-        if self._reconnect_lock is None:
-            self._reconnect_lock = asyncio.Lock()
-        async with self._reconnect_lock:
-            if self._closed:
-                raise rpc.ConnectionLost("gcs client closed")
-            if not self.conn.closed:
-                return self.conn
-            addr = self.conn.remote_addr or self.conn.peername
-            if addr is None:
-                raise rpc.ConnectionLost("gcs connection lost (no address to redial)")
-            conn = await rpc.connect(
-                addr[0],
-                addr[1],
-                handlers=self._handlers,
-                retry=int(self.RECONNECT_TIMEOUT_S / 0.25),
-                retry_interval=0.25,
-            )
-            conn.remote_addr = tuple(addr)
-            self.conn = conn
-            for channel in self._sub_handlers:
-                await conn.call("Subscribe", {"channel": channel})
-            for fn in self._on_reconnect:
-                try:
-                    await fn(self)
-                except Exception:
-                    logger.exception("gcs on_reconnect callback failed")
-            logger.info("reconnected to gcs at %s:%s", *addr)
-            return conn
+        return await self._rc._ensure_connected()
 
     async def _on_pub(self, conn, p):
         for fn in self._sub_handlers.get(p["channel"], []):
@@ -1248,11 +1269,4 @@ class GcsClient:
         return (await self.call("KVKeys", {"ns": ns, "prefix": prefix}))["keys"]
 
     async def call(self, method: str, payload=None, timeout=None):
-        conn = await self._ensure_connected()
-        try:
-            return await conn.call(method, payload, timeout)
-        except rpc.ConnectionLost:
-            # One transparent retry across a GCS restart. Safe: every GCS
-            # handler is an idempotent upsert/read against keyed state.
-            conn = await self._ensure_connected()
-            return await conn.call(method, payload, timeout)
+        return await self._rc.call(method, payload, timeout)

@@ -152,7 +152,7 @@ class PlasmaClient:
         meta = await self.conn.call(
             "PullObject",
             {"oid": oid, "from_addr": list(from_addr), "purpose": purpose},
-            timeout=300,
+            timeout=config.rpc_pull_timeout_s,
         )
         if meta.get("offset") is not None:
             self.held[oid] = self.held.get(oid, 0) + 1

@@ -81,7 +81,9 @@ class PushManager:
         try:
             conn = await self._get_conn(dest)
             start = await conn.call(
-                "PushStart", {"oid": oid, "size": size}, timeout=60
+                "PushStart",
+                {"oid": oid, "size": size},
+                timeout=config.rpc_chunk_timeout_s,
             )
             if not start.get("needed"):
                 return  # destination already has (or is assembling) it

@@ -1546,7 +1546,11 @@ class Raylet:
             handle.actor_id = spec["actor_id"]
             handle.job_id = spec.get("job_id")
             try:
-                await handle.conn.call("CreateActor", {"spec": spec}, timeout=300)
+                await handle.conn.call(
+                    "CreateActor",
+                    {"spec": spec},
+                    timeout=config.rpc_actor_create_timeout_s,
+                )
             except rpc.RpcError as e:
                 self._release_lease(req.lease_id, dirty=True)
                 return {"granted": False, "error": str(e)}
@@ -2189,7 +2193,9 @@ class Raylet:
             while True:
                 try:
                     await remote.call(
-                        "PushObject", {"oid": oid, "to": list(self.addr)}, timeout=120
+                        "PushObject",
+                        {"oid": oid, "to": list(self.addr)},
+                        timeout=config.rpc_transfer_timeout_s,
                     )
                     # Supervise the one-way chunk stream: a stream that stops
                     # mid-assembly (source death, chunk loss) is aborted and
@@ -2259,7 +2265,7 @@ class Raylet:
                 data = await remote.call(
                     "FetchChunk",
                     {"oid": oid, "offset": done, "size": min(chunk, size - done)},
-                    timeout=60,
+                    timeout=config.rpc_chunk_timeout_s,
                 )
                 view[offset + done : offset + done + len(data)] = data
                 done += len(data)

@@ -10,9 +10,24 @@ are cloudpickled into opaque ``bytes`` fields by the caller.
 
 Wire format: a raw msgpack stream; each message is ``[msgid, kind, method,
 payload]``. Kinds: 0=request, 1=reply, 2=error-reply, 3=push (one-way).
-msgpack is self-framing, so no length prefix is needed — the receiving side
-feeds whole socket chunks to a streaming Unpacker and drains every complete
+Requests may carry a fifth element: the remaining deadline budget (TTL) in
+float seconds, stamped at the moment the frame is packed. The receiver
+reconstructs an absolute deadline on its own clock (``loop.time() + ttl``)
+— relative TTLs make the deadline clock-skew-free, and a frame a fault
+schedule holds back arrives with its budget already shrunk. msgpack is
+self-framing, so no length prefix is needed — the receiving side feeds
+whole socket chunks to a streaming Unpacker and drains every complete
 message per chunk with zero per-frame awaits.
+
+Resilience (reference: retryable_grpc_client.h / gcs_rpc_client.h): every
+``call`` with a timeout (explicit or inherited from the ambient handler
+deadline) propagates its remaining budget downstream, so GCS -> raylet ->
+worker chains shrink the budget at every hop and no hop outlives its
+caller; servers shed requests that arrive already expired and cancel
+handlers at their deadline. :class:`RetryPolicy` (full-jitter exponential
+backoff with attempt + total-budget caps) drives both the ``connect`` dial
+loop and :class:`RetryableConnection`, which re-dials dead links and
+re-issues calls whose method the wire registry declares retry-safe.
 
 Throughput design (reference: the C++ layer's batched stream writes in
 ClientCallManager): the hot path is callback-based, not coroutine-based.
@@ -26,14 +41,19 @@ coroutine conveniences on top.
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import itertools
 import logging
 import os
+import random
 import tempfile
 import traceback
-from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
+from dataclasses import dataclass
+from typing import Any, Awaitable, Callable, Dict, Iterator, List, Optional, Tuple
 
 import msgpack
+
+from ray_tpu._private.common import config
 
 
 def _uds_path(port: int) -> str:
@@ -103,7 +123,134 @@ class ConnectionLost(RpcError):
     pass
 
 
+class DeadlineExceeded(RpcError):
+    """A request arrived past its deadline (shed) or its handler was cut at
+    the deadline. The error-reply text starts with this class name so the
+    far side can tell budget exhaustion from a handler bug."""
+
+
 _packb = msgpack.Packer(use_bin_type=True, autoreset=True).pack
+
+
+# ---------------------------------------------------------------------------
+# End-to-end deadlines.
+#
+# The deadline of the request currently being dispatched, as an absolute
+# loop.time() instant, set per handler task (each dispatch runs in its own
+# task, whose context copy isolates the var). Any ``Connection.call`` made
+# under it inherits the remaining budget — the mechanism by which a 120 s
+# LeaseWorkerForActor clamps the CreateActor it fans out to.
+# ---------------------------------------------------------------------------
+
+_ambient_deadline: contextvars.ContextVar[Optional[float]] = contextvars.ContextVar(
+    "ray_tpu_rpc_deadline", default=None
+)
+
+
+def current_deadline() -> Optional[float]:
+    """Absolute loop-time deadline of the request being handled, if any."""
+    return _ambient_deadline.get()
+
+
+def remaining_budget() -> Optional[float]:
+    """Seconds left in the current handler's deadline budget (None if
+    unbounded). Loop thread only."""
+    deadline = _ambient_deadline.get()
+    if deadline is None:
+        return None
+    return deadline - asyncio.get_running_loop().time()
+
+
+class DeadlineStats:
+    """Process-wide counters for deadline enforcement; the chaos runner
+    resets them per seed and the no-call-outlives-deadline invariant reads
+    ``overruns`` (handlers that survived past deadline + grace — a stalled
+    loop or a handler swallowing cancellation)."""
+
+    __slots__ = ("met", "shed", "enforced", "overruns")
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self.met = 0          # handlers that finished inside their deadline
+        self.shed = 0         # requests dropped as already expired
+        self.enforced = 0     # handlers cancelled at their deadline
+        self.overruns: List[Tuple[str, float]] = []  # (method, seconds late)
+
+    def snapshot(self) -> dict:
+        return {
+            "met": self.met,
+            "shed": self.shed,
+            "enforced": self.enforced,
+            "overruns": list(self.overruns),
+        }
+
+
+deadline_stats = DeadlineStats()
+
+
+# ---------------------------------------------------------------------------
+# Retry policy (reference: retryable_grpc_client.h exponential backoff).
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Full-jitter exponential backoff with an attempt cap and a total
+    wall-clock budget. ``max_attempts``/``total_budget_s`` of 0 mean
+    unbounded on that axis (the other cap still applies)."""
+
+    initial_backoff_s: float = 0.05
+    max_backoff_s: float = 2.0
+    multiplier: float = 2.0
+    max_attempts: int = 0
+    total_budget_s: float = 30.0
+
+    def backoff_cap(self, retry_index: int) -> float:
+        """Upper bound of the jitter window before retry ``retry_index``
+        (0-based)."""
+        return min(
+            self.max_backoff_s,
+            self.initial_backoff_s * self.multiplier ** retry_index,
+        )
+
+    def backoffs(self, rng: Optional[random.Random] = None) -> Iterator[float]:
+        """Infinite stream of jittered sleeps: sleep_i ~ U(0, cap_i). Pass a
+        seeded ``random.Random`` for a deterministic schedule (tests,
+        replay); the caps bound the caller's loop via :meth:`allows`."""
+        uniform = (rng or random).uniform
+        i = 0
+        while True:
+            yield uniform(0.0, self.backoff_cap(i))
+            i += 1
+
+    def allows(self, attempt: int, elapsed_s: float) -> bool:
+        """May attempt number ``attempt`` (1-based) start after
+        ``elapsed_s`` seconds since the first try?"""
+        if self.max_attempts > 0 and attempt > self.max_attempts:
+            return False
+        if self.total_budget_s > 0 and elapsed_s >= self.total_budget_s:
+            return False
+        return True
+
+    @classmethod
+    def for_dial(cls) -> "RetryPolicy":
+        return cls(
+            initial_backoff_s=config.rpc_dial_initial_backoff_s,
+            max_backoff_s=config.rpc_dial_max_backoff_s,
+            multiplier=config.rpc_backoff_multiplier,
+            total_budget_s=config.rpc_dial_total_s,
+        )
+
+    @classmethod
+    def for_calls(cls) -> "RetryPolicy":
+        return cls(
+            initial_backoff_s=config.rpc_retry_initial_backoff_s,
+            max_backoff_s=config.rpc_retry_max_backoff_s,
+            multiplier=config.rpc_backoff_multiplier,
+            total_budget_s=config.rpc_reconnect_timeout_s,
+        )
 
 
 class _RpcProtocol(asyncio.Protocol):
@@ -197,12 +344,22 @@ class Connection:
 
     # -- write path ----------------------------------------------------------
 
+    def _pack_frame(self, msg) -> bytes:
+        """Pack one frame, stamping a request's deadline (held in-memory as
+        an absolute loop.time() instant) into the relative TTL that goes on
+        the wire. Stamping at pack time — not at call time — means a frame a
+        chaos schedule delays ships with its budget already shrunk, so the
+        receiver's reconstructed deadline stays honest."""
+        if len(msg) > 4 and msg[4] is not None:
+            msg = [msg[0], msg[1], msg[2], msg[3], msg[4] - self._loop.time()]
+        return _packb(msg)
+
     def _send_nowait(self, msg) -> None:
         if self._closed:
             raise ConnectionLost("connection closed")
         if _send_interceptor is not None and _send_interceptor(self, msg):
             return  # consumed by fault injection (dropped/held/delayed)
-        self._out.append(_packb(msg))
+        self._out.append(self._pack_frame(msg))
         if not self._flush_scheduled:
             self._flush_scheduled = True
             self._loop.call_soon(self._flush)
@@ -213,7 +370,7 @@ class Connection:
         delay timer may outlive the link)."""
         if self._closed:
             return
-        self._out.append(_packb(msg))
+        self._out.append(self._pack_frame(msg))
         if not self._flush_scheduled:
             self._flush_scheduled = True
             self._loop.call_soon(self._flush)
@@ -243,14 +400,21 @@ class Connection:
 
     # -- request/reply -------------------------------------------------------
 
-    def call_nowait(self, method: str, payload: Any = None) -> asyncio.Future:
-        """Issue a request; returns the reply future. Loop thread only."""
+    def call_nowait(
+        self, method: str, payload: Any = None, deadline: Optional[float] = None
+    ) -> asyncio.Future:
+        """Issue a request; returns the reply future. ``deadline`` is an
+        absolute loop.time() instant carried to the server as a TTL; the
+        caller still owns its own wait. Loop thread only."""
         msgid = next(self._msgid)
         fut = self._loop.create_future()
         fut.rpc_msgid = msgid
         self._pending[msgid] = fut
+        frame = [msgid, _KIND_REQ, method, payload]
+        if deadline is not None:
+            frame.append(deadline)
         try:
-            self._send_nowait([msgid, _KIND_REQ, method, payload])
+            self._send_nowait(frame)
         except ConnectionLost:
             self._pending.pop(msgid, None)
             raise
@@ -271,13 +435,30 @@ class Connection:
             self._cb_pending.pop(msgid, None)
             raise
 
+    def _effective_deadline(self, timeout: Optional[float]) -> Optional[float]:
+        """Fold the explicit timeout with the ambient handler deadline: a
+        call made while serving a deadlined request never outlives its
+        caller, whatever timeout it asked for locally."""
+        ambient = _ambient_deadline.get()
+        local = None if timeout is None else self._loop.time() + timeout
+        if ambient is None:
+            return local
+        if local is None:
+            return ambient
+        return min(ambient, local)
+
     async def call(self, method: str, payload: Any = None, timeout: Optional[float] = None):
-        """Issue a request and await the reply."""
-        fut = self.call_nowait(method, payload)
+        """Issue a request and await the reply. The effective budget —
+        ``timeout`` clamped by the ambient handler deadline — rides the
+        frame as a TTL so every downstream hop sees it shrink."""
+        deadline = self._effective_deadline(timeout)
+        fut = self.call_nowait(method, payload, deadline=deadline)
         try:
-            if timeout is None:
+            if deadline is None:
                 return await fut
-            return await asyncio.wait_for(fut, timeout)
+            return await asyncio.wait_for(
+                fut, max(0.0, deadline - self._loop.time())
+            )
         finally:
             # On timeout or caller cancellation the reply will never be
             # consumed; drop the entry so the pending table doesn't leak.
@@ -307,18 +488,37 @@ class Connection:
             pass
 
     def _on_message(self, msg) -> None:
-        msgid, kind, method, payload = msg
+        msgid, kind, method, payload = msg[0], msg[1], msg[2], msg[3]
         if kind == _KIND_REQ:
+            deadline = None
+            if len(msg) > 4 and msg[4] is not None:
+                ttl = msg[4]
+                if ttl <= 0:
+                    # Shed stale work: the caller has already given up.
+                    deadline_stats.shed += 1
+                    self.reply_error_nowait(
+                        msgid,
+                        method,
+                        f"DeadlineExceeded: {method} arrived "
+                        f"{-ttl:.3f}s past its deadline (shed)",
+                    )
+                    return
+                deadline = self._loop.time() + ttl
             sync_h = self._sync_handlers.get(method)
             if sync_h is not None:
+                # Set the ambient deadline around the inline handler so any
+                # coroutine it spawn()s inherits the remaining budget.
+                token = _ambient_deadline.set(deadline)
                 try:
                     sync_h(self, msgid, payload)
                 except Exception as e:
                     self.reply_error_nowait(
                         msgid, method, f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
                     )
+                finally:
+                    _ambient_deadline.reset(token)
                 return
-            spawn(self._dispatch(msgid, method, payload))
+            spawn(self._dispatch(msgid, method, payload, deadline))
         elif kind == _KIND_PUSH:
             spawn(self._dispatch(None, method, payload))
         else:
@@ -339,12 +539,21 @@ class Connection:
                 else:
                     fut.set_exception(RpcError(payload))
 
-    async def _dispatch(self, msgid, method: str, payload) -> None:
+    async def _dispatch(
+        self, msgid, method: str, payload, deadline: Optional[float] = None
+    ) -> None:
         handler = self._handlers.get(method)
+        # Each dispatch runs in its own task (own context copy), so setting
+        # the ambient deadline here scopes it to this handler and every call
+        # it makes downstream.
+        _ambient_deadline.set(deadline)
         try:
             if handler is None:
                 raise RpcError(f"no handler for method {method!r}")
-            result = await handler(self, payload)
+            if deadline is None:
+                result = await handler(self, payload)
+            else:
+                result = await self._run_deadlined(handler, method, payload, deadline)
         except Exception as e:
             # Any handler failure — including ConnectionLost from a dial the
             # handler made to a third party — must produce an error reply, or
@@ -363,6 +572,35 @@ class Connection:
                 self._send_nowait([msgid, _KIND_REP, method, result])
             except ConnectionLost:
                 pass
+
+    async def _run_deadlined(self, handler, method: str, payload, deadline: float):
+        """Run a handler under its wire deadline: shed if already expired,
+        cancel at the deadline (the caller gave up at the same instant, so
+        the result would be discarded anyway), and record handlers whose
+        finish — or cancellation unwind — runs more than the grace period
+        late (the no-call-outlives-deadline invariant's raw data)."""
+        remaining = deadline - self._loop.time()
+        if remaining <= 0:
+            deadline_stats.shed += 1
+            raise DeadlineExceeded(
+                f"{method} shed before dispatch: deadline expired "
+                f"{-remaining:.3f}s ago"
+            )
+        try:
+            result = await asyncio.wait_for(handler(self, payload), remaining)
+        except asyncio.TimeoutError:
+            deadline_stats.enforced += 1
+            raise DeadlineExceeded(
+                f"{method} handler cancelled at its deadline "
+                f"({remaining:.3f}s budget on arrival)"
+            ) from None
+        finally:
+            late = self._loop.time() - deadline
+            if late > config.rpc_deadline_grace_s:
+                deadline_stats.overruns.append((method, late))
+            elif late <= 0:
+                deadline_stats.met += 1
+        return result
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -497,15 +735,41 @@ async def connect(
     host: str,
     port: int,
     handlers: Optional[Dict[str, Callable]] = None,
-    retry: int = 30,
-    retry_interval: float = 0.1,
+    retry: Optional[int] = None,
+    retry_interval: Optional[float] = None,
     sync_handlers: Optional[Dict[str, Callable]] = None,
+    policy: Optional[RetryPolicy] = None,
 ) -> Connection:
-    """Dial a server, retrying while it boots. Returns a duplex Connection."""
+    """Dial a server, retrying with jittered exponential backoff while it
+    boots. Returns a duplex Connection.
+
+    By default the dial schedule comes from :meth:`RetryPolicy.for_dial`
+    (config knobs ``rpc_dial_*``). Legacy ``retry``/``retry_interval``
+    arguments are mapped onto an equivalent policy — ``retry`` caps the
+    attempt count and ``retry * retry_interval`` caps the total wait — so
+    existing call sites keep their expected patience.
+    """
     loop = asyncio.get_running_loop()
+    if policy is None:
+        if retry is None and retry_interval is None:
+            policy = RetryPolicy.for_dial()
+        else:
+            n = 30 if retry is None else max(1, retry)
+            interval = 0.1 if retry_interval is None else retry_interval
+            policy = RetryPolicy(
+                initial_backoff_s=interval,
+                max_backoff_s=interval * 8,
+                multiplier=config.rpc_backoff_multiplier,
+                max_attempts=n,
+                total_budget_s=n * interval,
+            )
     last_err: Optional[Exception] = None
     uds = _uds_path(port) if host in _LOOPBACK else None
-    for _ in range(max(1, retry)):
+    backoffs = policy.backoffs()
+    start = loop.time()
+    attempt = 0
+    while True:
+        attempt += 1
         try:
             # NB: keep the caller's dict object (even if currently empty) so
             # handlers registered later are visible on this connection.
@@ -523,5 +787,169 @@ async def connect(
             return conn
         except (ConnectionRefusedError, OSError) as e:
             last_err = e
-            await asyncio.sleep(retry_interval)
-    raise ConnectionLost(f"could not connect to {host}:{port}: {last_err}")
+        delay = next(backoffs)
+        if not policy.allows(attempt + 1, (loop.time() - start) + delay):
+            break
+        await asyncio.sleep(delay)
+    raise ConnectionLost(
+        f"could not connect to {host}:{port} "
+        f"after {attempt} attempts: {last_err}"
+    )
+
+
+class RetryableConnection:
+    """A Connection wrapper that survives the link: transparent re-dial on
+    ``ConnectionLost``/timeout, with in-flight calls queued during the
+    reconnect window and drained against the fresh link — the reference
+    runtime's retryable gRPC client (``retryable_grpc_client.h``, and the
+    GCS client's failover call queue) in miniature.
+
+    Retry *safety* is per method, declared in ``wire.SCHEMAS``:
+
+    - ``"safe"`` — idempotent; retried freely.
+    - ``"dedup"`` — retried only when the payload carries the schema's
+      msgid-stable dedup token (e.g. ``lease_id``), which the server uses
+      to mirror the original outcome instead of re-applying.
+    - ``"none"`` — never retried; the first failure surfaces.
+
+    Methods missing from the registry use ``default_retry`` (constructor
+    argument; "safe" fits channels whose handlers are keyed upserts/reads
+    by construction, like the GCS control plane).
+
+    The wrapper owns reconnection, not call-level deadlines: each attempt
+    inherits the caller's ``timeout`` folded with the ambient handler
+    deadline, and the overall retry loop gives up when that budget — or the
+    policy's — runs out.
+    """
+
+    def __init__(
+        self,
+        dial: Callable[[], Awaitable[Connection]],
+        conn: Optional[Connection] = None,
+        policy: Optional[RetryPolicy] = None,
+        default_retry: str = "none",
+        attempt_timeout_s: Optional[float] = None,
+        on_reconnect: Optional[Callable[[Connection], Awaitable[None]]] = None,
+        name: str = "rpc",
+        rng: Optional[random.Random] = None,
+    ):
+        self._dial = dial
+        self.conn = conn
+        self._policy = policy or RetryPolicy.for_calls()
+        self._default_retry = default_retry
+        # Per-attempt cap so a request whose reply was dropped doesn't pin
+        # the whole budget. 0/None disables it (required for channels that
+        # carry long-polls, e.g. CreateActor wait_alive).
+        if attempt_timeout_s is None:
+            attempt_timeout_s = config.rpc_default_timeout_s
+        self._attempt_timeout_s = attempt_timeout_s or None
+        self._on_reconnect = on_reconnect
+        self._name = name
+        self._rng = rng or random.Random()
+        self._lock: Optional[asyncio.Lock] = None  # lazy: loop-bound
+        self._closed = False
+        self.stats = {"redials": 0, "retries": 0, "queued": 0}
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def _retry_mode(self, method: str, payload: Any) -> str:
+        """"safe" if this (method, payload) may be re-sent, else "none"."""
+        from ray_tpu._private import wire  # lazy: avoid import cycle
+
+        mode, dedup_key = wire.retry_class(method, self._default_retry)
+        if mode == wire.RETRY_DEDUP:
+            token = payload.get(dedup_key) if isinstance(payload, dict) else None
+            return wire.RETRY_SAFE if token is not None else wire.RETRY_NONE
+        return mode
+
+    async def _ensure_connected(self) -> Connection:
+        """Current live connection, (re)dialing under a lock if needed.
+        Sets ``self.conn`` *before* firing ``on_reconnect`` so re-entrant
+        calls made from the callback hit the fast path instead of
+        deadlocking on the lock."""
+        conn = self.conn
+        if conn is not None and not conn.closed:
+            return conn
+        if self._closed:
+            raise ConnectionLost(f"{self._name}: client closed")
+        if self._lock is None:
+            self._lock = asyncio.Lock()
+        queued = self._lock.locked()
+        if queued:
+            self.stats["queued"] += 1
+        async with self._lock:
+            conn = self.conn
+            if conn is not None and not conn.closed:
+                return conn  # another waiter already reconnected
+            if self._closed:
+                raise ConnectionLost(f"{self._name}: client closed")
+            conn = await self._dial()
+            self.conn = conn
+            self.stats["redials"] += 1
+            if self._on_reconnect is not None:
+                await self._on_reconnect(conn)
+            return conn
+
+    async def call(
+        self, method: str, payload: Any = None, timeout: Optional[float] = None
+    ):
+        """Issue a request, retrying per the method's wire retry class.
+
+        The overall budget is ``timeout`` folded with the ambient handler
+        deadline and the policy's total budget; backoffs are clamped to it.
+        Non-retryable failures — and retryable ones once the budget is
+        spent — propagate to the caller.
+        """
+        loop = asyncio.get_running_loop()
+        ambient = _ambient_deadline.get()
+        overall: Optional[float] = None
+        if timeout is not None:
+            overall = loop.time() + timeout
+        if ambient is not None:
+            overall = ambient if overall is None else min(overall, ambient)
+        start = loop.time()
+        backoffs = self._policy.backoffs(self._rng)
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                conn = await self._ensure_connected()
+                attempt_timeout = self._attempt_timeout_s
+                if overall is not None:
+                    remaining = overall - loop.time()
+                    if remaining <= 0:
+                        raise DeadlineExceeded(
+                            f"{self._name}: {method} budget exhausted "
+                            f"before attempt {attempt}"
+                        )
+                    if attempt_timeout is None or attempt_timeout > remaining:
+                        attempt_timeout = remaining
+                return await conn.call(method, payload, timeout=attempt_timeout)
+            except (ConnectionLost, asyncio.TimeoutError) as e:
+                if self._closed:
+                    raise
+                if self._retry_mode(method, payload) != "safe":
+                    raise
+                delay = next(backoffs)
+                now = loop.time()
+                if not self._policy.allows(attempt + 1, (now - start) + delay):
+                    raise
+                if overall is not None:
+                    remaining = overall - now
+                    if remaining <= delay:
+                        raise
+                self.stats["retries"] += 1
+                logger.debug(
+                    "%s: retrying %s after %s (attempt %d, sleeping %.3fs)",
+                    self._name, method, type(e).__name__, attempt, delay,
+                )
+                await asyncio.sleep(delay)
+
+    async def close(self) -> None:
+        """Terminal: no further re-dials; in-flight retry loops surface
+        their pending error instead of reconnecting."""
+        self._closed = True
+        if self.conn is not None:
+            await self.conn.close()

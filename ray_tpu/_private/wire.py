@@ -1,14 +1,31 @@
 """Wire-protocol schema registry for the msgpack RPC layer.
 
-Frames on the wire are ``[msgid, kind, method, payload]`` (rpc.py) and the
-payloads are plain msgpack dicts. This registry is the single versioned
-description of the payload shape for the high-traffic message types: each
-entry declares the keys a producer must send (``required``) and the keys a
-consumer may additionally read (``optional``). It has no runtime cost — the
-RPC layer never imports it; ``ray_tpu.devtools.rpc_check`` cross-checks
-every literal payload dict at call sites and every ``p["k"]``/``p.get("k")``
-in handler bodies against it at lint time, so a renamed field fails CI
-instead of silently returning ``None`` from ``p.get`` on the other side.
+Frames on the wire are ``[msgid, kind, method, payload]`` — requests may
+carry a fifth element, the remaining deadline budget in seconds (rpc.py) —
+and the payloads are plain msgpack dicts. This registry is the single
+versioned description of the payload shape for the high-traffic message
+types: each entry declares the keys a producer must send (``required``),
+the keys a consumer may additionally read (``optional``), and the method's
+*retry class* — whether the resilience layer may transparently re-issue the
+call after a lost connection or timeout. The lint pass
+(``ray_tpu.devtools.rpc_check``) cross-checks every literal payload dict at
+call sites and every ``p["k"]``/``p.get("k")`` in handler bodies against it,
+so a renamed field fails CI instead of silently returning ``None`` from
+``p.get`` on the other side; the retry classes are consumed at runtime by
+``rpc.RetryableConnection``.
+
+Retry classes
+-------------
+- ``RETRY_SAFE`` — the handler is an idempotent upsert/read against keyed
+  state; re-delivering the request is indistinguishable from delivering it
+  once. The resilience layer retries these freely.
+- ``RETRY_DEDUP`` — the handler mutates state but dedupes on a msgid-stable
+  token carried in the payload (``dedup_key``); e.g. the raylet's
+  granted-lease ledger keyed by ``lease_id``. Retried only when the token
+  is present in the payload.
+- ``RETRY_NONE`` — re-delivery could double-apply (ordered streams,
+  one-shot side effects). Failures surface to the caller, whose own
+  recovery (task retry, lineage reconstruction) owns the decision.
 
 Adding a new RPC method
 -----------------------
@@ -16,8 +33,10 @@ Adding a new RPC method
    call site.
 2. If the method carries a structured payload, add a ``WireSchema`` entry
    here. Required = keys every producer always sends; optional = everything
-   any consumer may read. Reply shapes are not checked (replies are built
-   and consumed in one file in practice).
+   any consumer may read. Declare the retry class honestly: ``RETRY_SAFE``
+   is a promise about the handler's semantics, not a convenience flag.
+   Reply shapes are not checked (replies are built and consumed in one file
+   in practice).
 3. Run ``python -m ray_tpu.devtools.lint`` — drift in either direction
    (producer missing a required key / sending an undeclared one, consumer
    reading an undeclared one) fails the gate.
@@ -31,58 +50,118 @@ each step reviewable.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, FrozenSet, Iterable
+from typing import Dict, FrozenSet, Iterable, Optional, Tuple
+
+RETRY_SAFE = "safe"
+RETRY_DEDUP = "dedup"
+RETRY_NONE = "none"
+
+_RETRY_CLASSES = (RETRY_SAFE, RETRY_DEDUP, RETRY_NONE)
 
 
 @dataclass(frozen=True)
 class WireSchema:
-    """Payload-key contract for one RPC method."""
+    """Payload-key contract and retry class for one RPC method."""
 
     required: FrozenSet[str] = frozenset()
     optional: FrozenSet[str] = frozenset()
+    retry: str = RETRY_NONE
+    dedup_key: Optional[str] = None
+
+    def __post_init__(self):
+        if self.retry not in _RETRY_CLASSES:
+            raise ValueError(f"unknown retry class {self.retry!r}")
+        if self.retry == RETRY_DEDUP and not self.dedup_key:
+            raise ValueError("RETRY_DEDUP requires a dedup_key")
 
 
-def _s(required: Iterable[str] = (), optional: Iterable[str] = ()) -> WireSchema:
-    return WireSchema(frozenset(required), frozenset(optional))
+def _s(
+    required: Iterable[str] = (),
+    optional: Iterable[str] = (),
+    retry: str = RETRY_NONE,
+    dedup_key: Optional[str] = None,
+) -> WireSchema:
+    return WireSchema(frozenset(required), frozenset(optional), retry, dedup_key)
 
 
 # The top message types by control/data-plane traffic. Methods not listed
-# here still get method-name cross-checking, just not key checking.
+# here still get method-name cross-checking, just not key checking; their
+# retry class defaults to the channel's default (RetryableConnection's
+# ``default_retry`` — "safe" on the GCS channel, whose handlers are keyed
+# upserts/reads by construction).
 SCHEMAS: Dict[str, WireSchema] = {
     # -- GCS control plane ---------------------------------------------------
-    "RegisterNode": _s(["node_id", "addr", "resources"], ["labels"]),
-    "UpdateResources": _s(["node_id", "available"], ["total", "version"]),
-    "CreateActor": _s(["spec"], ["wait_alive", "get_if_exists"]),
-    "GetActor": _s(["actor_id"]),
-    "ReportActorReady": _s(
-        ["actor_id"], ["addr", "worker_id", "node_id", "error"]
+    "RegisterNode": _s(
+        ["node_id", "addr", "resources"], ["labels"], retry=RETRY_SAFE
     ),
-    "ReportWorkerDied": _s(["actor_ids"], ["cause", "worker_id"]),
-    "KillActor": _s(["actor_id"], ["no_restart"]),
-    "KVPut": _s(["key", "value"], ["ns", "overwrite"]),
-    "KVGet": _s(["key"], ["ns"]),
-    "Subscribe": _s(["channel"]),
-    "Publish": _s(["channel", "msg"]),
+    "UpdateResources": _s(
+        ["node_id", "available"], ["total", "version"], retry=RETRY_SAFE
+    ),
+    # Keyed upsert on actor_id: a retried CreateActor attaches to the
+    # existing record instead of double-enqueueing (gcs.py _create_actor).
+    "CreateActor": _s(
+        ["spec"], ["wait_alive", "get_if_exists"], retry=RETRY_SAFE
+    ),
+    "GetActor": _s(["actor_id"], retry=RETRY_SAFE),
+    "ReportActorReady": _s(
+        ["actor_id"], ["addr", "worker_id", "node_id", "error"],
+        retry=RETRY_SAFE,
+    ),
+    "ReportWorkerDied": _s(
+        ["actor_ids"], ["cause", "worker_id"], retry=RETRY_SAFE
+    ),
+    "KillActor": _s(["actor_id"], ["no_restart"], retry=RETRY_SAFE),
+    # NB: a KVPut retry after a lost reply reports added=False on the
+    # re-issue when overwrite=False — the effect is still exactly-once.
+    "KVPut": _s(["key", "value"], ["ns", "overwrite"], retry=RETRY_SAFE),
+    "KVGet": _s(["key"], ["ns"], retry=RETRY_SAFE),
+    "Subscribe": _s(["channel"], retry=RETRY_SAFE),
+    # Pubsub is at-least-once: a retried Publish may deliver twice.
+    "Publish": _s(["channel", "msg"], retry=RETRY_SAFE),
     # Server->client pubsub delivery push.
     "Pub": _s(["channel", "msg"]),
     # -- raylet scheduling ---------------------------------------------------
+    # Deduped by the raylet's granted-lease ledger (PR 2): a retried frame
+    # with the same lease_id mirrors the original grant outcome.
     "RequestWorkerLease": _s(
         ["lease_id", "resources"],
         ["strategy", "pg_id", "bundle_index", "spilled_from", "job_id"],
+        retry=RETRY_DEDUP,
+        dedup_key="lease_id",
     ),
-    "CancelWorkerLease": _s(["lease_id"]),
-    "ReturnWorker": _s(["lease_id"], ["dirty"]),
-    "LeaseWorkerForActor": _s(["spec"]),
-    "KillWorker": _s(["worker_id"], ["probe", "force"]),
-    # -- task dispatch -------------------------------------------------------
+    "CancelWorkerLease": _s(["lease_id"], retry=RETRY_SAFE),
+    "ReturnWorker": _s(
+        ["lease_id"], ["dirty"], retry=RETRY_DEDUP, dedup_key="lease_id"
+    ),
+    # Deduped on spec.actor_id ("actor:<id>" lease ids) via the raylet's
+    # actor_creations_in_flight set + grant ledger.
+    "LeaseWorkerForActor": _s(
+        ["spec"], retry=RETRY_DEDUP, dedup_key="spec"
+    ),
+    "KillWorker": _s(["worker_id"], ["probe", "force"], retry=RETRY_SAFE),
+    # -- task dispatch (ordered streams: retries owned by the task layer) ----
     "PushTask": _s(["spec"]),
     "PushActorTask": _s(["spec"]),
     # -- object plane --------------------------------------------------------
-    "ObjCreate": _s(["oid", "size"], ["pin"]),
-    "ObjSeal": _s(["oid"]),
-    "WaitObject": _s(["oid"], ["timeout"]),
-    "PushStart": _s(["oid", "size"]),
+    "ObjCreate": _s(
+        ["oid", "size"], ["pin"], retry=RETRY_DEDUP, dedup_key="oid"
+    ),
+    "ObjSeal": _s(["oid"], retry=RETRY_SAFE),
+    "WaitObject": _s(["oid"], ["timeout"], retry=RETRY_SAFE),
+    "PushStart": _s(
+        ["oid", "size"], retry=RETRY_DEDUP, dedup_key="oid"
+    ),
     "PushChunk": _s(["oid", "offset", "data"]),
     # -- logs / observability ------------------------------------------------
-    "GetLog": _s([], ["filename", "worker_id", "stream", "tail"]),
+    "GetLog": _s(
+        [], ["filename", "worker_id", "stream", "tail"], retry=RETRY_SAFE
+    ),
 }
+
+
+def retry_class(method: str, default: str = RETRY_NONE) -> Tuple[str, Optional[str]]:
+    """(retry class, dedup key) for a method; ``default`` for unlisted ones."""
+    schema = SCHEMAS.get(method)
+    if schema is None:
+        return default, None
+    return schema.retry, schema.dedup_key

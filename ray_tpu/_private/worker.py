@@ -199,7 +199,9 @@ def init(
                 )
             return node, core, gcs_addr
 
-        node, core, gcs_addr = w.run_async(_bring_up(), timeout=120)
+        node, core, gcs_addr = w.run_async(
+            _bring_up(), timeout=config.driver_bringup_timeout_s
+        )
         w.node = node
         w.core = core
         w.mode = "driver"
@@ -289,7 +291,7 @@ def shutdown() -> None:
                 await node.stop()
 
     try:
-        w.run_async(_down(), timeout=30)
+        w.run_async(_down(), timeout=config.driver_shutdown_timeout_s)
     except Exception:
         pass
 

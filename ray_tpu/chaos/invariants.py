@@ -259,6 +259,25 @@ async def check_orphan_tasks(settle_s: float = 1.0) -> List[Violation]:
     ]
 
 
+def check_deadlines() -> List[Violation]:
+    """No call outlives its deadline: every handler dispatched under a wire
+    deadline must finish — or unwind its cancellation — within the grace
+    period (``config.rpc_deadline_grace_s``) of it. An overrun means a
+    handler swallowed cancellation or the loop stalled long enough that
+    shedding/enforcement never got to run; either way a hop kept working
+    after its caller gave up. Counters are process-wide (rpc.deadline_stats)
+    and reset per seed by the runner."""
+    return [
+        Violation(
+            "no-call-outlives-deadline",
+            "-",
+            f"handler {method} finished {late:.3f}s past its wire deadline "
+            "(> grace period)",
+        )
+        for method, late in rpc.deadline_stats.overruns
+    ]
+
+
 async def check(cluster) -> List[Violation]:
     """Run every invariant against a quiesced cluster."""
     violations: List[Violation] = []
@@ -268,4 +287,5 @@ async def check(cluster) -> List[Violation]:
     if cluster.gcs_server is not None:
         violations.extend(check_actors(cluster.gcs_server))
     violations.extend(await check_orphan_tasks())
+    violations.extend(check_deadlines())
     return violations

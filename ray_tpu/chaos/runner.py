@@ -74,6 +74,15 @@ _TRANSFER_ENV = {
 
 _TASKS_ENV = {"RAY_TPU_WORKER_LEASE_IDLE_KEEP_S": "0.2"}
 
+_LATENCY_ENV = {
+    # Per-attempt cap on the retryable GCS channel: a dropped reply is
+    # re-issued after 2s instead of hanging the caller's whole budget.
+    # Safe here because the latency workloads are tasks-only (no
+    # CreateActor wait_alive long-polls ride the GCS channel).
+    "RAY_TPU_RPC_DEFAULT_TIMEOUT_S": "2.0",
+    "RAY_TPU_WORKER_LEASE_IDLE_KEEP_S": "0.2",
+}
+
 
 SCENARIOS: Dict[str, Scenario] = {
     s.name: s
@@ -147,6 +156,50 @@ SCENARIOS: Dict[str, Scenario] = {
             env=dict(_TASKS_ENV),
         ),
         Scenario(
+            name="latency_storm",
+            description="heavy ambient latency: every request and reply "
+            "delayed 5-80ms with p=0.35; deadlines must shrink hop to hop "
+            "and no handler may outlive its caller",
+            specs=[
+                FaultSpec("delay-req", "delay", "*",
+                          frame="request", p=0.35, delay_s=(0.005, 0.08)),
+                FaultSpec("delay-rep", "delay", "*",
+                          frame="reply", p=0.35, delay_s=(0.005, 0.08)),
+            ],
+            workload="tasks",
+            env=dict(_LATENCY_ENV),
+        ),
+        Scenario(
+            name="latency_gcs_drop",
+            description="GCS reply loss: idempotent control-plane replies "
+            "dropped; the retryable channel re-issues within its budget "
+            "(named methods only — blanket drops would hang long-polls)",
+            specs=[
+                FaultSpec("drop-kv", "drop", "KV*",
+                          frame="reply", p=0.2),
+                FaultSpec("drop-resources", "drop", "UpdateResources",
+                          frame="reply", p=0.3),
+                FaultSpec("drop-nodes", "drop", "GetAllNodes",
+                          frame="reply", p=0.3),
+            ],
+            workload="tasks",
+            env=dict(_LATENCY_ENV),
+        ),
+        Scenario(
+            name="latency_gcs_restart",
+            description="ambient request latency plus a GCS kill+restart: "
+            "GCS-bound calls queue across the failover and drain after "
+            "reconnect as latency blips, not errors",
+            specs=[
+                FaultSpec("delay-req", "delay", "*",
+                          frame="request", p=0.25, delay_s=(0.005, 0.06)),
+            ],
+            workload="tasks",
+            steps=4,
+            nemesis=["restart_gcs"],
+            env=dict(_LATENCY_ENV),
+        ),
+        Scenario(
             name="kill_raylet",
             description="kill the node holding transferred objects; refs "
             "recover via lineage reconstruction",
@@ -166,8 +219,12 @@ SUITES: Dict[str, List[str]] = {
     "smoke": ["rpc_delay", "dup_lease", "chunk_loss", "reorder_push"],
     # Process-level nemesis: heavier, run over fewer seeds.
     "recovery": ["kill_worker", "gcs_restart", "kill_raylet"],
+    # Delay/drop-heavy schedules exercising the RPC resilience layer
+    # (retryable channels, deadline propagation, GCS failover queueing).
+    "latency": ["latency_storm", "latency_gcs_drop", "latency_gcs_restart"],
     "full": [
         "rpc_delay", "dup_lease", "chunk_loss", "reorder_push",
+        "latency_storm", "latency_gcs_drop", "latency_gcs_restart",
         "kill_worker", "gcs_restart", "kill_raylet",
     ],
 }
@@ -188,6 +245,8 @@ class SeedResult:
     duplicate_grants_avoided: int = 0
     stalled_streams: int = 0
     rerequested_streams: int = 0
+    deadline_shed: int = 0
+    deadline_enforced: int = 0
 
     def to_wire(self) -> dict:
         return {
@@ -201,6 +260,8 @@ class SeedResult:
             "duplicate_grants_avoided": self.duplicate_grants_avoided,
             "stalled_streams": self.stalled_streams,
             "rerequested_streams": self.rerequested_streams,
+            "deadline_shed": self.deadline_shed,
+            "deadline_enforced": self.deadline_enforced,
         }
 
 
@@ -260,6 +321,7 @@ class _Session:
 
 def run_seed(session: _Session, scenario: Scenario, seed: int,
              verbose: bool = False) -> SeedResult:
+    from ray_tpu._private import rpc
     from ray_tpu.chaos import interceptors, invariants
     from ray_tpu.chaos.nemesis import Nemesis
 
@@ -274,6 +336,9 @@ def run_seed(session: _Session, scenario: Scenario, seed: int,
         # still be warm): every seed then re-requests leases and re-transfers
         # objects, so its schedule actually sees traffic to fault.
         await invariants.quiesce(session.cluster, timeout=15.0)
+        # Per-seed deadline accounting: the no-call-outlives-deadline
+        # invariant reads these process-wide counters at convergence.
+        rpc.deadline_stats.reset()
         return interceptors.install(schedule)
 
     async def _uninstall():
@@ -380,6 +445,8 @@ def run_seed(session: _Session, scenario: Scenario, seed: int,
         duplicate_grants_avoided=dup_avoided,
         stalled_streams=stalled,
         rerequested_streams=rereq,
+        deadline_shed=rpc.deadline_stats.shed,
+        deadline_enforced=rpc.deadline_stats.enforced,
     )
 
 

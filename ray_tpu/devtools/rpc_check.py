@@ -19,11 +19,17 @@ This pass makes the wire protocol checkable at lint time:
    every required key and nothing undeclared, and consumer handler bodies
    (``p["k"]`` / ``p.get("k")`` on the payload parameter) must only touch
    declared keys.
+3. **Magic timeouts** — runtime code under ``_private/`` must not pass a
+   numeric ``timeout=`` literal at a ``.call(...)`` site; budgets come from
+   ``common.config`` (the ``rpc_*_timeout_s`` knobs) so they are tunable,
+   greppable, and consistent with the resilience layer's deadline
+   propagation. Tests, devtools, and examples may use literals.
 
 Non-literal method names (e.g. the dashboard's generic proxy
 ``conn.call(method, ...)``) are outside the static horizon and skipped.
 Suppression: ``# aio-lint: disable=<rule>`` with rules
-``unknown-rpc-method``, ``orphan-rpc-handler``, ``payload-key-drift``.
+``unknown-rpc-method``, ``orphan-rpc-handler``, ``payload-key-drift``,
+``rpc-magic-timeout``.
 
 Run: ``python -m ray_tpu.devtools.rpc_check [--markdown] [paths]``.
 """
@@ -47,6 +53,7 @@ from ray_tpu.devtools.aio_lint import (
 RULE_UNKNOWN = "unknown-rpc-method"
 RULE_ORPHAN = "orphan-rpc-handler"
 RULE_DRIFT = "payload-key-drift"
+RULE_TIMEOUT = "rpc-magic-timeout"
 
 _CALL_METHODS = {"call", "call_nowait", "call_cb", "push", "push_nowait"}
 _REGISTER_METHODS = {"register", "register_sync", "handler"}
@@ -61,6 +68,9 @@ class CallSite:
     # keys; None when the payload is dynamic (or **expanded).
     payload_keys: Optional[Set[str]] = None
     via: str = "call"
+    # Numeric timeout literal passed at the call site (timeout= kwarg or the
+    # third positional argument of .call), if any.
+    timeout_literal: Optional[float] = None
 
 
 @dataclass
@@ -88,6 +98,19 @@ class Inventory:
 def _const_str(node: ast.AST) -> Optional[str]:
     if isinstance(node, ast.Constant) and isinstance(node.value, str):
         return node.value
+    return None
+
+
+def _const_num(node: ast.AST) -> Optional[float]:
+    """Numeric literal (incl. unary minus), or None. Booleans excluded."""
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, (ast.USub, ast.UAdd)):
+        node = node.operand
+    if (
+        isinstance(node, ast.Constant)
+        and isinstance(node.value, (int, float))
+        and not isinstance(node.value, bool)
+    ):
+        return float(node.value)
     return None
 
 
@@ -173,9 +196,14 @@ class _FileScanner(ast.NodeVisitor):
             method = _const_str(node.args[0])
             if method is not None:
                 payload = node.args[1] if len(node.args) > 1 else None
+                timeout_literal = None
+                if attr == "call" and len(node.args) > 2:
+                    timeout_literal = _const_num(node.args[2])
                 for kw in node.keywords:
                     if kw.arg == "payload":
                         payload = kw.value
+                    elif kw.arg == "timeout":
+                        timeout_literal = _const_num(kw.value)
                 self.inv.calls.append(
                     CallSite(
                         method,
@@ -183,6 +211,7 @@ class _FileScanner(ast.NodeVisitor):
                         node.lineno,
                         _payload_keys(payload),
                         via=attr,
+                        timeout_literal=timeout_literal,
                     )
                 )
         elif attr in _REGISTER_METHODS and node.args:
@@ -335,6 +364,7 @@ def check(paths: Optional[List[str]] = None) -> List[Finding]:
         )
 
     findings.extend(_check_payload_drift(inv))
+    findings.extend(_check_magic_timeouts(inv, rpc_path))
 
     # Apply inline suppressions from the source files involved.
     sup_cache: Dict[str, Dict[int, Set[str]]] = {}
@@ -413,6 +443,38 @@ def _check_payload_drift(inv: Inventory) -> List[Finding]:
     return findings
 
 
+def _check_magic_timeouts(inv: Inventory, rpc_path: str) -> List[Finding]:
+    """Numeric ``timeout=`` literals at RPC call sites in runtime code.
+
+    Scope is ``_private/`` only (excluding rpc.py itself, whose defaults ARE
+    the mechanism): that is the production control/data plane where a magic
+    number silently diverges from the config budgets. Tests, devtools, and
+    examples legitimately pin tiny timeouts.
+    """
+    findings: List[Finding] = []
+    for c in inv.calls:
+        if c.timeout_literal is None:
+            continue
+        parts = os.path.abspath(c.path).split(os.sep)
+        if "_private" not in parts:
+            continue
+        if os.path.abspath(c.path) == rpc_path:
+            continue
+        findings.append(
+            Finding(
+                c.path,
+                c.line,
+                0,
+                RULE_TIMEOUT,
+                f"{c.via}({c.method!r}, ..., timeout={c.timeout_literal:g}) "
+                "uses a numeric literal — take the budget from "
+                "common.config (rpc_*_timeout_s) so it is tunable and "
+                "consistent with deadline propagation",
+            )
+        )
+    return findings
+
+
 def markdown_table(paths: Optional[List[str]] = None) -> str:
     """The versioned wire-protocol inventory committed to docs/."""
     from ray_tpu._private import wire
@@ -436,12 +498,19 @@ def markdown_table(paths: Optional[List[str]] = None) -> str:
         "# RPC wire-protocol inventory",
         "",
         "Generated by `python -m ray_tpu.devtools.rpc_check --markdown`.",
-        "Frames are msgpack `[msgid, kind, method, payload]`"
-        " (see `ray_tpu/_private/rpc.py`). Schemas for the starred methods",
-        "live in `ray_tpu/_private/wire.py`; the lint gate fails on drift.",
+        "Frames are msgpack `[msgid, kind, method, payload]`; requests may",
+        "carry a fifth element, the remaining deadline budget (TTL) in",
+        "seconds — the receiver reconstructs an absolute deadline from it,",
+        "sheds already-expired calls, and hands handlers the remaining",
+        "budget to pass downstream (see `ray_tpu/_private/rpc.py`). Schemas",
+        "for the starred methods live in `ray_tpu/_private/wire.py`; the",
+        "lint gate fails on drift. Retry is the method's wire retry class",
+        "consumed by `rpc.RetryableConnection`: `safe` = idempotent, retried",
+        "freely; `dedup(key)` = retried only with the msgid-stable token;",
+        "`none` = never retried.",
         "",
-        "| Method | Schema | Servers (handler) | Client call sites | Payload keys |",
-        "|---|---|---|---|---|",
+        "| Method | Schema | Retry | Servers (handler) | Client call sites | Payload keys |",
+        "|---|---|---|---|---|---|",
     ]
     for method in sorted(by_method):
         info = by_method[method]
@@ -463,9 +532,15 @@ def markdown_table(paths: Optional[List[str]] = None) -> str:
                 + [f"{k}?" for k in sorted(schema.optional)]
             ) or "(empty)"
             star = "★"
+            if schema.retry == wire.RETRY_DEDUP:
+                retry = f"dedup({schema.dedup_key})"
+            else:
+                retry = schema.retry
         else:
-            keys, star = "", ""
-        lines.append(f"| `{method}` | {star} | {servers} | {callers} | {keys} |")
+            keys, star, retry = "", "", ""
+        lines.append(
+            f"| `{method}` | {star} | {retry} | {servers} | {callers} | {keys} |"
+        )
     lines.append("")
     lines.append(
         f"{len(by_method)} methods; ★ = schema-checked "

@@ -1,0 +1,62 @@
+"""Perf regression gate: compare a ray_perf results JSON against the
+committed floors and fail (exit 1) on any metric below its floor.
+
+Usage::
+
+    python -m ray_tpu._private.ray_perf --json /tmp/perf.json
+    python benchmarks/perf_gate.py /tmp/perf.json
+
+Floors live in benchmarks/perf_floors.json next to this script; each gated
+metric records the reference rate it was set from and a ``floor`` at 70% of
+it, so the gate trips on a >30% regression. A metric present in the floors
+file but missing from the results is a failure too (a silently-dropped
+benchmark must not pass the gate).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_FLOORS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "perf_floors.json")
+
+
+def gate(results_path: str, floors_path: str = _FLOORS) -> int:
+    with open(results_path) as f:
+        results = json.load(f)
+    with open(floors_path) as f:
+        floors = json.load(f)
+
+    failures = []
+    print(f"{'metric':<28} {'measured':>12} {'floor':>12} {'reference':>12}")
+    for name, spec in floors["metrics"].items():
+        floor, ref = spec["floor"], spec["reference"]
+        measured = results.get(name)
+        if measured is None:
+            failures.append(f"{name}: missing from results")
+            print(f"{name:<28} {'MISSING':>12} {floor:>12.1f} {ref:>12.1f}")
+            continue
+        verdict = "" if measured >= floor else "  << REGRESSION"
+        print(f"{name:<28} {measured:>12.1f} {floor:>12.1f} {ref:>12.1f}{verdict}")
+        if measured < floor:
+            failures.append(
+                f"{name}: {measured:.1f}/s is below floor {floor:.1f}/s "
+                f"({measured / ref:.0%} of reference {ref:.1f}/s)"
+            )
+    if failures:
+        print("\nperf gate FAILED:", file=sys.stderr)
+        for line in failures:
+            print(f"  {line}", file=sys.stderr)
+        return 1
+    print("\nperf gate passed")
+    return 0
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("results", help="ray_perf --json output path")
+    parser.add_argument("--floors", default=_FLOORS)
+    args = parser.parse_args()
+    sys.exit(gate(args.results, args.floors))

@@ -26,6 +26,9 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     "object_store_memory_min": 64 * 1024 * 1024,
     # Worker lease / pool.
     "worker_lease_timeout_s": 60.0,
+    # Zygote fork / worker process start: how long the raylet waits for the
+    # forked pid before declaring the spawn wedged.
+    "worker_start_timeout_s": 60.0,
     "idle_worker_keep_s": 60.0,
     # How long an owner's idle leases park before returning to the raylet.
     # Bursty submitters reuse the full worker set across bursts; other
@@ -52,8 +55,14 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     # recomputed by re-running its producing task (reference:
     # object_recovery_manager.h + task_manager.cc lineage bookkeeping).
     "max_lineage_reconstruction": 3,
-    # Object transfer chunk size between nodes.
+    # Object transfer chunk size between nodes (the floor: adaptive sizing
+    # scales the chunk with the object, see adaptive_chunk_size()).
     "object_chunk_size": 8 * 1024 * 1024,
+    # Adaptive chunk cap: huge transfers use chunks up to this size so a
+    # multi-GiB object doesn't pay per-chunk drain/round-trip overhead
+    # hundreds of times. Blob frames stream chunks zero-copy, so a bigger
+    # chunk costs no extra buffering on the send side.
+    "object_chunk_size_max": 64 * 1024 * 1024,
     # Arena eviction: unpinned objects accessed within this window are never
     # evicted (their arena bytes could still be mid-read by a client).
     "object_store_eviction_grace_s": 10.0,
@@ -74,6 +83,12 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     # ray_config_def.h). IO runs off the raylet event loop so multi-GiB
     # spills never stall lease grants or RPCs.
     "max_io_workers": 4,
+    # Bounded wait for the spill/restore IO pool to drain at node shutdown
+    # (a wedged storage backend must not hang shutdown forever).
+    "io_pool_shutdown_timeout_s": 10.0,
+    # serve: how long the controller waits for a replica to acknowledge a
+    # user_config reconfigure before replacing it.
+    "serve_reconfigure_timeout_s": 30.0,
     # Create-request backpressure: how long ObjCreate waits for spill/eviction
     # to make room before failing (plasma create_request_queue.cc analog).
     "object_store_create_timeout_s": 30.0,
@@ -157,6 +172,12 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     "rpc_chunk_timeout_s": 60.0,
     # Client -> local raylet pull of a remote object (PullObject).
     "rpc_pull_timeout_s": 300.0,
+    # Bulk senders' per-chunk TCP drain wait (push_manager): a destination
+    # that keeps the socket above the high-water mark this long is wedged.
+    "rpc_drain_timeout_s": 30.0,
+    # Blocking ObjGet a puller falls back to when PullObject returned no
+    # mapping (e.g. the seal is still in flight on the owner's connection).
+    "rpc_object_get_timeout_s": 30.0,
     # Optional per-attempt cap on the retryable GCS channel: a lost reply
     # is re-issued (idempotent methods only) after this long instead of
     # riding out the caller's whole budget. 0 disables (production
@@ -212,6 +233,18 @@ class _Config:
 
 
 config = _Config()
+
+
+def adaptive_chunk_size(total_size: int) -> int:
+    """Transfer chunk size for an object of ``total_size`` bytes: the base
+    ``object_chunk_size`` for small objects, scaling with the object (about
+    a quarter of it) up to ``object_chunk_size_max``. Fewer, larger chunks
+    amortize the per-chunk drain wait and control-frame overhead; blob
+    framing keeps the send side zero-copy at any chunk size."""
+    base = config.object_chunk_size
+    cap = max(base, config.object_chunk_size_max)
+    return max(base, min(cap, total_size // 4))
+
 
 # ---------------------------------------------------------------------------
 # Fixed-point resources (reference: src/ray/common/scheduling/fixed_point.h).

@@ -108,6 +108,24 @@ import itertools as _itertools
 _fast_counter = _itertools.count(int.from_bytes(os.urandom(4), "little"))
 
 
+def _reseed_after_fork() -> None:
+    # Workers are os.fork()ed from a preloaded zygote (worker_zygote.py),
+    # which imports this module BEFORE forking: without a reseed every
+    # worker inherits the same prefix and counter position, so two workers
+    # submitting tasks draw IDENTICAL task ids — and task ids feed
+    # deterministic_object_id, so their return objects alias in the store
+    # (ObjCreate sees `exists` and the second task's output is silently the
+    # first task's bytes). Observed as flaky wrong-block delivery in the
+    # data pipeline whenever two forked workers (e.g. two streaming-split
+    # coordinators) ran near-aligned submission counts.
+    global _FAST_PREFIX, _fast_counter
+    _FAST_PREFIX = os.urandom(_ID_SIZE - 6).hex()
+    _fast_counter = _itertools.count(int.from_bytes(os.urandom(4), "little"))
+
+
+os.register_at_fork(after_in_child=_reseed_after_fork)
+
+
 def fast_unique_hex() -> str:
     """A unique 32-char hex id (16 bytes), cheap enough for per-call use."""
     return _FAST_PREFIX + (next(_fast_counter) & 0xFFFFFFFFFFFF).to_bytes(6, "little").hex()

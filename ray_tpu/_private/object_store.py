@@ -91,6 +91,14 @@ class PlasmaClient:
         # Objects this client holds (the raylet counts a hold per ObjGet and
         # will not recycle their bytes until released / disconnect).
         self.held: Dict[str, int] = {}
+        # Debounced release batch: oids queued by release() in one loop tick
+        # flush as a single ObjRelease call (value drops arrive in bursts
+        # when a task's deserialized arguments are collected together).
+        self._release_pending: set = set()
+        self._release_flush_scheduled = False
+        # Last in-flight batched-release task (tests/benchmarks await it to
+        # observe flush completion; the path itself is fire-and-forget).
+        self._release_task: Optional[asyncio.Task] = None
 
     def _arena_view(self, name: str) -> memoryview:
         seg = self._arenas.get(name)
@@ -122,7 +130,7 @@ class PlasmaClient:
         )
         if reply.get("exists"):
             return
-        self._slice(reply)[: len(payload)] = payload
+        shm.copy_into(self._slice(reply), payload)
         self.conn.push_nowait("ObjSeal", {"oid": oid})
 
     async def get(
@@ -157,7 +165,9 @@ class PlasmaClient:
         if meta.get("offset") is not None:
             self.held[oid] = self.held.get(oid, 0) + 1
             return self._slice(meta)
-        found, missing = await self.get([oid], timeout=30)
+        found, missing = await self.get(
+            [oid], timeout=config.rpc_object_get_timeout_s
+        )
         if oid in found:
             return found[oid]
         raise ObjectLostError(f"pull of {oid[:12]} failed: {missing}")
@@ -167,17 +177,32 @@ class PlasmaClient:
         await self.release_counts({oid: self.held.get(oid, 0) for oid in oids})
 
     def release(self, oid: str) -> None:
-        """Fire-and-forget single release (LRU touch + hold drop)."""
-        import asyncio
-
-        try:
-            task = rpc.spawn(self.release_many([oid]))
-        except RuntimeError:  # no running loop (sync teardown path)
+        """Fire-and-forget release (LRU touch + all-holds drop). Coalesced:
+        every release() in the same loop tick joins one debounced batch that
+        flushes as a single ObjRelease call — N value drops used to cost N
+        spawned tasks and N RPCs."""
+        self._release_pending.add(oid)
+        if self._release_flush_scheduled:
             return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:  # no running loop (sync teardown path)
+            self._release_pending.discard(oid)
+            return
+        self._release_flush_scheduled = True
+        loop.call_soon(self._flush_releases)
+
+    def _flush_releases(self) -> None:
+        self._release_flush_scheduled = False
+        pending, self._release_pending = self._release_pending, set()
+        if not pending or self.conn.closed:
+            return
+        task = rpc.spawn(self.release_many(list(pending)))
         # Retrieve any exception so a closed connection doesn't log noise.
         task.add_done_callback(
             lambda t: t.exception() if not t.cancelled() else None
         )
+        self._release_task = task
 
     async def release_counts(self, counts: Dict[str, int]) -> None:
         """Drop up to ``counts[oid]`` holds per object (value-lifetime holds:

@@ -17,7 +17,7 @@ import logging
 from typing import Dict, Tuple
 
 from ray_tpu._private import rpc
-from ray_tpu._private.common import config
+from ray_tpu._private.common import adaptive_chunk_size, config
 
 logger = logging.getLogger(__name__)
 
@@ -87,7 +87,7 @@ class PushManager:
             )
             if not start.get("needed"):
                 return  # destination already has (or is assembling) it
-            chunk = config.object_chunk_size
+            chunk = adaptive_chunk_size(size)
             sent = 0
             while sent < size:
                 n = min(chunk, size - sent)
@@ -98,16 +98,23 @@ class PushManager:
                     self.stats["inflight_chunks"],
                 )
                 try:
-                    data = bytes(r.arena.view[off + sent : off + sent + n])
-                    conn.push_nowait(
-                        "PushChunk", {"oid": oid, "offset": sent, "data": data}
+                    # Zero-copy send: the arena view goes to the transport as
+                    # a blob sidecar inside this call (the obj_holds pin
+                    # covers the synchronous write window; an unwritable
+                    # socket copies into asyncio's own buffer).
+                    conn.blob_push_nowait(
+                        "PushChunk",
+                        {"oid": oid, "offset": sent},
+                        r.arena.view[off + sent : off + sent + n],
                     )
                     # TCP backpressure: wait for the socket buffer to fall
                     # below the high-water mark before the next chunk — but
                     # bounded: a wedged destination (zero-window, stuck loop)
                     # must not pin a global chunk-budget slot forever.
                     try:
-                        await asyncio.wait_for(conn.drain(), timeout=30)
+                        await asyncio.wait_for(
+                            conn.drain(), timeout=config.rpc_drain_timeout_s
+                        )
                     except asyncio.TimeoutError:
                         await conn.close()  # dest aborts assembly on the drop
                         self._conns.pop(dest, None)

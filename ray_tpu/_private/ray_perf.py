@@ -32,6 +32,91 @@ def timeit(name: str, fn, multiplier: int = 1) -> float:
     return best
 
 
+def _bench_release_batched() -> float:
+    """Rate of plasma hold drops through the debounced release() batch:
+    one cycle takes holds on N objects (one ObjGet), queues N release()
+    calls, and awaits the single coalesced ObjRelease flush."""
+    import numpy as np
+
+    from ray_tpu._private import worker as worker_mod
+
+    n = 200
+    payload = np.zeros(256 * 1024, dtype=np.uint8)  # plasma-sized
+    refs = [ray_tpu.put(payload) for _ in range(n)]
+    oids = [r.hex() for r in refs]
+    w = worker_mod.global_worker
+    plasma = w.core.plasma
+
+    async def _cycle():
+        found, _ = await plasma.get(oids)
+        del found
+        for oid in oids:
+            plasma.release(oid)
+        await asyncio.sleep(0)  # run the call_soon flush
+        task = plasma._release_task
+        if task is not None:
+            await task
+
+    rate = timeit(
+        "batched release (200 holds)", lambda: w.run_async(_cycle(), 60), n
+    )
+    del refs
+    return rate
+
+
+def _bench_transfer_16mb() -> float:
+    """Two-node 16MB object transfers (PushChunk blob sidecar): each cycle
+    produces fresh objects on node A and consumes them on node B, so every
+    get crosses the wire."""
+    import numpy as np
+
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    store = 512 * 1024 * 1024
+    cluster = Cluster(head_node_args={"num_cpus": 1, "num_tpus": 0})
+    cluster.add_node(num_cpus=2, object_store_memory=store)
+    cluster.add_node(num_cpus=2, object_store_memory=store)
+    cluster.connect()
+    try:
+
+        @ray_tpu.remote(num_cpus=2)
+        def produce(i):
+            return np.full(16 * 1024 * 1024 // 8, float(i))
+
+        @ray_tpu.remote(num_cpus=2)
+        def consume(x):
+            return float(x[0])
+
+        nodes = [
+            n for n in ray_tpu.nodes() if n["total"].get("CPU", 0) >= 20000
+        ]
+        n1, n2 = nodes[0]["node_id"], nodes[1]["node_id"]
+        k = 3
+        seq = [0]
+
+        def cycle():
+            base = seq[0]
+            seq[0] += k
+            refs = [
+                produce.options(
+                    scheduling_strategy=NodeAffinitySchedulingStrategy(n1)
+                ).remote(base + i)
+                for i in range(k)
+            ]
+            outs = [
+                consume.options(
+                    scheduling_strategy=NodeAffinitySchedulingStrategy(n2)
+                ).remote(r)
+                for r in refs
+            ]
+            ray_tpu.get(outs, timeout=120)
+
+        return timeit("16MB cross-node transfer", cycle, k)
+    finally:
+        cluster.shutdown()
+
+
 def main(json_path: str = "") -> Dict[str, float]:
     results: Dict[str, float] = {}
     ray_tpu.init(num_cpus=8, num_tpus=0)
@@ -124,7 +209,17 @@ def main(json_path: str = "") -> Dict[str, float]:
         "16MB get (zero-copy)", lambda: [ray_tpu.get(bref) for _ in range(50)], 50
     )
 
+    big64 = np.zeros(64 * 1024 * 1024 // 8)  # 64 MB
+    results["put_64mb_per_s"] = timeit(
+        "64MB put (shm)", lambda: [ray_tpu.put(big64) for _ in range(5)], 5
+    )
+    del big64
+
+    results["release_batched_per_s"] = _bench_release_batched()
+
     ray_tpu.shutdown()
+
+    results["transfer_16mb_per_s"] = _bench_transfer_16mb()
     if json_path:
         with open(json_path, "w") as f:
             json.dump(results, f, indent=2)

@@ -28,7 +28,7 @@ from typing import Dict, List, Optional, Set, Tuple
 from ray_tpu._private import aiocheck, external_storage, rpc, shm
 from ray_tpu._private.pull_manager import PullStalled
 from ray_tpu._private.push_manager import PushManager
-from ray_tpu._private.common import ResourceSet, config
+from ray_tpu._private.common import ResourceSet, adaptive_chunk_size, config
 from ray_tpu._private.gcs import GcsClient
 from ray_tpu._private.store_core import make_store_core
 
@@ -185,7 +185,9 @@ class _Zygote:
                     except ValueError:
                         pass
                     raise
-            pid = await asyncio.wait_for(fut, timeout=60)
+            pid = await asyncio.wait_for(
+                fut, timeout=config.worker_start_timeout_s
+            )
         except BaseException:
             os.close(out_r)
             os.close(err_r)
@@ -250,6 +252,53 @@ class LeaseRequest:
         self.demand = demand
         self.payload = payload
         self.fut: asyncio.Future = asyncio.get_running_loop().create_future()
+
+
+class _ArenaChunkSink:
+    """Blob sink streaming one inbound PushChunk straight into the
+    destination arena span. Every write re-validates the assembly: the
+    condemned sweep or an abort can free (and something else reallocate)
+    the span while the blob is mid-stream, and writing on would corrupt
+    whoever reuses it. ``st`` identity is the guard — a fresh assembly for
+    the same oid has a different dict."""
+
+    __slots__ = ("raylet", "oid", "st", "pos")
+
+    def __init__(self, raylet, oid: str, st: dict, off: int, size: int):
+        self.raylet = raylet
+        self.oid = oid
+        self.st = st
+        self.pos = st["offset"] + off
+
+    def write(self, view) -> None:
+        st = self.st
+        if st is None:
+            return
+        r = self.raylet
+        if r.push_assembly.get(self.oid) is not st:
+            self.st = None  # aborted/superseded mid-blob: drop the rest
+            return
+        if self.oid in r.condemned:
+            del r.push_assembly[self.oid]
+            self.st = None
+            return
+        n = view.nbytes
+        r.arena.view[self.pos : self.pos + n] = view
+        self.pos += n
+        st["recv"] += n
+        st["last"] = time.monotonic()
+
+    def done(self, ok: bool) -> None:
+        st = self.st
+        if st is None or self.raylet.push_assembly.get(self.oid) is not st:
+            return
+        if not ok:
+            # Connection died mid-blob: the span holds a torn chunk.
+            self.raylet._abort_push_assembly(self.oid)
+            return
+        if st["recv"] >= st["size"]:
+            del self.raylet.push_assembly[self.oid]
+            rpc.spawn(self.raylet._obj_seal(None, {"oid": self.oid}))
 
 
 class Raylet:
@@ -552,7 +601,7 @@ class Raylet:
                     None,
                     lambda: self._io_pool.shutdown(wait=True, cancel_futures=True),
                 ),
-                timeout=10,
+                timeout=config.io_pool_shutdown_timeout_s,
             )
         except (asyncio.TimeoutError, RuntimeError):
             logger.warning("spill IO pool did not quiesce; abandoning threads")
@@ -602,7 +651,7 @@ class Raylet:
         s.register("FetchChunk", self._fetch_chunk)
         s.register("PushObject", self._push_object)
         s.register("PushStart", self._push_start)
-        s.register("PushChunk", self._push_chunk)
+        s.register_blob("PushChunk", self._push_chunk_sink)
         s.register("PreparePGBundles", self._prepare_pg)
         s.register("CommitPGBundles", self._commit_pg)
         s.register("ReleasePGBundles", self._release_pg)
@@ -2106,25 +2155,27 @@ class Raylet:
         }
         return {"needed": True}
 
-    async def _push_chunk(self, conn, p):
-        """Destination side: one inbound chunk (one-way message). Seals and
-        wakes waiters when the last byte lands."""
-        st = self.push_assembly.get(p["oid"])
+    def _push_chunk_sink(self, conn, p, size):
+        """Destination side: blob sink factory for one inbound chunk. The
+        chunk's bytes stream from the socket straight into the arena span at
+        the assembly's write offset (one copy, NIC->arena) instead of
+        materializing in a msgpack payload first. Returning None drains and
+        discards the blob."""
+        oid, off = p["oid"], p["offset"]
+        st = self.push_assembly.get(oid)
         if st is None:
-            return  # assembly aborted (e.g. object deleted mid-push)
+            return None  # assembly aborted (e.g. object deleted mid-push)
         if st.get("conn") != id(conn):
             # Chunk from a stale source (an aborted push's connection that
             # un-wedged after a fresh PushStart re-created the assembly):
             # counting it would seal before the live transfer's tail lands.
-            return
-        if p["oid"] in self.condemned:
+            return None
+        if oid in self.condemned:
             # Deleted mid-assembly: stop writing before the condemned sweep
             # can free the span out from under us.
-            del self.push_assembly[p["oid"]]
-            return
-        data = p["data"]
-        off = p["offset"]
-        if off != st["recv"] or off + len(data) > st["size"]:
+            del self.push_assembly[oid]
+            return None
+        if off != st["recv"] or off + size > st["size"]:
             # Out-of-order, duplicated, or over-long chunk. Writing it would
             # either punch a hole (sealing on byte count would then expose
             # uninitialized shm) or run past the span into a neighboring
@@ -2133,17 +2184,11 @@ class Raylet:
             # the next pull re-transfer from scratch.
             logger.warning(
                 "aborting push assembly of %s: chunk offset %d (expected %d, size %d)",
-                p["oid"][:12], off, st["recv"], st["size"],
+                oid[:12], off, st["recv"], st["size"],
             )
-            self._abort_push_assembly(p["oid"])
-            return
-        base = st["offset"] + off
-        self.arena.view[base : base + len(data)] = data
-        st["recv"] += len(data)
-        st["last"] = time.monotonic()
-        if st["recv"] >= st["size"]:
-            del self.push_assembly[p["oid"]]
-            await self._obj_seal(conn, {"oid": p["oid"]})
+            self._abort_push_assembly(oid)
+            return None
+        return _ArenaChunkSink(self, oid, st, off, size)
 
     def _abort_push_assembly(self, oid: str) -> None:
         """Drop a dead inbound push so the oid does not stay permanently
@@ -2259,16 +2304,26 @@ class Raylet:
                 return create
             offset = create["offset"]
             view = self.arena.view
-            chunk = config.object_chunk_size
+            chunk = adaptive_chunk_size(size)
             done = 0
             while done < size:
-                data = await remote.call(
+                n = min(chunk, size - done)
+                # Blob reply streamed straight into our arena span at the
+                # object's offset: the socket bytes land in shm with no
+                # intermediate msgpack buffer.
+                sink = rpc.SpanSink(view, offset + done)
+                await remote.call_into(
                     "FetchChunk",
-                    {"oid": oid, "offset": done, "size": min(chunk, size - done)},
+                    {"oid": oid, "offset": done, "size": n},
+                    sink,
                     timeout=config.rpc_chunk_timeout_s,
                 )
-                view[offset + done : offset + done + len(data)] = data
-                done += len(data)
+                if sink.written != n:
+                    raise rpc.RpcError(
+                        f"short FetchChunk for {oid[:12]}: "
+                        f"{sink.written}/{n} bytes at offset {done}"
+                    )
+                done += n
             await self._obj_seal(conn, {"oid": oid})
             self._add_hold(conn, oid)
             return create
@@ -2282,7 +2337,10 @@ class Raylet:
         if info is None or not info[2]:
             raise rpc.RpcError(f"object {p['oid'][:12]} not local")
         base = info[0] + p["offset"]
-        return bytes(self.arena.view[base : base + p["size"]])
+        n = p["size"]
+        # Blob reply: the arena view is written to the transport before
+        # _dispatch returns to the loop, so no hold is needed for the send.
+        return rpc.Blob({"size": n}, self.arena.view[base : base + n])
 
     # -- placement group bundles ---------------------------------------------
 

@@ -9,7 +9,8 @@ codegen step. Payloads that carry Python objects (task args, actor state)
 are cloudpickled into opaque ``bytes`` fields by the caller.
 
 Wire format: a raw msgpack stream; each message is ``[msgid, kind, method,
-payload]``. Kinds: 0=request, 1=reply, 2=error-reply, 3=push (one-way).
+payload]``. Kinds: 0=request, 1=reply, 2=error-reply, 3=push (one-way),
+4=blob (one-way when msgid==0, request otherwise), 5=blob-reply.
 Requests may carry a fifth element: the remaining deadline budget (TTL) in
 float seconds, stamped at the moment the frame is packed. The receiver
 reconstructs an absolute deadline on its own clock (``loop.time() + ttl``)
@@ -18,6 +19,18 @@ schedule holds back arrives with its budget already shrunk. msgpack is
 self-framing, so no length prefix is needed — the receiving side feeds
 whole socket chunks to a streaming Unpacker and drains every complete
 message per chunk with zero per-frame awaits.
+
+Blob sidecar frames (kinds 4/5) are the zero-copy data plane: the control
+frame is packed msgpack like any other, but its fifth element declares a
+byte length and the next N bytes on the stream are the raw payload,
+UN-packed. The sender hands ``memoryview``s straight to the transport (no
+pack copy, no join); the receiver switches the read loop into blob mode
+and streams the bytes into a *sink* — for object transfer that sink is
+the destination shm arena at the object's assigned offset, so a remote
+transfer costs one copy (socket -> arena), same as a local put. Sinks are
+chosen per method (``Server.register_blob``), per call
+(``Connection.call_into``), or default to an in-memory buffer delivered
+to the regular handler as ``payload["data"]``.
 
 Resilience (reference: retryable_grpc_client.h / gcs_rpc_client.h): every
 ``call`` with a timeout (explicit or inherited from the ambient handler
@@ -85,8 +98,111 @@ _KIND_REQ = 0
 _KIND_REP = 1
 _KIND_ERR = 2
 _KIND_PUSH = 3
+# Blob sidecar frames: the packed control message is [msgid, kind, method,
+# payload, blob_len] and the blob_len bytes that follow on the stream are raw
+# (not msgpack). kind 4 is one-way when msgid == 0 (PushChunk) and a request
+# otherwise; kind 5 is a reply whose bulk data rides as the sidecar.
+_KIND_BLOB = 4
+_KIND_BLOB_REP = 5
 
 _MAX_FRAME = 1 << 31
+
+# _flush joins adjacent small buffers into one transport.write; buffers at or
+# above this size are written individually so large blob memoryviews go to
+# the socket without an intermediate join copy.
+_WRITE_JOIN_MAX = 64 * 1024
+
+
+def _blob_buffers(blob) -> list:
+    """Normalize a blob argument (bytes/bytearray/memoryview or a list of
+    them) into a flat list of 1-D byte memoryviews."""
+    parts = [blob] if isinstance(blob, (bytes, bytearray, memoryview)) else list(blob)
+    out = []
+    for p in parts:
+        v = p if isinstance(p, memoryview) else memoryview(p)
+        if v.format != "B" or v.ndim != 1:
+            v = v.cast("B")
+        if v.nbytes:
+            out.append(v)
+    return out
+
+
+def _blob_bytes(blob) -> bytes:
+    """Materialize a blob into one stable bytes object (chaos interception:
+    a delayed/duplicated frame must not reference live arena memory)."""
+    bufs = _blob_buffers(blob)
+    if len(bufs) == 1:
+        return bytes(bufs[0])
+    return b"".join(bufs)
+
+
+class Blob:
+    """Handler return value that ships as a blob-reply frame: ``payload`` is
+    the msgpack meta, ``blob`` (bytes/memoryview or list of them) rides the
+    stream raw. The buffers are written to the transport before the send
+    call returns, so handlers may pass live arena views."""
+
+    __slots__ = ("payload", "blob")
+
+    def __init__(self, payload: Any, blob):
+        self.payload = payload
+        self.blob = blob
+
+
+class BufferSink:
+    """Default blob sink: accumulates the inbound blob into one buffer.
+    ``value()`` returns the filled bytearray without a final copy."""
+
+    __slots__ = ("_buf", "_pos")
+
+    def __init__(self, size: int):
+        self._buf = bytearray(size)
+        self._pos = 0
+
+    def write(self, view: memoryview) -> None:
+        n = view.nbytes
+        self._buf[self._pos : self._pos + n] = view
+        self._pos += n
+
+    def done(self, ok: bool) -> None:
+        pass
+
+    def value(self) -> bytearray:
+        return self._buf
+
+
+class _NullSink:
+    """Discards an unwanted blob (declined by a sink factory) so the stream
+    stays framed."""
+
+    __slots__ = ()
+
+    def write(self, view: memoryview) -> None:
+        pass
+
+    def done(self, ok: bool) -> None:
+        pass
+
+
+class SpanSink:
+    """Blob sink writing sequentially into a caller-held memoryview span
+    (e.g. an shm arena slice at an object's assigned offset)."""
+
+    __slots__ = ("view", "pos", "written")
+
+    def __init__(self, view: memoryview, pos: int = 0):
+        self.view = view
+        self.pos = pos
+        self.written = 0
+
+    def write(self, v: memoryview) -> None:
+        n = v.nbytes
+        self.view[self.pos : self.pos + n] = v
+        self.pos += n
+        self.written += n
+
+    def done(self, ok: bool) -> None:
+        pass
 
 # Fault-injection hook (ray_tpu.chaos): when set, every outbound frame from
 # this process is offered to the interceptor BEFORE packing. The interceptor
@@ -265,6 +381,15 @@ class _RpcProtocol(asyncio.Protocol):
         self.transport: Optional[asyncio.Transport] = None
         self._paused = False
         self._drain_waiters: list = []
+        # Blob receive mode: while _blob_remaining > 0 inbound bytes bypass
+        # the Unpacker and stream into _blob_sink. _fed counts bytes fed to
+        # the CURRENT Unpacker so the unconsumed tail (bytes after a blob
+        # control frame) can be recovered via unpacker.tell().
+        self._fed = 0
+        self._blob_msg: Optional[list] = None
+        self._blob_sink = None
+        self._blob_external = False
+        self._blob_remaining = 0
 
     def connection_made(self, transport) -> None:
         self.transport = transport
@@ -287,15 +412,72 @@ class _RpcProtocol(asyncio.Protocol):
         self._drain_waiters.clear()
 
     def data_received(self, data: bytes) -> None:
-        self._unpacker.feed(data)
-        on_message = self._conn._on_message
+        view = memoryview(data)
         try:
-            for msg in self._unpacker:
-                on_message(msg)
+            while True:
+                if self._blob_remaining > 0:
+                    n = view.nbytes
+                    if n <= self._blob_remaining:
+                        self._blob_sink.write(view)
+                        self._blob_remaining -= n
+                        if self._blob_remaining == 0:
+                            self._finish_blob()
+                        return
+                    self._blob_sink.write(view[: self._blob_remaining])
+                    view = view[self._blob_remaining :]
+                    self._blob_remaining = 0
+                    self._finish_blob()
+                if not view.nbytes:
+                    return
+                self._unpacker.feed(view)
+                self._fed += view.nbytes
+                switched = False
+                for msg in self._unpacker:
+                    if (
+                        isinstance(msg, (list, tuple))
+                        and len(msg) >= 5
+                        and (msg[1] == _KIND_BLOB or msg[1] == _KIND_BLOB_REP)
+                    ):
+                        # The bytes after this control frame are the raw blob
+                        # (and whatever follows it), NOT msgpack: recover the
+                        # unconsumed tail of the current chunk, discard the
+                        # Unpacker (its buffer holds those same bytes), and
+                        # switch to blob mode.
+                        tail = self._fed - self._unpacker.tell()
+                        self._unpacker = msgpack.Unpacker(
+                            raw=False, strict_map_key=False, max_buffer_size=_MAX_FRAME
+                        )
+                        self._fed = 0
+                        self._begin_blob(list(msg))
+                        view = view[view.nbytes - tail :]
+                        switched = True
+                        break
+                    self._conn._on_message(msg)
+                if not switched:
+                    return
         except Exception:
             logger.exception("rpc stream corrupted; dropping connection")
             if self.transport is not None:
                 self.transport.close()
+
+    def _begin_blob(self, msg: list) -> None:
+        size = msg[4]
+        if not isinstance(size, int) or size < 0 or size > _MAX_FRAME:
+            raise RpcError(f"invalid blob length {size!r}")
+        sink, external = self._conn._select_blob_sink(msg, size)
+        if size == 0:
+            self._conn._on_blob_complete(msg, sink, external)
+            return
+        self._blob_msg = msg
+        self._blob_sink = sink
+        self._blob_external = external
+        self._blob_remaining = size
+
+    def _finish_blob(self) -> None:
+        msg, sink, external = self._blob_msg, self._blob_sink, self._blob_external
+        self._blob_msg = None
+        self._blob_sink = None
+        self._conn._on_blob_complete(msg, sink, external)
 
 
 class Connection:
@@ -306,8 +488,16 @@ class Connection:
         handlers: Dict[str, Callable[..., Awaitable[Any]]],
         on_close: Optional[Callable[["Connection"], None]] = None,
         sync_handlers: Optional[Dict[str, Callable]] = None,
+        blob_factories: Optional[Dict[str, Callable]] = None,
     ):
         self._handlers = handlers
+        # Blob sink factories: ``factory(conn, payload, size) -> sink|None``
+        # invoked inline from the read path when a kind-4 control frame for
+        # that method arrives; None declines (the blob is drained and
+        # discarded). Shared dict from the owning Server (register_blob).
+        self._blob_factories = blob_factories if blob_factories is not None else {}
+        # Per-call blob-reply sinks (call_into), keyed by msgid.
+        self._blob_reply_sinks: Dict[int, Any] = {}
         # Sync fast-path handlers: ``fn(conn, msgid, payload)`` invoked inline
         # from data_received — no asyncio task per message. The handler must
         # not block; it replies later via ``reply_nowait``. Used for the task
@@ -344,23 +534,44 @@ class Connection:
 
     # -- write path ----------------------------------------------------------
 
-    def _pack_frame(self, msg) -> bytes:
-        """Pack one frame, stamping a request's deadline (held in-memory as
-        an absolute loop.time() instant) into the relative TTL that goes on
-        the wire. Stamping at pack time — not at call time — means a frame a
-        chaos schedule delays ships with its budget already shrunk, so the
-        receiver's reconstructed deadline stays honest."""
+    def _pack_frame(self, msg) -> list:
+        """Pack one frame into its wire buffers. For a request with a
+        deadline, the absolute loop.time() instant held in-memory is stamped
+        into the relative TTL that goes on the wire — at pack time, not call
+        time, so a frame a chaos schedule delays ships with its budget
+        already shrunk and the receiver's reconstructed deadline stays
+        honest. A blob frame packs as its control message (payload slot 4
+        rewritten to the byte length) followed by the raw buffers."""
+        kind = msg[1]
+        if kind == _KIND_BLOB or kind == _KIND_BLOB_REP:
+            buffers = _blob_buffers(msg[4])
+            total = sum(b.nbytes for b in buffers)
+            out = [_packb([msg[0], kind, msg[2], msg[3], total])]
+            out.extend(buffers)
+            return out
         if len(msg) > 4 and msg[4] is not None:
             msg = [msg[0], msg[1], msg[2], msg[3], msg[4] - self._loop.time()]
-        return _packb(msg)
+        return [_packb(msg)]
 
     def _send_nowait(self, msg) -> None:
         if self._closed:
             raise ConnectionLost("connection closed")
-        if _send_interceptor is not None and _send_interceptor(self, msg):
-            return  # consumed by fault injection (dropped/held/delayed)
-        self._out.append(self._pack_frame(msg))
-        if not self._flush_scheduled:
+        blob = msg[1] == _KIND_BLOB or msg[1] == _KIND_BLOB_REP
+        if _send_interceptor is not None:
+            if blob:
+                # Materialize before offering: a dropped/delayed/duplicated
+                # blob frame must be one atomic unit with a stable copy of
+                # the data, not a view into live (reusable) arena memory.
+                msg = [msg[0], msg[1], msg[2], msg[3], _blob_bytes(msg[4])]
+            if _send_interceptor(self, msg):
+                return  # consumed by fault injection (dropped/held/delayed)
+        self._out.extend(self._pack_frame(msg))
+        if blob:
+            # Blob buffers may be live arena views the caller only pins for
+            # the duration of this call: hand them to the transport NOW (an
+            # unwritable socket copies them into asyncio's own buffer).
+            self._flush()
+        elif not self._flush_scheduled:
             self._flush_scheduled = True
             self._loop.call_soon(self._flush)
 
@@ -370,8 +581,10 @@ class Connection:
         delay timer may outlive the link)."""
         if self._closed:
             return
-        self._out.append(self._pack_frame(msg))
-        if not self._flush_scheduled:
+        self._out.extend(self._pack_frame(msg))
+        if msg[1] == _KIND_BLOB or msg[1] == _KIND_BLOB_REP:
+            self._flush()
+        elif not self._flush_scheduled:
             self._flush_scheduled = True
             self._loop.call_soon(self._flush)
 
@@ -380,12 +593,28 @@ class Connection:
         if self._closed or not self._out:
             self._out.clear()
             return
-        if len(self._out) == 1:
-            data = self._out[0]
-        else:
-            data = b"".join(self._out)
-        self._out.clear()
-        self._protocol.transport.write(data)
+        out = self._out
+        self._out = []
+        transport = self._protocol.transport
+        if len(out) == 1:
+            transport.write(out[0])
+            return
+        # Join adjacent small frames into one write (the control-plane hot
+        # path: one syscall per loop tick); large blob memoryviews are
+        # written individually so they reach the socket with no join copy.
+        pending: list = []
+        for item in out:
+            if isinstance(item, memoryview) and item.nbytes >= _WRITE_JOIN_MAX:
+                if pending:
+                    transport.write(
+                        pending[0] if len(pending) == 1 else b"".join(pending)
+                    )
+                    pending.clear()
+                transport.write(item)
+            else:
+                pending.append(item)
+        if pending:
+            transport.write(pending[0] if len(pending) == 1 else b"".join(pending))
 
     async def drain(self) -> None:
         """Wait until the transport's write buffer is below the high-water
@@ -472,6 +701,62 @@ class Connection:
     async def push(self, method: str, payload: Any = None) -> None:
         self._send_nowait([0, _KIND_PUSH, method, payload])
 
+    # -- blob sidecar frames -------------------------------------------------
+
+    def blob_push_nowait(self, method: str, payload: Any, blob) -> None:
+        """One-way blob frame: msgpack control message + raw sidecar bytes.
+        ``blob`` is bytes/memoryview or a list of them; the buffers are
+        handed to the transport before this returns (scatter-gather, no pack
+        copy), so live arena views are safe to pass. Loop thread only."""
+        self._send_nowait([0, _KIND_BLOB, method, payload, blob])
+
+    async def call_with_blob(
+        self, method: str, payload: Any, blob, timeout: Optional[float] = None
+    ):
+        """Issue a request whose bulk data rides as a blob sidecar instead
+        of inside the msgpack payload; awaits the reply like ``call``. The
+        receiver's sink factory (or the default buffer, delivered to the
+        handler as ``payload["data"]``) consumes the bytes."""
+        msgid = next(self._msgid)
+        fut = self._loop.create_future()
+        fut.rpc_msgid = msgid
+        self._pending[msgid] = fut
+        try:
+            self._send_nowait([msgid, _KIND_BLOB, method, payload, blob])
+        except ConnectionLost:
+            self._pending.pop(msgid, None)
+            raise
+        try:
+            if timeout is None:
+                return await fut
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            if fut.cancelled():
+                self._pending.pop(msgid, None)
+
+    async def call_into(
+        self, method: str, payload: Any, sink, timeout: Optional[float] = None
+    ):
+        """Issue a request whose reply may carry a blob sidecar streamed
+        into ``sink`` (``write(view)`` per chunk, ``done(ok)`` at the end).
+        Returns the reply's meta payload once the blob has fully landed.
+        An error reply or a plain reply resolves without touching the
+        sink."""
+        deadline = self._effective_deadline(timeout)
+        fut = self.call_nowait(method, payload, deadline=deadline)
+        msgid = fut.rpc_msgid
+        self._blob_reply_sinks[msgid] = sink
+        try:
+            if deadline is None:
+                return await fut
+            return await asyncio.wait_for(
+                fut, max(0.0, deadline - self._loop.time())
+            )
+        finally:
+            self._blob_reply_sinks.pop(msgid, None)
+            if fut.cancelled():
+                self._pending.pop(msgid, None)
+
     # -- read path -----------------------------------------------------------
 
     def reply_nowait(self, msgid: int, method: str, payload: Any) -> None:
@@ -486,6 +771,62 @@ class Connection:
             self._send_nowait([msgid, _KIND_ERR, method, err])
         except ConnectionLost:
             pass
+
+    def _select_blob_sink(self, msg: list, size: int):
+        """Pick the sink for an inbound blob; returns (sink, external).
+        ``external`` sinks (factory- or call_into-registered) own delivery;
+        the default BufferSink's contents are instead injected into the
+        payload as ``data`` and dispatched like a normal message."""
+        msgid, kind, method, payload = msg[0], msg[1], msg[2], msg[3]
+        if kind == _KIND_BLOB_REP:
+            sink = self._blob_reply_sinks.pop(msgid, None)
+            if sink is not None:
+                return sink, True
+            return BufferSink(size), False
+        factory = self._blob_factories.get(method)
+        if factory is not None:
+            try:
+                sink = factory(self, payload, size)
+            except Exception:
+                logger.exception("blob sink factory for %s failed", method)
+                sink = None
+            if sink is not None:
+                return sink, True
+            return _NullSink(), True  # declined: drain and discard
+        return BufferSink(size), False
+
+    def _on_blob_complete(self, msg: list, sink, external: bool) -> None:
+        """A blob fully landed: finish the sink, then deliver the control
+        message (resolve the pending call for a blob reply; dispatch the
+        handler for a blob push/request)."""
+        msgid, kind, method, payload = msg[0], msg[1], msg[2], msg[3]
+        try:
+            sink.done(True)
+        except Exception:
+            logger.exception("blob sink completion for %s failed", method)
+        if kind == _KIND_BLOB_REP:
+            if not external and isinstance(payload, dict):
+                payload["data"] = sink.value()
+            cb = self._cb_pending.pop(msgid, None)
+            if cb is not None:
+                try:
+                    cb(payload, None)
+                except Exception:
+                    logger.exception("inline reply callback failed")
+                return
+            fut = self._pending.pop(msgid, None)
+            if fut is not None and not fut.done():
+                fut.set_result(payload)
+            return
+        if external:
+            # The sink consumed the data plane; only a request (msgid != 0)
+            # still needs its handler to produce a reply.
+            if msgid:
+                spawn(self._dispatch(msgid, method, payload))
+            return
+        if isinstance(payload, dict):
+            payload["data"] = sink.value()
+        spawn(self._dispatch(msgid or None, method, payload))
 
     def _on_message(self, msg) -> None:
         msgid, kind, method, payload = msg[0], msg[1], msg[2], msg[3]
@@ -569,7 +910,16 @@ class Connection:
             return
         if msgid is not None:
             try:
-                self._send_nowait([msgid, _KIND_REP, method, result])
+                if isinstance(result, Blob):
+                    # Blob reply: no awaits between the handler returning its
+                    # (possibly arena-backed) views and the transport write
+                    # inside _send_nowait, so the span cannot be recycled
+                    # under the send.
+                    self._send_nowait(
+                        [msgid, _KIND_BLOB_REP, method, result.payload, result.blob]
+                    )
+                else:
+                    self._send_nowait([msgid, _KIND_REP, method, result])
             except ConnectionLost:
                 pass
 
@@ -609,6 +959,26 @@ class Connection:
             return
         self._closed = True
         self._out.clear()
+        # Fail the mid-stream blob (the sink may hold a partially-written
+        # arena span: done(False) lets it abort/quarantine) and any sinks
+        # still waiting for a blob reply.
+        proto = self._protocol
+        sink = proto._blob_sink
+        if sink is not None:
+            proto._blob_sink = None
+            proto._blob_msg = None
+            proto._blob_remaining = 0
+            try:
+                sink.done(False)
+            except Exception:
+                logger.exception("blob sink teardown failed")
+        if self._blob_reply_sinks:
+            sinks, self._blob_reply_sinks = self._blob_reply_sinks, {}
+            for s in sinks.values():
+                try:
+                    s.done(False)
+                except Exception:
+                    logger.exception("blob reply sink teardown failed")
         for fut in self._pending.values():
             if not fut.done():
                 fut.set_exception(ConnectionLost("connection closed"))
@@ -650,6 +1020,7 @@ class Server:
         self._port = port
         self._handlers: Dict[str, Callable] = {}
         self._sync_handlers: Dict[str, Callable] = {}
+        self._blob_factories: Dict[str, Callable] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self.connections: set = set()
         self._on_disconnect: Optional[Callable[[Connection], None]] = None
@@ -668,6 +1039,15 @@ class Server:
         """Register a sync fast-path handler ``fn(conn, msgid, payload)``."""
         self._sync_handlers[name] = fn
 
+    def register_blob(self, name: str, factory: Callable) -> None:
+        """Register a blob sink factory ``factory(conn, payload, size) ->
+        sink | None`` for inbound kind-4 frames of this method. The factory
+        runs inline from the read path; returning None drains and discards
+        the blob. The sink's ``write(view)`` is called per streamed chunk
+        (the view is transient — copy it) and ``done(ok)`` once on full
+        arrival (ok=True) or connection teardown (ok=False)."""
+        self._blob_factories[name] = factory
+
     def on_disconnect(self, fn: Callable[[Connection], None]) -> None:
         self._on_disconnect = fn
 
@@ -676,6 +1056,7 @@ class Server:
             self._handlers,
             on_close=self._conn_closed,
             sync_handlers=self._sync_handlers,
+            blob_factories=self._blob_factories,
         )
         self.connections.add(conn)
         return conn._protocol
@@ -739,6 +1120,7 @@ async def connect(
     retry_interval: Optional[float] = None,
     sync_handlers: Optional[Dict[str, Callable]] = None,
     policy: Optional[RetryPolicy] = None,
+    blob_factories: Optional[Dict[str, Callable]] = None,
 ) -> Connection:
     """Dial a server, retrying with jittered exponential backoff while it
     boots. Returns a duplex Connection.
@@ -774,7 +1156,9 @@ async def connect(
             # NB: keep the caller's dict object (even if currently empty) so
             # handlers registered later are visible on this connection.
             conn = Connection(
-                handlers if handlers is not None else {}, sync_handlers=sync_handlers
+                handlers if handlers is not None else {},
+                sync_handlers=sync_handlers,
+                blob_factories=blob_factories,
             )
             conn.remote_addr = (host, port)
             if uds is not None and os.path.exists(uds):

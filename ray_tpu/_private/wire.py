@@ -1,7 +1,8 @@
 """Wire-protocol schema registry for the msgpack RPC layer.
 
 Frames on the wire are ``[msgid, kind, method, payload]`` — requests may
-carry a fifth element, the remaining deadline budget in seconds (rpc.py) —
+carry a fifth element, the remaining deadline budget in seconds (rpc.py),
+and blob frames (kinds 4/5) carry the sidecar byte length there instead —
 and the payloads are plain msgpack dicts. This registry is the single
 versioned description of the payload shape for the high-traffic message
 types: each entry declares the keys a producer must send (``required``),
@@ -59,20 +60,36 @@ RETRY_NONE = "none"
 _RETRY_CLASSES = (RETRY_SAFE, RETRY_DEDUP, RETRY_NONE)
 
 
+_BLOB_DIRECTIONS = (None, "push", "request", "reply")
+
+
 @dataclass(frozen=True)
 class WireSchema:
-    """Payload-key contract and retry class for one RPC method."""
+    """Payload-key contract and retry class for one RPC method.
+
+    ``blob`` marks methods whose bulk bytes travel as a blob sidecar frame
+    (kinds 4/5 in rpc.py) instead of a msgpack field: the control frame's
+    payload slot carries the declared byte length and the raw bytes follow
+    on the stream. Values: ``"push"`` (one-way kind-4 blob to the handler),
+    ``"request"`` (kind-4 blob with a msgid, handler sees the bytes as
+    ``p["data"]``), ``"reply"`` (the handler returns ``rpc.Blob`` and the
+    bytes stream into the caller's registered sink). ``None`` = plain
+    control frames only.
+    """
 
     required: FrozenSet[str] = frozenset()
     optional: FrozenSet[str] = frozenset()
     retry: str = RETRY_NONE
     dedup_key: Optional[str] = None
+    blob: Optional[str] = None
 
     def __post_init__(self):
         if self.retry not in _RETRY_CLASSES:
             raise ValueError(f"unknown retry class {self.retry!r}")
         if self.retry == RETRY_DEDUP and not self.dedup_key:
             raise ValueError("RETRY_DEDUP requires a dedup_key")
+        if self.blob not in _BLOB_DIRECTIONS:
+            raise ValueError(f"unknown blob direction {self.blob!r}")
 
 
 def _s(
@@ -80,8 +97,11 @@ def _s(
     optional: Iterable[str] = (),
     retry: str = RETRY_NONE,
     dedup_key: Optional[str] = None,
+    blob: Optional[str] = None,
 ) -> WireSchema:
-    return WireSchema(frozenset(required), frozenset(optional), retry, dedup_key)
+    return WireSchema(
+        frozenset(required), frozenset(optional), retry, dedup_key, blob
+    )
 
 
 # The top message types by control/data-plane traffic. Methods not listed
@@ -151,7 +171,15 @@ SCHEMAS: Dict[str, WireSchema] = {
     "PushStart": _s(
         ["oid", "size"], retry=RETRY_DEDUP, dedup_key="oid"
     ),
-    "PushChunk": _s(["oid", "offset", "data"]),
+    # Blob-sidecar data plane: the chunk bytes are NOT a payload key — they
+    # follow the control frame on the stream. Blob calls are never
+    # transparently retried (the sink may be a live arena span).
+    "PushChunk": _s(["oid", "offset"], blob="push"),
+    "FetchChunk": _s(["oid", "offset", "size"], blob="reply"),
+    # -- ray-client plane ----------------------------------------------------
+    # Small puts send "payload" inline; large puts ship the serialized
+    # region as a kind-4 blob which the server reads back as "data".
+    "CPut": _s([], ["payload", "data"], blob="request"),
     # -- logs / observability ------------------------------------------------
     "GetLog": _s(
         [], ["filename", "worker_id", "stream", "tail"], retry=RETRY_SAFE

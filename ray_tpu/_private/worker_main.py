@@ -187,7 +187,9 @@ class Executor:
             result = fn(*args, **kwargs)
             serialized = serialization.serialize(result)
             if serialized.total_size <= config.max_direct_call_object_size:
-                return (0, serialized.to_bytes())
+                # bytes() wrap: the C++ side reads the payload with
+                # PyBytes_AsStringAndSize, which rejects bytearray.
+                return (0, bytes(serialized.to_bytes()))
             # Large return: plasma write via the worker loop, then the same
             # returns descriptor the RPC path uses.
             oid = return_object_ids(tid.decode(), 1)[0]
@@ -458,17 +460,21 @@ class Executor:
         return out
 
     def _error_payload(self, exc: BaseException) -> bytes:
+        # Exact bytes required: this payload can cross the native fastpath
+        # channel (PyBytes_AsStringAndSize rejects bytearray).
         tb = traceback.format_exc()
         try:
             exc.task_traceback = tb  # best effort annotation
         except Exception:
             pass
         try:
-            return serialization.serialize(exc).to_bytes()
+            return bytes(serialization.serialize(exc).to_bytes())
         except Exception:
-            return serialization.serialize(
-                TaskError(RuntimeError(repr(exc)), traceback_str=tb)
-            ).to_bytes()
+            return bytes(
+                serialization.serialize(
+                    TaskError(RuntimeError(repr(exc)), traceback_str=tb)
+                ).to_bytes()
+            )
 
     # -- normal tasks --------------------------------------------------------
 

@@ -29,6 +29,20 @@ logger = logging.getLogger(__name__)
 _KIND_TO_CLASS = {0: "request", 1: "reply", 2: "reply", 3: "push"}
 
 
+def _frame_class(msg: list) -> str:
+    """Map a frame to its fault class. Blob frames (kinds 4/5) carry a raw
+    byte sidecar but classify like their control twin: a kind-4 blob with
+    msgid 0 is a one-way push, with a msgid it is a request; kind 5 is a
+    reply. The rpc layer materializes the sidecar before offering the frame
+    here, so drop/delay/dup treat control frame + payload as ONE unit."""
+    kind = msg[1]
+    if kind == 4:
+        return "push" if not msg[0] else "request"
+    if kind == 5:
+        return "reply"
+    return _KIND_TO_CLASS.get(kind, "request")
+
+
 class ChaosInterceptor:
     """Applies a schedule's decisions to outbound frames.
 
@@ -60,7 +74,7 @@ class ChaosInterceptor:
         """Return True when the frame was consumed (rpc must not send it)."""
         try:
             method = msg[2]
-            frame_class = _KIND_TO_CLASS.get(msg[1], "request")
+            frame_class = _frame_class(msg)
         except Exception:
             return False
         spec = self._match(method, frame_class)

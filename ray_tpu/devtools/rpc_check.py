@@ -7,9 +7,11 @@ key fails at runtime (or worse, silently returns ``None`` from ``p.get``).
 This pass makes the wire protocol checkable at lint time:
 
 1. **Method inventory** — every literal method string at a
-   ``call``/``call_nowait``/``call_cb``/``push``/``push_nowait`` call site is
-   cross-checked against every handler registration
-   (``Server.register``/``register_sync``, ``@server.handler(...)``, literal
+   ``call``/``call_nowait``/``call_cb``/``push``/``push_nowait`` call site —
+   plus the blob-sidecar sends ``blob_push_nowait``/``call_with_blob``/
+   ``call_into`` — is cross-checked against every handler registration
+   (``Server.register``/``register_sync``/``register_blob``,
+   ``@server.handler(...)``, literal
    ``handlers={...}`` dicts passed to ``rpc.connect``/``Connection``, and
    ``_handlers["X"] = fn`` / ``_handlers.setdefault("X", fn)``). Call sites
    naming a method no server registers are errors; registered handlers no
@@ -20,7 +22,9 @@ This pass makes the wire protocol checkable at lint time:
    (``p["k"]`` / ``p.get("k")`` on the payload parameter) must only touch
    declared keys.
 3. **Magic timeouts** — runtime code under ``_private/`` must not pass a
-   numeric ``timeout=`` literal at a ``.call(...)`` site; budgets come from
+   numeric ``timeout=`` literal at a ``.call(...)`` site, nor a numeric
+   literal of >= 10 s to ``asyncio.wait_for`` (that magnitude is a deadline
+   *budget*, not a cleanup grace wait); budgets come from
    ``common.config`` (the ``rpc_*_timeout_s`` knobs) so they are tunable,
    greppable, and consistent with the resilience layer's deadline
    propagation. Tests, devtools, and examples may use literals.
@@ -55,8 +59,23 @@ RULE_ORPHAN = "orphan-rpc-handler"
 RULE_DRIFT = "payload-key-drift"
 RULE_TIMEOUT = "rpc-magic-timeout"
 
-_CALL_METHODS = {"call", "call_nowait", "call_cb", "push", "push_nowait"}
-_REGISTER_METHODS = {"register", "register_sync", "handler"}
+_CALL_METHODS = {
+    "call",
+    "call_nowait",
+    "call_cb",
+    "push",
+    "push_nowait",
+    # Blob-sidecar sends (rpc.py kinds 4/5): same (method, payload, ...)
+    # shape, so method-name and payload-key checking apply unchanged.
+    "blob_push_nowait",
+    "call_with_blob",
+    "call_into",
+}
+_REGISTER_METHODS = {"register", "register_sync", "handler", "register_blob"}
+# asyncio.wait_for literals at or above this many seconds are deadline
+# *budgets* (drain windows, fallback gets, spawn waits) and must come from
+# config; shorter literals are bounded cleanup/grace waits and stay inline.
+_WAIT_FOR_BUDGET_S = 10.0
 
 
 @dataclass
@@ -93,6 +112,8 @@ class Inventory:
     # (``_call_gcs("ListActors")``), so "no other literal mentions this
     # method" is the actual dead-handler signal.
     str_literals: Set[str] = field(default_factory=set)
+    # asyncio.wait_for(..., <numeric literal>) sites: (path, line, seconds).
+    wait_for_literals: List[Tuple[str, int, float]] = field(default_factory=list)
 
 
 def _const_str(node: ast.AST) -> Optional[str]:
@@ -153,6 +174,10 @@ class _FileScanner(ast.NodeVisitor):
         if not args:
             return
         pname = args[-1].arg
+        if pname == "size" and len(args) >= 2:
+            # Blob sink factory shape ``(conn, p, size)`` (register_blob):
+            # the payload is the second-to-last parameter.
+            pname = args[-2].arg
         if pname in ("self", "conn"):
             return
         keys: Set[str] = set()
@@ -223,6 +248,19 @@ class _FileScanner(ast.NodeVisitor):
                 self.inv.regs.append(
                     Registration(method, self.path, node.lineno, handler, attr)
                 )
+        elif (
+            attr == "wait_for"
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id == "asyncio"
+        ):
+            t = None
+            if len(node.args) > 1:
+                t = _const_num(node.args[1])
+            for kw in node.keywords:
+                if kw.arg == "timeout":
+                    t = _const_num(kw.value)
+            if t is not None:
+                self.inv.wait_for_literals.append((self.path, node.lineno, t))
         elif attr == "setdefault" and len(node.args) == 2:
             # GcsClient-style: conn._handlers.setdefault("Pub", self._on_pub)
             if self._targets_handlers_dict(fn.value):
@@ -452,13 +490,13 @@ def _check_magic_timeouts(inv: Inventory, rpc_path: str) -> List[Finding]:
     examples legitimately pin tiny timeouts.
     """
     findings: List[Finding] = []
+
+    def _in_scope(path: str) -> bool:
+        p = os.path.abspath(path)
+        return "_private" in p.split(os.sep) and p != rpc_path
+
     for c in inv.calls:
-        if c.timeout_literal is None:
-            continue
-        parts = os.path.abspath(c.path).split(os.sep)
-        if "_private" not in parts:
-            continue
-        if os.path.abspath(c.path) == rpc_path:
+        if c.timeout_literal is None or not _in_scope(c.path):
             continue
         findings.append(
             Finding(
@@ -470,6 +508,21 @@ def _check_magic_timeouts(inv: Inventory, rpc_path: str) -> List[Finding]:
                 "uses a numeric literal — take the budget from "
                 "common.config (rpc_*_timeout_s) so it is tunable and "
                 "consistent with deadline propagation",
+            )
+        )
+    for path, line, t in inv.wait_for_literals:
+        if t < _WAIT_FOR_BUDGET_S or not _in_scope(path):
+            continue
+        findings.append(
+            Finding(
+                path,
+                line,
+                0,
+                RULE_TIMEOUT,
+                f"asyncio.wait_for(..., {t:g}) uses a numeric literal of "
+                f">= {_WAIT_FOR_BUDGET_S:g}s — that is a deadline budget; "
+                "take it from common.config so it is tunable (short "
+                "cleanup/grace waits are exempt)",
             )
         )
     return findings
@@ -502,15 +555,21 @@ def markdown_table(paths: Optional[List[str]] = None) -> str:
         "carry a fifth element, the remaining deadline budget (TTL) in",
         "seconds — the receiver reconstructs an absolute deadline from it,",
         "sheds already-expired calls, and hands handlers the remaining",
-        "budget to pass downstream (see `ray_tpu/_private/rpc.py`). Schemas",
+        "budget to pass downstream (see `ray_tpu/_private/rpc.py`). Blob",
+        "frames (kinds 4 and 5) put the sidecar byte length in the fifth",
+        "slot instead and stream that many raw bytes after the control",
+        "frame — the data plane's zero-copy path. Schemas",
         "for the starred methods live in `ray_tpu/_private/wire.py`; the",
         "lint gate fails on drift. Retry is the method's wire retry class",
         "consumed by `rpc.RetryableConnection`: `safe` = idempotent, retried",
         "freely; `dedup(key)` = retried only with the msgid-stable token;",
-        "`none` = never retried.",
+        "`none` = never retried. Blob is the sidecar direction: `push` =",
+        "one-way kind-4 blob into a registered sink, `request` = kind-4",
+        "blob the handler reads as `p[\"data\"]`, `reply` = the handler",
+        "returns `rpc.Blob` and the caller's sink receives the bytes.",
         "",
-        "| Method | Schema | Retry | Servers (handler) | Client call sites | Payload keys |",
-        "|---|---|---|---|---|---|",
+        "| Method | Schema | Retry | Blob | Servers (handler) | Client call sites | Payload keys |",
+        "|---|---|---|---|---|---|---|",
     ]
     for method in sorted(by_method):
         info = by_method[method]
@@ -536,10 +595,12 @@ def markdown_table(paths: Optional[List[str]] = None) -> str:
                 retry = f"dedup({schema.dedup_key})"
             else:
                 retry = schema.retry
+            blob = schema.blob or "—"
         else:
-            keys, star, retry = "", "", ""
+            keys, star, retry, blob = "", "", "", ""
         lines.append(
-            f"| `{method}` | {star} | {retry} | {servers} | {callers} | {keys} |"
+            f"| `{method}` | {star} | {retry} | {blob} | {servers} | "
+            f"{callers} | {keys} |"
         )
     lines.append("")
     lines.append(

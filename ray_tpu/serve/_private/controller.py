@@ -20,6 +20,7 @@ from typing import Any, Dict, List, Optional
 import cloudpickle
 
 from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.common import config as _config
 from ray_tpu.serve._private.common import (
     ApplicationStatus,
     DeploymentID,
@@ -453,7 +454,10 @@ class ServeController:
                 refs = await core.submit_actor_task(
                     rec.actor_id, "reconfigure", (user_config,), {}, num_returns=1
                 )
-                await asyncio.wait_for(core.get_objects(refs[0], timeout=None), 30)
+                await asyncio.wait_for(
+                    core.get_objects(refs[0], timeout=None),
+                    _config.serve_reconfigure_timeout_s,
+                )
             except Exception as e:
                 logger.warning(
                     "reconfigure of replica %s failed: %r; replacing",

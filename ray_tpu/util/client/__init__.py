@@ -16,7 +16,7 @@ import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import rpc, serialization
-from ray_tpu._private.common import GetTimeoutError, RayTpuError
+from ray_tpu._private.common import GetTimeoutError, RayTpuError, config
 from ray_tpu.util.client.common import ClientObjectRef
 
 __all__ = ["ClientContext", "ClientObjectRef", "connect"]
@@ -110,7 +110,18 @@ class ClientContext:
 
     def put(self, value: Any) -> ClientObjectRef:
         payload = serialization.serialize(value).to_bytes()
-        reply = self._run(self.conn.call("CPut", {"payload": payload}), timeout=300)
+        if len(payload) > config.max_direct_call_object_size:
+            # Large values ride as a blob sidecar: the serialized region goes
+            # to the socket as raw bytes (no msgpack re-pack of the payload)
+            # and lands server-side as p["data"].
+            reply = self._run(
+                self.conn.call_with_blob("CPut", {}, payload, timeout=300),
+                timeout=310,
+            )
+        else:
+            reply = self._run(
+                self.conn.call("CPut", {"payload": payload}), timeout=300
+            )
         return ClientObjectRef(reply["oid"], tuple(reply["owner_addr"]), self)
 
     def get(self, refs, timeout: Optional[float] = None):

@@ -169,7 +169,9 @@ class ClientServer:
         sess = self._session(conn)
         core = sess.core
         oid = ObjectID.from_random().hex()
-        payload = p["payload"]
+        # Small puts carry "payload" inline in the control frame; large puts
+        # arrive as a blob sidecar injected by the RPC layer as "data".
+        payload = p["data"] if "data" in p else p["payload"]
         if len(payload) <= config.max_direct_call_object_size:
             core.memory_store.put_inline(oid, payload)
         else:

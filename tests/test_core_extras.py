@@ -285,3 +285,31 @@ def test_pull_manager_priority_and_quota():
         }
 
     asyncio.run(scenario())
+
+
+def test_fast_id_state_reseeds_after_fork():
+    """Forked workers must not inherit the zygote's fast-id stream: shared
+    prefix + counter makes two workers draw identical task ids, whose
+    deterministic return-object ids then alias in the object store (the
+    second task's output silently becomes the first task's bytes)."""
+    import os
+
+    from ray_tpu._private import ids
+
+    r, w = os.pipe()
+    pid = os.fork()
+    if pid == 0:  # child
+        try:
+            os.write(w, ids.fast_unique_hex().encode())
+        finally:
+            os._exit(0)
+    os.close(w)
+    _, status = os.waitpid(pid, 0)
+    assert status == 0
+    child = os.read(r, 64).decode()
+    os.close(r)
+    parent = ids.fast_unique_hex()
+    assert len(child) == 32 and len(parent) == 32
+    # The 20-hex-char random prefix must differ post-fork (1 in 16^20
+    # chance of a false pass by collision).
+    assert child[:20] != parent[:20]

@@ -4,7 +4,7 @@
 PYTHON ?= python
 SANITIZER ?= address
 
-.PHONY: lint test sanitize wire-docs protocols build chaos
+.PHONY: lint test sanitize wire-docs protocols build chaos loadgen
 
 lint:
 	$(PYTHON) -m ray_tpu.devtools.lint
@@ -46,6 +46,12 @@ protocols:
 # Deterministic fault injection (docs/chaos.md). SEEDS seeds per scenario;
 # failing seeds land in chaos_corpus.jsonl for replay. The latency suite
 # exercises the RPC resilience layer (docs/resilience.md) over fewer seeds.
+# Serve load harness (docs/serving.md): closed-loop calibration plus a 5x
+# open-loop overload phase against a local deployment; exits nonzero if an
+# admitted request overruns its deadline.
+loadgen:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m ray_tpu.loadgen --smoke
+
 SEEDS ?= 20
 LATENCY_SEEDS ?= 10
 chaos:

@@ -1,16 +1,22 @@
-"""Perf regression gate: compare a ray_perf results JSON against the
-committed floors and fail (exit 1) on any metric below its floor.
+"""Perf regression gate: compare results JSONs against the committed floors
+and fail (exit 1) on any metric outside its bounds.
 
 Usage::
 
     python -m ray_tpu._private.ray_perf --json /tmp/perf.json
-    python benchmarks/perf_gate.py /tmp/perf.json
+    python -m ray_tpu.loadgen --smoke --json /tmp/serve.json
+    python benchmarks/perf_gate.py /tmp/perf.json /tmp/serve.json
+
+Multiple results files are shallow-merged (later files win on key
+collisions) so the core-runtime and serving harnesses gate together.
 
 Floors live in benchmarks/perf_floors.json next to this script; each gated
 metric records the reference rate it was set from and a ``floor`` at 70% of
-it, so the gate trips on a >30% regression. A metric present in the floors
-file but missing from the results is a failure too (a silently-dropped
-benchmark must not pass the gate).
+it, so the gate trips on a >30% regression. Latency-style metrics where
+lower is better carry a ``ceiling`` instead (measured must stay at or
+below it). A metric present in the floors file but missing from the
+results is a failure too (a silently-dropped benchmark must not pass the
+gate).
 """
 
 from __future__ import annotations
@@ -19,32 +25,49 @@ import argparse
 import json
 import os
 import sys
+from typing import List
 
 _FLOORS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "perf_floors.json")
 
 
-def gate(results_path: str, floors_path: str = _FLOORS) -> int:
-    with open(results_path) as f:
-        results = json.load(f)
+def gate(results_paths: List[str], floors_path: str = _FLOORS) -> int:
+    if isinstance(results_paths, str):  # back-compat: single-path callers
+        results_paths = [results_paths]
+    results = {}
+    for path in results_paths:
+        with open(path) as f:
+            results.update(json.load(f))
     with open(floors_path) as f:
         floors = json.load(f)
 
     failures = []
-    print(f"{'metric':<28} {'measured':>12} {'floor':>12} {'reference':>12}")
+    print(f"{'metric':<28} {'measured':>12} {'bound':>12} {'reference':>12}")
     for name, spec in floors["metrics"].items():
-        floor, ref = spec["floor"], spec["reference"]
+        ref = spec["reference"]
+        ceiling = spec.get("ceiling")
+        floor = spec.get("floor")
+        bound = ceiling if ceiling is not None else floor
         measured = results.get(name)
         if measured is None:
             failures.append(f"{name}: missing from results")
-            print(f"{name:<28} {'MISSING':>12} {floor:>12.1f} {ref:>12.1f}")
+            print(f"{name:<28} {'MISSING':>12} {bound:>12.1f} {ref:>12.1f}")
             continue
-        verdict = "" if measured >= floor else "  << REGRESSION"
-        print(f"{name:<28} {measured:>12.1f} {floor:>12.1f} {ref:>12.1f}{verdict}")
-        if measured < floor:
-            failures.append(
-                f"{name}: {measured:.1f}/s is below floor {floor:.1f}/s "
-                f"({measured / ref:.0%} of reference {ref:.1f}/s)"
-            )
+        if ceiling is not None:
+            ok = measured <= ceiling
+            if not ok:
+                failures.append(
+                    f"{name}: {measured:.1f} is above ceiling {ceiling:.1f} "
+                    f"({measured / ref:.0%} of reference {ref:.1f})"
+                )
+        else:
+            ok = measured >= floor
+            if not ok:
+                failures.append(
+                    f"{name}: {measured:.1f} is below floor {floor:.1f} "
+                    f"({measured / ref:.0%} of reference {ref:.1f})"
+                )
+        verdict = "" if ok else "  << REGRESSION"
+        print(f"{name:<28} {measured:>12.1f} {bound:>12.1f} {ref:>12.1f}{verdict}")
     if failures:
         print("\nperf gate FAILED:", file=sys.stderr)
         for line in failures:
@@ -56,7 +79,12 @@ def gate(results_path: str, floors_path: str = _FLOORS) -> int:
 
 if __name__ == "__main__":
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("results", help="ray_perf --json output path")
+    parser.add_argument(
+        "results",
+        nargs="+",
+        help="results JSON path(s): ray_perf --json and/or loadgen --smoke "
+        "--json output; merged before gating",
+    )
     parser.add_argument("--floors", default=_FLOORS)
     args = parser.parse_args()
     sys.exit(gate(args.results, args.floors))

@@ -89,6 +89,37 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     # serve: how long the controller waits for a replica to acknowledge a
     # user_config reconfigure before replacing it.
     "serve_reconfigure_timeout_s": 30.0,
+    # serve: default end-to-end request budget the proxy stamps on ingress
+    # requests (overridable per request via the serve-request-timeout-s
+    # header). Rides the RPC TTL frames, so every downstream hop shrinks it.
+    "serve_request_timeout_s": 60.0,
+    # serve: default per-deployment router queue-depth cap (requests waiting
+    # for a replica slot). Overflow sheds immediately with a typed
+    # DeploymentOverloadedError, bounding memory under open-loop storms.
+    # Per-deployment override: DeploymentConfig.max_queued_requests.
+    "serve_max_queued_requests": 200,
+    # serve: EWMA smoothing factor for the router's per-deployment service
+    # time estimate (admission control sheds requests whose remaining
+    # deadline budget cannot cover the estimate).
+    "serve_admission_ewma_alpha": 0.2,
+    # serve: admission safety factor — a request is shed unless its
+    # remaining budget >= estimate * factor, so near-deadline requests
+    # don't burn a replica slot only to be cut at the wire deadline.
+    "serve_admission_safety_factor": 1.5,
+    # serve: how often each router pushes queue-depth/ongoing metrics to the
+    # controller (feeds the queue-driven autoscaler).
+    "serve_router_metrics_interval_s": 0.5,
+    # serve: how long a backpressured request waits for a freed replica slot
+    # between admission re-checks.
+    "serve_backpressure_poll_s": 0.5,
+    # serve: controller-side timeout for one replica get_metrics sample.
+    "serve_metrics_sample_timeout_s": 2.0,
+    # serve: grace added on top of graceful_shutdown_timeout_s before the
+    # controller force-kills a draining replica.
+    "serve_shutdown_grace_s": 5.0,
+    # serve: long-poll listen timeout (controller holds a listen open this
+    # long before replying empty; clients immediately re-listen).
+    "serve_long_poll_timeout_s": 30.0,
     # Create-request backpressure: how long ObjCreate waits for spill/eviction
     # to make room before failing (plasma create_request_queue.cc analog).
     "object_store_create_timeout_s": 30.0,
@@ -198,6 +229,11 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     # cancellation) this long past its wire deadline before the chaos
     # no-call-outlives-deadline invariant flags it.
     "rpc_deadline_grace_s": 0.5,
+    # Worker subprocesses flush deadline_stats deltas (met/shed/enforced/
+    # overruns) to the GCS at this cadence, plus once on Exit, so the
+    # no-call-outlives-deadline invariant sees overruns inside
+    # task-executing workers. 0 disables periodic reporting.
+    "rpc_deadline_report_interval_s": 0.5,
     # Driver-side loop-thread bridge budgets (worker.py run_async): whole
     # cluster bring-up, and graceful shutdown before the loop is abandoned.
     "driver_bringup_timeout_s": 120.0,

@@ -2454,7 +2454,16 @@ class CoreWorker:
         wire["seq_no"] = sub.seq
         sub.seq += 1
         try:
-            fut = conn.call_nowait("PushActorTask", {"spec": wire})
+            # Fold the ambient deadline like Connection.call does: an actor
+            # call made while serving (or routing) a deadlined request rides
+            # the fast path with the same TTL stamp, so the replica-side
+            # server can shed/cancel it (serve admission control relies on
+            # this for the no-admitted-request-overruns guarantee).
+            fut = conn.call_nowait(
+                "PushActorTask",
+                {"spec": wire},
+                deadline=rpc.current_deadline(),
+            )
         except rpc.ConnectionLost:
             sub.conn = None
             if wire.get("max_retries", 0) > wire.get("_attempt", 0):

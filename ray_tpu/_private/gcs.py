@@ -156,6 +156,16 @@ class GcsServer:
             "gcs.placement_groups"
         )
         self.task_events: List[dict] = []  # ring buffer of task state events
+        # Cluster-wide deadline-enforcement aggregate, fed by worker
+        # subprocess flushes (ReportDeadlineStats deltas + exit-time flush).
+        # The chaos no-call-outlives-deadline invariant reads `overruns`
+        # here so worker-side overruns are visible, not just driver-side.
+        self.worker_deadline_stats: Dict[str, Any] = {
+            "met": 0,
+            "shed": 0,
+            "enforced": 0,
+            "overruns": [],  # (worker_id, method, seconds late)
+        }
         # Monotonic cluster-view version; every membership/resource change
         # bumps it and broadcasts a delta (reference: ray_syncer.h:88
         # bidirectional versioned sync streams).
@@ -376,6 +386,7 @@ class GcsServer:
         s.register("ListNamedActors", self._list_named_actors)
         s.register("ReportActorReady", self._report_actor_ready)
         s.register("ReportWorkerDied", self._report_worker_died)
+        s.register("ReportDeadlineStats", self._report_deadline_stats)
         s.register("KillActor", self._kill_actor)
         s.register("KVPut", self._kv_put)
         s.register("KVGet", self._kv_get)
@@ -383,6 +394,7 @@ class GcsServer:
         s.register("KVKeys", self._kv_keys)
         s.register("KVExists", self._kv_exists)
         s.register("Subscribe", self._subscribe)
+        s.register("Unsubscribe", self._unsubscribe)
         s.register("Publish", self._publish)
         s.register("RegisterJob", self._register_job)
         s.register("JobFinished", self._job_finished)
@@ -761,6 +773,20 @@ class GcsServer:
                 )
         return {"ok": True}
 
+    async def _report_deadline_stats(self, conn, p):
+        """Accumulate a worker's deadline-enforcement deltas (worker-side
+        rpc.deadline_stats snapshot-and-reset, flushed periodically and on
+        exit by worker_main). Overruns carry the worker id so a violation
+        names the process that outlived its deadline."""
+        agg = self.worker_deadline_stats
+        agg["met"] += int(p.get("met", 0))
+        agg["shed"] += int(p.get("shed", 0))
+        agg["enforced"] += int(p.get("enforced", 0))
+        wid = p.get("worker_id", "?")
+        for method, late in p.get("overruns", []):
+            agg["overruns"].append((wid, method, float(late)))
+        return {"ok": True}
+
     async def _get_actor(self, conn, p):
         actor = self.actors.get(p["actor_id"])
         if actor is None:
@@ -850,6 +876,10 @@ class GcsServer:
 
     async def _subscribe(self, conn, p):
         self.publisher.subscribe(p["channel"], conn)
+        return {"ok": True}
+
+    async def _unsubscribe(self, conn, p):
+        self.publisher.unsubscribe(p["channel"], conn)
         return {"ok": True}
 
     async def _publish(self, conn, p):
@@ -1244,6 +1274,22 @@ class GcsClient:
         self._sub_handlers.setdefault(channel, []).append(handler)
         conn = await self._ensure_connected()
         await conn.call("Subscribe", {"channel": channel})
+
+    async def unsubscribe(self, channel: str, handler) -> None:
+        """Detach one handler; drops the server-side subscription (and the
+        reconnect re-subscribe) once the channel has no handlers left."""
+        handlers = self._sub_handlers.get(channel)
+        if handlers is None:
+            return
+        try:
+            handlers.remove(handler)
+        except ValueError:
+            pass
+        if handlers:
+            return
+        del self._sub_handlers[channel]
+        conn = await self._ensure_connected()
+        await conn.call("Unsubscribe", {"channel": channel})
 
     async def publish(self, channel: str, msg) -> None:
         await self.call("Publish", {"channel": channel, "msg": msg})

@@ -47,6 +47,14 @@ class Publisher:
         for subs in self.channels.values():
             subs.pop(cid, None)
 
+    def unsubscribe(self, channel: str, conn: rpc.Connection) -> None:
+        subs = self.channels.get(channel)
+        if subs is None:
+            return
+        subs.pop(id(conn), None)
+        if not subs:
+            del self.channels[channel]
+
     def publish(self, channel: str, msg: Any) -> None:
         """Enqueue to every subscriber; returns immediately (never blocks the
         caller on a slow subscriber's socket)."""

@@ -130,12 +130,20 @@ SCHEMAS: Dict[str, WireSchema] = {
     "ReportWorkerDied": _s(
         ["actor_ids"], ["cause", "worker_id"], retry=RETRY_SAFE
     ),
+    # Worker-subprocess deadline-enforcement deltas (snapshot-and-reset on
+    # the worker side). Deltas are additive, so a blind retry after a lost
+    # reply would double-count: RETRY_NONE — a dropped report just folds
+    # into the worker's next flush.
+    "ReportDeadlineStats": _s(
+        ["worker_id", "met", "shed", "enforced", "overruns"], retry=RETRY_NONE
+    ),
     "KillActor": _s(["actor_id"], ["no_restart"], retry=RETRY_SAFE),
     # NB: a KVPut retry after a lost reply reports added=False on the
     # re-issue when overwrite=False — the effect is still exactly-once.
     "KVPut": _s(["key", "value"], ["ns", "overwrite"], retry=RETRY_SAFE),
     "KVGet": _s(["key"], ["ns"], retry=RETRY_SAFE),
     "Subscribe": _s(["channel"], retry=RETRY_SAFE),
+    "Unsubscribe": _s(["channel"], retry=RETRY_SAFE),
     # Pubsub is at-least-once: a retried Publish may deliver twice.
     "Publish": _s(["channel", "msg"], retry=RETRY_SAFE),
     # Server->client pubsub delivery push.

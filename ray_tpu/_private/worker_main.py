@@ -36,6 +36,40 @@ logger = logging.getLogger(__name__)
 _GEN_BACKPRESSURE_WINDOW = 16
 
 
+def _deadline_stats_delta(worker_id: str) -> Optional[dict]:
+    """Snapshot-and-reset the process deadline counters as a wire delta.
+
+    Runs on the event loop with no awaits between read and reset, so no
+    enforcement event can land in the gap and be lost or double-counted.
+    Returns None when there is nothing to report.
+    """
+    st = rpc.deadline_stats
+    if not (st.met or st.shed or st.enforced or st.overruns):
+        return None
+    delta = {
+        "met": st.met,
+        "shed": st.shed,
+        "enforced": st.enforced,
+        "overruns": [[m, float(late)] for m, late in st.overruns],
+        "worker_id": worker_id,
+    }
+    st.reset()
+    return delta
+
+
+def _restore_deadline_delta(delta: dict) -> None:
+    """Fold an undelivered delta back into the local counters so the next
+    flush carries it. If the report actually landed and only the reply was
+    lost, counters double-count (ReportDeadlineStats is RETRY_NONE for the
+    same reason) — acceptable for telemetry, and an overrun re-reported
+    twice still flags the same real violation."""
+    st = rpc.deadline_stats
+    st.met += delta["met"]
+    st.shed += delta["shed"]
+    st.enforced += delta["enforced"]
+    st.overruns.extend((m, late) for m, late in delta["overruns"])
+
+
 class _ExecThread:
     """Dedicated execution thread with reply batching.
 
@@ -911,6 +945,18 @@ class Executor:
         )
 
     async def handle_exit(self, conn, p):
+        # Final deadline-stats flush: overruns observed in this worker's last
+        # report interval must reach the GCS aggregate before the process
+        # dies, or the no-call-outlives-deadline invariant goes blind to
+        # them. Bounded so a dead GCS cannot stall the exit.
+        delta = _deadline_stats_delta(self.core.worker_id)
+        if delta is not None:
+            try:
+                await asyncio.wait_for(
+                    self.core.gcs.call("ReportDeadlineStats", delta), timeout=1.0
+                )
+            except Exception:
+                pass
         asyncio.get_running_loop().call_later(0.05, os._exit, 0)
         return {"ok": True}
 
@@ -978,6 +1024,25 @@ async def amain() -> None:
         {"worker_id": worker_id, "addr": list(addr), "fp_port": fp_port},
     )
     core.job_id = core.job_id or reply.get("job_id", "")
+
+    async def _deadline_report_loop() -> None:
+        """Flush deadline-enforcement deltas to the GCS aggregate so overruns
+        inside worker subprocesses are visible to the cluster-wide
+        no-call-outlives-deadline invariant, not just driver-local stats."""
+        interval = config.rpc_deadline_report_interval_s
+        if interval <= 0:
+            return
+        while True:
+            await asyncio.sleep(interval)
+            delta = _deadline_stats_delta(worker_id)
+            if delta is None:
+                continue
+            try:
+                await core.gcs.call("ReportDeadlineStats", delta)
+            except Exception:
+                _restore_deadline_delta(delta)
+
+    rpc.spawn(_deadline_report_loop())
 
     # Exit if the raylet link dies: an unmanaged worker must not linger.
     while not raylet_conn.closed:

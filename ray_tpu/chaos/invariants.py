@@ -259,15 +259,19 @@ async def check_orphan_tasks(settle_s: float = 1.0) -> List[Violation]:
     ]
 
 
-def check_deadlines() -> List[Violation]:
+def check_deadlines(gcs_server=None) -> List[Violation]:
     """No call outlives its deadline: every handler dispatched under a wire
     deadline must finish — or unwind its cancellation — within the grace
     period (``config.rpc_deadline_grace_s``) of it. An overrun means a
     handler swallowed cancellation or the loop stalled long enough that
     shedding/enforcement never got to run; either way a hop kept working
-    after its caller gave up. Counters are process-wide (rpc.deadline_stats)
-    and reset per seed by the runner."""
-    return [
+    after its caller gave up.
+
+    Two sources: the driver-process counters (rpc.deadline_stats, reset per
+    seed by the runner) and — when a GCS server is given — the cluster
+    aggregate fed by worker-subprocess flushes (ReportDeadlineStats), so a
+    replica or task worker that outlived its deadline is a violation too."""
+    violations = [
         Violation(
             "no-call-outlives-deadline",
             "-",
@@ -276,6 +280,17 @@ def check_deadlines() -> List[Violation]:
         )
         for method, late in rpc.deadline_stats.overruns
     ]
+    if gcs_server is not None:
+        for wid, method, late in gcs_server.worker_deadline_stats["overruns"]:
+            violations.append(
+                Violation(
+                    "no-call-outlives-deadline",
+                    str(wid),
+                    f"worker handler {method} finished {late:.3f}s past its "
+                    "wire deadline (> grace period)",
+                )
+            )
+    return violations
 
 
 async def check(cluster) -> List[Violation]:
@@ -287,5 +302,5 @@ async def check(cluster) -> List[Violation]:
     if cluster.gcs_server is not None:
         violations.extend(check_actors(cluster.gcs_server))
     violations.extend(await check_orphan_tasks())
-    violations.extend(check_deadlines())
+    violations.extend(check_deadlines(cluster.gcs_server))
     return violations

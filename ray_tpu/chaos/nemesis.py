@@ -17,7 +17,10 @@ from typing import List, Optional
 
 logger = logging.getLogger(__name__)
 
-ACTIONS = ("kill_worker", "kill_raylet", "restart_gcs")
+ACTIONS = ("kill_worker", "kill_replica", "kill_raylet", "restart_gcs")
+
+# Actor-name prefix of Serve replica workers (ReplicaID.to_actor_name).
+SERVE_REPLICA_PREFIX = "SERVE_REPLICA::"
 
 
 class Nemesis:
@@ -37,6 +40,8 @@ class Nemesis:
         no eligible target existed — e.g. no spawned workers yet)."""
         if action == "kill_worker":
             return self._kill_worker(pick)
+        if action == "kill_replica":
+            return self._kill_replica(pick)
         if action == "kill_raylet":
             return await self._kill_raylet(pick)
         if action == "restart_gcs":
@@ -61,6 +66,46 @@ class Nemesis:
         self.actions_fired.append("kill_worker")
         logger.info("nemesis: killed worker %s on %s", worker_id[:8], node_id[:8])
         return f"kill_worker {worker_id[:8]}@{node_id[:8]}"
+
+    def _kill_replica(self, pick: int) -> Optional[str]:
+        """SIGKILL a worker hosting a Serve *replica* actor — never the
+        controller or proxy, whose loss is a control-plane outage rather than
+        the data-plane fault the serve scenarios exercise. The controller's
+        health loop must replace the replica and routers must route around
+        the corpse."""
+        gcs = self.cluster.gcs_server
+        if gcs is None:
+            return None
+        replica_workers = {
+            a.worker_id
+            for a in gcs.actors.values()
+            if a.state == "ALIVE"
+            and (a.name or "").startswith(SERVE_REPLICA_PREFIX)
+            and a.worker_id
+        }
+        candidates = []
+        for node_id in sorted(self.cluster.raylets):
+            raylet = self.cluster.raylets[node_id]
+            for worker_id in sorted(raylet.workers):
+                if worker_id not in replica_workers:
+                    continue
+                handle = raylet.workers[worker_id]
+                if handle.proc is not None and handle.proc.returncode is None:
+                    candidates.append((node_id, worker_id, handle))
+        if not candidates:
+            return None
+        node_id, worker_id, handle = candidates[pick % len(candidates)]
+        try:
+            handle.proc.kill()
+        except ProcessLookupError:
+            return None
+        self.actions_fired.append("kill_replica")
+        logger.info(
+            "nemesis: killed serve replica worker %s on %s",
+            worker_id[:8],
+            node_id[:8],
+        )
+        return f"kill_replica {worker_id[:8]}@{node_id[:8]}"
 
     async def _kill_raylet(self, pick: int) -> Optional[str]:
         head_id = (

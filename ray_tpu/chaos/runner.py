@@ -16,6 +16,7 @@ timeout) are applied before cluster boot and restored after.
 from __future__ import annotations
 
 import argparse
+import asyncio
 import hashlib
 import json
 import os
@@ -47,6 +48,15 @@ def _add(a, b):
     return a + b
 
 
+class _ServeEcho:
+    """Serve chaos workload: echo with a small await, so process kills land
+    mid-request and delay faults have a handler window to bite."""
+
+    async def __call__(self, x):
+        await asyncio.sleep(0.02)
+        return x
+
+
 # -- scenario catalog --------------------------------------------------------
 
 
@@ -55,13 +65,17 @@ class Scenario:
     name: str
     description: str
     specs: List[FaultSpec]
-    workload: str  # "tasks" | "transfer"
+    workload: str  # "tasks" | "transfer" | "serve"
     steps: int = 3
     nemesis: List[str] = field(default_factory=list)
     remote_node: bool = False  # add a {"victim": 2} node for cross-node work
     env: Dict[str, str] = field(default_factory=dict)
     # Re-add a victim node at the end of a seed run if nemesis removed one.
     repair: bool = False
+    # serve workload: per-request budget, and whether to tear down the
+    # process-wide router between steps (it must rebuild from the controller).
+    serve_timeout_s: float = 2.0
+    router_restart: bool = False
 
 
 _TRANSFER_ENV = {
@@ -200,6 +214,45 @@ SCENARIOS: Dict[str, Scenario] = {
             env=dict(_LATENCY_ENV),
         ),
         Scenario(
+            name="serve_replica_kill",
+            description="SIGKILL a serve replica worker while 16 requests "
+            "are in flight; failures surface typed, the health loop replaces "
+            "the replica, and fresh requests route around the corpse",
+            specs=[],
+            workload="serve",
+            steps=4,
+            nemesis=["kill_replica"],
+            env=dict(_TASKS_ENV),
+        ),
+        Scenario(
+            name="serve_deadline_storm",
+            description="delay serve data-plane dispatch 10-120ms against a "
+            "tight 0.4s request budget; excess latency must come back as "
+            "typed sheds or deadline cuts, never an admitted request "
+            "outliving its deadline",
+            specs=[
+                FaultSpec("delay-dispatch", "delay", "PushActorTask",
+                          frame="request", p=0.5, delay_s=(0.01, 0.12)),
+                FaultSpec("delay-dispatch-rep", "delay", "PushActorTask",
+                          frame="reply", p=0.5, delay_s=(0.01, 0.12)),
+            ],
+            workload="serve",
+            steps=4,
+            serve_timeout_s=0.4,
+            env=dict(_TASKS_ENV),
+        ),
+        Scenario(
+            name="serve_router_restart",
+            description="tear down the process-wide router between steps; a "
+            "fresh router rebuilds its replica view from the controller and "
+            "requests keep succeeding",
+            specs=[],
+            workload="serve",
+            steps=4,
+            router_restart=True,
+            env=dict(_TASKS_ENV),
+        ),
+        Scenario(
             name="kill_raylet",
             description="kill the node holding transferred objects; refs "
             "recover via lineage reconstruction",
@@ -222,9 +275,15 @@ SUITES: Dict[str, List[str]] = {
     # Delay/drop-heavy schedules exercising the RPC resilience layer
     # (retryable channels, deadline propagation, GCS failover queueing).
     "latency": ["latency_storm", "latency_gcs_drop", "latency_gcs_restart"],
+    # Serving stack under fire: replica death mid-request, deadline storms,
+    # router restarts — the no-request-lost-or-overrun invariant suite.
+    "serve": [
+        "serve_replica_kill", "serve_deadline_storm", "serve_router_restart",
+    ],
     "full": [
         "rpc_delay", "dup_lease", "chunk_loss", "reorder_push",
         "latency_storm", "latency_gcs_drop", "latency_gcs_restart",
+        "serve_replica_kill", "serve_deadline_storm", "serve_router_restart",
         "kill_worker", "gcs_restart", "kill_raylet",
     ],
 }
@@ -294,6 +353,25 @@ class _Session:
         self.produce = ray_tpu.remote(
             max_retries=3, resources={"victim": 1} if scenario.remote_node else None
         )(_produce_blob)
+        self.serve = None
+        self.serve_dep: Optional[str] = None
+        if scenario.workload == "serve":
+            from ray_tpu import serve
+
+            self.serve = serve
+            serve.start(http_options={"enabled": False})
+            echo = serve.deployment(
+                num_replicas=2,
+                max_ongoing_requests=4,
+                max_queued_requests=32,
+                # Fast death detection: a killed replica must be replaced
+                # within the seed, not after a 10s default health period.
+                health_check_period_s=0.25,
+                health_check_timeout_s=2.0,
+                graceful_shutdown_timeout_s=1.0,
+            )(_ServeEcho)
+            serve.run(echo.bind(), route_prefix=None)
+            self.serve_dep = f"default#{echo.name}"
 
     def run_async(self, coro, timeout=60):
         return self.w.run_async(coro, timeout=timeout)
@@ -307,6 +385,14 @@ class _Session:
 
     def close(self) -> None:
         try:
+            if self.serve is not None:
+                # Also clears the cached controller handle and the
+                # process-wide router — both would otherwise point into this
+                # (about to die) cluster when the next session boots.
+                try:
+                    self.serve.shutdown()
+                except Exception:
+                    pass
             self.cluster.shutdown()
         finally:
             for k, old in self._saved_env.items():
@@ -337,29 +423,112 @@ def run_seed(session: _Session, scenario: Scenario, seed: int,
         # objects, so its schedule actually sees traffic to fault.
         await invariants.quiesce(session.cluster, timeout=15.0)
         # Per-seed deadline accounting: the no-call-outlives-deadline
-        # invariant reads these process-wide counters at convergence.
+        # invariant reads these process-wide counters — and the GCS-side
+        # aggregate of worker-subprocess flushes — at convergence.
         rpc.deadline_stats.reset()
+        gcs = session.cluster.gcs_server
+        if gcs is not None:
+            gcs.worker_deadline_stats.update(met=0, shed=0, enforced=0)
+            gcs.worker_deadline_stats["overruns"].clear()
         return interceptors.install(schedule)
 
     async def _uninstall():
         return interceptors.uninstall()
 
+    async def _serve_step(step, actions):
+        """One burst of 16 concurrent serve requests; nemesis actions fire
+        WHILE the burst is in flight (replica kill mid-request). Returns
+        (outcome counters, violations, nemesis descriptions)."""
+        from ray_tpu.serve import handle as handle_mod
+        from ray_tpu.serve._private.common import DeploymentOverloadedError
+
+        router = await handle_mod._get_router()
+        outcomes = {"ok": 0, "shed": 0, "deadline": 0, "replica_error": 0}
+        bad: List[str] = []
+        error_samples: List[str] = []
+
+        async def one(i):
+            want = seed * 1000 + step * 100 + i
+            try:
+                got = await router.assign_request(
+                    session.serve_dep,
+                    {"call_method": "__call__", "request_id": "",
+                     "multiplexed_model_id": ""},
+                    (want,),
+                    {},
+                    timeout_s=scenario.serve_timeout_s,
+                )
+            except DeploymentOverloadedError:
+                outcomes["shed"] += 1
+            except (rpc.DeadlineExceeded, TimeoutError, asyncio.TimeoutError):
+                outcomes["deadline"] += 1
+            except Exception as e:
+                # A replica killed mid-request surfaces as a typed
+                # actor-death error: acceptable (callers can retry), unlike
+                # a wrong value or a hang.
+                outcomes["replica_error"] += 1
+                if len(error_samples) < 3:
+                    error_samples.append(f"{type(e).__name__}: {e}")
+            else:
+                if got != want:
+                    bad.append(f"request {i} returned {got!r}, want {want}")
+                else:
+                    outcomes["ok"] += 1
+
+        burst = asyncio.gather(*(one(i) for i in range(16)))
+        fired = []
+        if actions:
+            await asyncio.sleep(0.02)  # let requests reach the replicas
+            for action, pick in actions:
+                desc = await nemesis.fire(action, pick)
+                if desc:
+                    fired.append(desc)
+        await burst
+        if not outcomes["ok"]:
+            # Zero successes is about to be a violation: capture the
+            # router's replica view so the corpus says *why* (stale set,
+            # empty set, all corpses) instead of just the outcome counts.
+            rs = router._replica_set(session.serve_dep)
+            error_samples.append(
+                f"replicas={[r.replica_id_str[-8:] for r in rs.replicas]} "
+                f"stats={router.stats().get(session.serve_dep)}"
+            )
+        return outcomes, bad, fired, error_samples
+
     interceptor = session.run_async(_install(), timeout=20)
     try:
         for step in range(scenario.steps):
-            for action, pick in plan.at_step(step):
-                async def _fire(action=action, pick=pick):
-                    return await nemesis.fire(action, pick)
+            actions = plan.at_step(step)
+            if scenario.workload != "serve":
+                for action, pick in actions:
+                    async def _fire(action=action, pick=pick):
+                        return await nemesis.fire(action, pick)
 
-                fired = session.run_async(_fire(), timeout=60)
-                if verbose and fired:
-                    print(f"      nemesis: {fired}")
-                if scenario.repair and fired:
-                    # Autoscaler analog: replace the killed node right away
-                    # so queued infeasible work and reconstruction proceed.
-                    session.repair_victim_node()
+                    fired = session.run_async(_fire(), timeout=60)
+                    if verbose and fired:
+                        print(f"      nemesis: {fired}")
+                    if scenario.repair and fired:
+                        # Autoscaler analog: replace the killed node right
+                        # away so queued infeasible work and reconstruction
+                        # proceed.
+                        session.repair_victim_node()
             try:
-                if scenario.workload == "tasks":
+                if scenario.workload == "serve":
+                    outcomes, bad, fired, err_samples = session.run_async(
+                        _serve_step(step, actions), timeout=90
+                    )
+                    if verbose and fired:
+                        for desc in fired:
+                            print(f"      nemesis: {desc}")
+                    violations.extend(
+                        f"workload: step {step} serve: {b}" for b in bad
+                    )
+                    if not outcomes["ok"]:
+                        violations.append(
+                            f"workload: step {step} no serve request "
+                            f"succeeded: {outcomes} errors={err_samples}"
+                        )
+                elif scenario.workload == "tasks":
                     refs = [
                         session.add.remote(seed * 1000 + step * 10 + i, i)
                         for i in range(4)
@@ -387,6 +556,13 @@ def run_seed(session: _Session, scenario: Scenario, seed: int,
                 violations.append(
                     f"workload: step {step} failed: {type(e).__name__}: {e}"
                 )
+            if scenario.router_restart:
+                async def _restart_router():
+                    from ray_tpu.serve import handle as handle_mod
+
+                    handle_mod._reset_router()
+
+                session.run_async(_restart_router(), timeout=10)
     finally:
         session.run_async(_uninstall())
 
@@ -423,6 +599,37 @@ def run_seed(session: _Session, scenario: Scenario, seed: int,
             violations.append("probe: fresh task returned wrong value")
     except Exception as e:
         violations.append(f"probe: fresh task failed: {type(e).__name__}: {e}")
+    # Probe 3 (serve): a fresh request must route and succeed — whatever the
+    # faults broke (replica, router view) has been repaired by now. One retry
+    # absorbs a router whose long-poll update is still in flight.
+    if scenario.workload == "serve":
+
+        async def _serve_probe():
+            from ray_tpu.serve import handle as handle_mod
+
+            router = await handle_mod._get_router()
+            for attempt in (0, 1):
+                try:
+                    return await router.assign_request(
+                        session.serve_dep,
+                        {"call_method": "__call__", "request_id": "",
+                         "multiplexed_model_id": ""},
+                        (seed,),
+                        {},
+                        timeout_s=5.0,
+                    )
+                except Exception:
+                    if attempt:
+                        raise
+                    await asyncio.sleep(1.0)
+
+        try:
+            if session.run_async(_serve_probe(), timeout=30) != seed:
+                violations.append("probe: serve request returned wrong value")
+        except Exception as e:
+            violations.append(
+                f"probe: serve request failed: {type(e).__name__}: {e}"
+            )
 
     dup_avoided = sum(
         r.duplicate_lease_grants_avoided for r in session.cluster.raylets.values()

@@ -27,7 +27,10 @@ This pass makes the wire protocol checkable at lint time:
    *budget*, not a cleanup grace wait); budgets come from
    ``common.config`` (the ``rpc_*_timeout_s`` knobs) so they are tunable,
    greppable, and consistent with the resilience layer's deadline
-   propagation. Tests, devtools, and examples may use literals.
+   propagation. Under ``serve/_private/`` the same rule additionally covers
+   numeric ``timeout_s=`` keyword literals (the serving stack's request
+   budgets — ``common.config``'s ``serve_*`` knobs own those). Tests,
+   devtools, and examples may use literals.
 
 Non-literal method names (e.g. the dashboard's generic proxy
 ``conn.call(method, ...)``) are outside the static horizon and skipped.
@@ -114,6 +117,9 @@ class Inventory:
     str_literals: Set[str] = field(default_factory=set)
     # asyncio.wait_for(..., <numeric literal>) sites: (path, line, seconds).
     wait_for_literals: List[Tuple[str, int, float]] = field(default_factory=list)
+    # Any-call numeric timeout_s= keyword literals: (path, line, seconds).
+    # Checked only under serve/_private (the serving stack's budget kwarg).
+    timeout_s_literals: List[Tuple[str, int, float]] = field(default_factory=list)
 
 
 def _const_str(node: ast.AST) -> Optional[str]:
@@ -274,6 +280,13 @@ class _FileScanner(ast.NodeVisitor):
                             _fn_simple_name(node.args[1]),
                             "setdefault",
                         )
+                    )
+        for kw in node.keywords:
+            if kw.arg == "timeout_s":
+                t = _const_num(kw.value)
+                if t is not None:
+                    self.inv.timeout_s_literals.append(
+                        (self.path, node.lineno, t)
                     )
         # Literal handlers= dicts passed to rpc.connect()/Connection().
         for kw in node.keywords:
@@ -523,6 +536,26 @@ def _check_magic_timeouts(inv: Inventory, rpc_path: str) -> List[Finding]:
                 f">= {_WAIT_FOR_BUDGET_S:g}s — that is a deadline budget; "
                 "take it from common.config so it is tunable (short "
                 "cleanup/grace waits are exempt)",
+            )
+        )
+
+    def _serve_scope(path: str) -> bool:
+        parts = os.path.abspath(path).split(os.sep)
+        return "serve" in parts and "_private" in parts
+
+    for path, line, t in inv.timeout_s_literals:
+        if not _serve_scope(path):
+            continue
+        findings.append(
+            Finding(
+                path,
+                line,
+                0,
+                RULE_TIMEOUT,
+                f"timeout_s={t:g} uses a numeric literal in the serving "
+                "stack — request budgets come from common.config (the "
+                "serve_* knobs) so admission control and deadline "
+                "propagation stay consistent",
             )
         )
     return findings

@@ -15,6 +15,7 @@ composition via deployment handles, and a reconciling controller actor.
     assert handle.remote(2).result() == 4
 """
 
+from ray_tpu.serve._private.common import DeploymentOverloadedError
 from ray_tpu.serve._private.proxy import HTTPRequest
 from ray_tpu.serve.api import (
     Application,
@@ -40,6 +41,7 @@ __all__ = [
     "Deployment",
     "DeploymentConfig",
     "DeploymentHandle",
+    "DeploymentOverloadedError",
     "DeploymentResponse",
     "HTTPOptions",
     "HTTPRequest",

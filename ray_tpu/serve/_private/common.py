@@ -16,6 +16,31 @@ CONTROLLER_NAME = "SERVE_CONTROLLER"
 DEFAULT_APP_NAME = "default"
 
 
+class DeploymentOverloadedError(Exception):
+    """Typed load-shed: the router refused this request at admission.
+
+    ``reason`` is one of:
+
+    - ``"queue_full"`` — the deployment's router queue is at its
+      max_queued_requests cap; admitting more would grow memory without
+      bound under an open-loop storm.
+    - ``"deadline_unreachable"`` — the request's remaining deadline budget
+      cannot cover the observed per-replica service estimate, so running it
+      would burn a replica slot only to be cut at the wire deadline.
+
+    Callers (proxy, loadgen, chaos) treat this as backpressure, not a bug:
+    the HTTP proxy maps it to 503, gRPC to RESOURCE_EXHAUSTED.
+    """
+
+    def __init__(self, deployment_id_str: str, reason: str, detail: str = ""):
+        self.deployment_id_str = deployment_id_str
+        self.reason = reason
+        super().__init__(
+            f"deployment {deployment_id_str} overloaded ({reason})"
+            + (f": {detail}" if detail else "")
+        )
+
+
 @dataclass(frozen=True)
 class DeploymentID:
     name: str
@@ -107,6 +132,10 @@ class RunningReplicaInfo:
     deployment_id_str: str
     actor_id: str
     max_ongoing_requests: int
+    # Router queue cap for the whole deployment (-1 -> the
+    # config.serve_max_queued_requests default); rides the replica-set
+    # long-poll push so routers learn it without extra RPCs.
+    max_queued_requests: int = -1
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -114,6 +143,7 @@ class RunningReplicaInfo:
             "deployment_id_str": self.deployment_id_str,
             "actor_id": self.actor_id,
             "max_ongoing_requests": self.max_ongoing_requests,
+            "max_queued_requests": self.max_queued_requests,
         }
 
     @classmethod

@@ -10,17 +10,19 @@ and proxies via a long-poll host. The data plane never touches the controller.
 from __future__ import annotations
 
 import asyncio
+import math
 
 from ray_tpu._private.rpc import spawn as _spawn
 import logging
 import time
 import traceback
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
 
 from ray_tpu._private import worker as worker_mod
 from ray_tpu._private.common import config as _config
+from ray_tpu._private.gcs import DEAD as ACTOR_DEAD
 from ray_tpu.serve._private.common import (
     ApplicationStatus,
     DeploymentID,
@@ -37,13 +39,22 @@ RECONCILE_PERIOD_S = 0.25
 
 
 class _ReplicaRecord:
-    def __init__(self, replica_id: ReplicaID, actor_id: str, max_ongoing: int):
+    def __init__(
+        self,
+        replica_id: ReplicaID,
+        actor_id: str,
+        max_ongoing: int,
+        max_queued: int = -1,
+    ):
         self.replica_id = replica_id
         self.actor_id = actor_id
         self.max_ongoing = max_ongoing
+        self.max_queued = max_queued
         self.ready = False
         self.health_task: Optional[asyncio.Task] = None
         self.consecutive_health_failures = 0
+        # GCS actor:<id> pubsub handler while the death watch is armed.
+        self.death_watch: Optional[Any] = None
 
     def info(self) -> RunningReplicaInfo:
         return RunningReplicaInfo(
@@ -51,6 +62,7 @@ class _ReplicaRecord:
             deployment_id_str=str(self.replica_id.deployment_id),
             actor_id=self.actor_id,
             max_ongoing_requests=self.max_ongoing,
+            max_queued_requests=self.max_queued,
         )
 
 
@@ -70,7 +82,9 @@ class _DeploymentState:
         self.deleting = False
         # autoscaling bookkeeping
         self.metrics_window: List[tuple] = []  # (t, total_ongoing)
-        self.autoscale_decision_ts = 0.0
+        self.queue_ewma = 0.0  # smoothed router queue depth
+        self.above_since: Optional[float] = None  # hysteresis timers
+        self.below_since: Optional[float] = None
         self.current_target: Optional[int] = None
         # start-failure backoff
         self.consecutive_start_failures = 0
@@ -91,6 +105,52 @@ class _DeploymentState:
         return [r.info() for r in self.replicas.values() if r.ready]
 
 
+def autoscale_tick(state: _DeploymentState, ac: AutoscalingConfig, now: float):
+    """Decide the replica target from the ongoing-request window plus the
+    smoothed router queue depth (state.queue_ewma), with hysteresis: a
+    desired target only takes effect after it has held continuously for
+    upscale_delay_s / downscale_delay_s. Returns the new target, or None.
+
+    Kept as a free function (its only side effects are the window prune and
+    the hysteresis timers on `state`) so tests can drive it with synthetic
+    clocks and queue depths without a live control loop.
+    """
+    window = [
+        (t, v) for (t, v) in state.metrics_window if now - t <= ac.look_back_period_s
+    ]
+    state.metrics_window = window
+    if not window:
+        return None
+    ongoing_avg = sum(v for _, v in window) / len(window)
+    # Queued requests are load the replicas haven't absorbed yet; counting
+    # them is what makes the scaler react to saturation (ongoing alone
+    # plateaus at num_replicas * max_ongoing_requests under overload).
+    load = ongoing_avg + state.queue_ewma
+    desired = max(
+        ac.min_replicas,
+        min(ac.max_replicas, math.ceil(load / max(ac.target_ongoing_requests, 1e-9))),
+    )
+    cur = state.target_replicas
+    if desired > cur:
+        state.below_since = None
+        if state.above_since is None:
+            state.above_since = now
+        if now - state.above_since >= ac.upscale_delay_s:
+            state.above_since = None
+            return desired
+    elif desired < cur:
+        state.above_since = None
+        if state.below_since is None:
+            state.below_since = now
+        if now - state.below_since >= ac.downscale_delay_s:
+            state.below_since = None
+            return desired
+    else:
+        state.above_since = None
+        state.below_since = None
+    return None
+
+
 class ServeController:
     """Created as a detached named actor with high max_concurrency so
     long-poll listens don't block control operations."""
@@ -103,6 +163,9 @@ class ServeController:
         self._loop_task: Optional[asyncio.Task] = None
         self._proxy_actor_id: Optional[str] = None
         self._shutdown = False
+        # (dep_id_str, router_id) -> (monotonic ts, queued, ongoing); pushed
+        # by every router's metrics loop, consumed by the autoscaler.
+        self._router_metrics: Dict[Tuple[str, str], Tuple[float, int, int]] = {}
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -148,6 +211,19 @@ class ServeController:
 
     async def check_alive(self) -> bool:
         return True
+
+    async def record_router_metrics(
+        self, router_id: str, snap: Dict[str, Dict[str, int]]
+    ) -> None:
+        """Routers push {dep_id_str: {"queued": n, "ongoing": n}} here on a
+        short interval; the autoscaler sums fresh entries across routers."""
+        now = time.monotonic()
+        for dep_key, m in (snap or {}).items():
+            self._router_metrics[(dep_key, router_id)] = (
+                now,
+                int(m.get("queued", 0)),
+                int(m.get("ongoing", 0)),
+            )
 
     # -- long poll -----------------------------------------------------------
 
@@ -213,6 +289,7 @@ class ServeController:
                     new_cfg = existing.config
                     for rec in existing.replicas.values():
                         rec.max_ongoing = new_cfg.max_ongoing_requests
+                        rec.max_queued = new_cfg.max_queued_requests
                     if new_cfg.user_config != old_cfg.user_config:
                         _spawn(
                             self._reconfigure_replicas(existing, new_cfg.user_config)
@@ -399,6 +476,9 @@ class ServeController:
                     str(state.dep_id),
                     replica_id.unique_id,
                     cfg.user_config,
+                    cfg.max_batch_size,
+                    cfg.batch_wait_timeout_s,
+                    cfg.max_ongoing_requests,
                 ),
                 {},
                 resources=resources,
@@ -415,10 +495,16 @@ class ServeController:
                 core.get_objects(refs[0], timeout=None),
                 timeout=cfg.health_check_timeout_s,
             )
-            rec = _ReplicaRecord(replica_id, actor_id, cfg.max_ongoing_requests)
+            rec = _ReplicaRecord(
+                replica_id,
+                actor_id,
+                cfg.max_ongoing_requests,
+                cfg.max_queued_requests,
+            )
             rec.ready = True
             state.replicas[replica_id.unique_id] = rec
             rec.health_task = _spawn(self._health_loop(state, rec))
+            self._arm_death_watch(state, rec)
             state.message = ""
             state.consecutive_start_failures = 0
             state.backoff_until = 0.0
@@ -496,10 +582,62 @@ class ServeController:
                         self._start_stopping(state, rec)
                     return
 
+    def _arm_death_watch(self, state: _DeploymentState, rec: _ReplicaRecord) -> None:
+        """Replace a replica the moment the GCS declares its actor DEAD.
+
+        The RPC health loop needs up to ``health_check_timeout_s`` plus two
+        more periods to call a SIGKILLed replica dead — seconds in which
+        routers still list the corpse. The GCS hears about the worker's
+        death from its raylet almost immediately and publishes the actor
+        state transition, so subscribing here turns replacement latency
+        from seconds into one reconcile tick."""
+        core = worker_mod._core()
+
+        def on_update(msg) -> None:
+            if (msg or {}).get("state") != ACTOR_DEAD:
+                return
+            if (
+                self._shutdown
+                or state.replicas.get(rec.replica_id.unique_id) is not rec
+            ):
+                return
+            logger.warning(
+                "replica %s of %s actor died (%s); replacing",
+                rec.replica_id.unique_id,
+                state.dep_id,
+                (msg or {}).get("death_cause") or "no cause recorded",
+            )
+            self._start_stopping(state, rec)
+
+        rec.death_watch = on_update
+
+        async def _arm() -> None:
+            await core.gcs.subscribe(f"actor:{rec.actor_id}", on_update)
+            # The actor may have died before the Subscribe landed and that
+            # publish is gone; read the state once to close the gap.
+            try:
+                reply = await core.gcs.call(
+                    "GetActor", {"actor_id": rec.actor_id}
+                )
+            except Exception:
+                return  # the health loop still covers this replica
+            info = reply.get("actor")
+            if info is not None:
+                on_update(info)
+
+        _spawn(_arm())
+
     def _start_stopping(self, state: _DeploymentState, rec: _ReplicaRecord) -> None:
         if rec.health_task is not None:
             rec.health_task.cancel()
             rec.health_task = None
+        if rec.death_watch is not None:
+            handler, rec.death_watch = rec.death_watch, None
+            _spawn(
+                worker_mod._core().gcs.unsubscribe(
+                    f"actor:{rec.actor_id}", handler
+                )
+            )
         state.replicas.pop(rec.replica_id.unique_id, None)
         self._broadcast_replicas(str(state.dep_id))
         task = _spawn(self._stop_replica(state, rec))
@@ -517,7 +655,8 @@ class ServeController:
             )
             await asyncio.wait_for(
                 core.get_objects(refs[0], timeout=None),
-                timeout=state.config.graceful_shutdown_timeout_s + 5,
+                timeout=state.config.graceful_shutdown_timeout_s
+                + _config.serve_shutdown_grace_s,
             )
         except Exception:
             pass
@@ -529,6 +668,23 @@ class ServeController:
 
     # -- autoscaling ---------------------------------------------------------
 
+    def _router_queue_depth(
+        self, dep_key: str, ac: AutoscalingConfig, now: float
+    ) -> int:
+        """Sum queued requests across routers, ignoring (and pruning) entries
+        older than queue_metric_staleness_s — a dead router must not pin the
+        depth at its last reported value forever."""
+        total = 0
+        for (key, router_id), (ts, queued, _ongoing) in list(
+            self._router_metrics.items()
+        ):
+            if now - ts > ac.queue_metric_staleness_s:
+                del self._router_metrics[(key, router_id)]
+                continue
+            if key == dep_key:
+                total += queued
+        return total
+
     def _autoscale(self, state: _DeploymentState) -> None:
         ac = state.config.autoscaling_config
         if ac is None or state.deleting:
@@ -536,22 +692,19 @@ class ServeController:
         now = time.monotonic()
         # Sample metrics (fire-and-forget gather; cheap at control-loop rate).
         _spawn(self._sample_metrics(state, now, ac))
-        window = [(t, v) for (t, v) in state.metrics_window if now - t <= ac.look_back_period_s]
-        state.metrics_window = window
-        if not window:
-            return
-        avg_total = sum(v for _, v in window) / len(window)
-        desired = max(
-            ac.min_replicas,
-            min(ac.max_replicas, round(avg_total / max(ac.target_ongoing_requests, 1e-9))),
-        )
-        cur = state.target_replicas
-        if desired > cur and now - state.autoscale_decision_ts >= ac.upscale_delay_s:
-            state.current_target = desired
-            state.autoscale_decision_ts = now
-        elif desired < cur and now - state.autoscale_decision_ts >= ac.downscale_delay_s:
-            state.current_target = desired
-            state.autoscale_decision_ts = now
+        depth = self._router_queue_depth(str(state.dep_id), ac, now)
+        alpha = ac.queue_ewma_alpha
+        state.queue_ewma = alpha * depth + (1.0 - alpha) * state.queue_ewma
+        new_target = autoscale_tick(state, ac, now)
+        if new_target is not None and new_target != state.target_replicas:
+            logger.info(
+                "autoscaling %s: %d -> %d (queue_ewma=%.1f)",
+                state.dep_id,
+                state.target_replicas,
+                new_target,
+                state.queue_ewma,
+            )
+            state.current_target = new_target
 
     async def _sample_metrics(
         self, state: _DeploymentState, ts: float, ac: AutoscalingConfig
@@ -566,7 +719,8 @@ class ServeController:
                     rec.actor_id, "get_metrics", (), {}, num_returns=1
                 )
                 m = await asyncio.wait_for(
-                    core.get_objects(refs[0], timeout=None), timeout=2
+                    core.get_objects(refs[0], timeout=None),
+                    timeout=_config.serve_metrics_sample_timeout_s,
                 )
                 total += m.get("num_ongoing_requests", 0)
                 rec.consecutive_health_failures = 0

@@ -10,10 +10,9 @@ from __future__ import annotations
 
 import asyncio
 
+from ray_tpu._private.common import config
 from ray_tpu._private.rpc import spawn as _spawn
 from typing import Any, Callable, Dict, Optional, Tuple
-
-LISTEN_TIMEOUT_S = 30.0
 
 
 class LongPollHost:
@@ -55,7 +54,7 @@ class LongPollHost:
             async with self._changed:
                 await asyncio.wait_for(
                     self._changed.wait_for(lambda: bool(stale())),
-                    timeout=LISTEN_TIMEOUT_S,
+                    timeout=config.serve_long_poll_timeout_s,
                 )
         except asyncio.TimeoutError:
             return {}

@@ -15,8 +15,11 @@ import logging
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional, Tuple
 
+from ray_tpu._private import rpc
 from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.common import config
 from ray_tpu.actor import ActorHandle
+from ray_tpu.serve._private.common import DeploymentOverloadedError
 from ray_tpu.serve._private.long_poll import LongPollClient
 
 logger = logging.getLogger(__name__)
@@ -164,9 +167,11 @@ class ProxyActor:
             try:
                 result = await self._router.assign_request(
                     dep_id_str, _meta(request), (request.payload,), {},
-                    timeout_s=60.0,
+                    timeout_s=config.serve_request_timeout_s,
                 )
-            except TimeoutError as e:
+            except DeploymentOverloadedError as e:
+                await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+            except (TimeoutError, rpc.DeadlineExceeded) as e:
                 await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
             except Exception as e:
                 await context.abort(
@@ -185,11 +190,13 @@ class ProxyActor:
             try:
                 async for item in self._router.assign_request_streaming(
                     dep_id_str, _meta(request), (request.payload,), {},
-                    timeout_s=60.0,
+                    timeout_s=config.serve_request_timeout_s,
                 ):
                     payload, ctype = self._encode_reply(item)
                     yield ServeReply(payload=payload, content_type=ctype)
-            except TimeoutError as e:
+            except DeploymentOverloadedError as e:
+                await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+            except (TimeoutError, rpc.DeadlineExceeded) as e:
                 await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
             except Exception as e:
                 await context.abort(
@@ -203,6 +210,18 @@ class ProxyActor:
         )
         await self._grpc_server.start()
         self._grpc_port = bound
+
+    @staticmethod
+    def _request_budget(request) -> float:
+        """Per-request deadline budget: clients may shrink (or stretch) the
+        default via the serve-request-timeout-s header."""
+        raw = request.headers.get("serve-request-timeout-s")
+        if raw:
+            try:
+                return max(0.001, float(raw))
+            except ValueError:
+                pass
+        return config.serve_request_timeout_s
 
     def _set_route_table(self, table: Dict[str, Dict[str, str]]) -> None:
         self._route_table = table or {}
@@ -270,8 +289,17 @@ class ProxyActor:
             )
         try:
             result = await self._router.assign_request(
-                dep_id_str, meta, (http_req,), {}, timeout_s=60.0
+                dep_id_str, meta, (http_req,), {},
+                timeout_s=self._request_budget(request),
             )
+        except DeploymentOverloadedError as e:
+            # Typed shed -> 503 with Retry-After: the client should back
+            # off, the deployment is refusing (not failing) the request.
+            return web.Response(
+                status=503, text=str(e), headers={"Retry-After": "1"}
+            )
+        except rpc.DeadlineExceeded as e:
+            return web.Response(status=504, text=str(e))
         except TimeoutError as e:
             return web.Response(status=503, text=str(e))
         except Exception as e:
@@ -316,7 +344,8 @@ class ProxyActor:
         started = False
         try:
             async for item in self._router.assign_request_streaming(
-                dep_id_str, meta, (http_req,), {}, timeout_s=60.0
+                dep_id_str, meta, (http_req,), {},
+                timeout_s=self._request_budget(request),
             ):
                 if not started:
                     await resp.prepare(request)
@@ -330,6 +359,12 @@ class ProxyActor:
                 else:
                     chunk = json.dumps(item).encode() + b"\n"
                 await resp.write(chunk)
+        except DeploymentOverloadedError as e:
+            if not started:
+                return web.Response(
+                    status=503, text=str(e), headers={"Retry-After": "1"}
+                )
+            raise
         except TimeoutError as e:
             if not started:
                 return web.Response(status=503, text=str(e))

@@ -3,18 +3,181 @@
 Analog of python/ray/serve/_private/replica.py (ReplicaActor:231): wraps the
 user callable, tracks ongoing-request count (consumed by the pow-2 router and
 the autoscaler), exposes health checks and reconfigure.
+
+Continuous dynamic batching (reference: @serve.batch; Orca-style iteration
+scheduling, Yu et al. OSDI'22): when max_batch_size > 1, concurrent requests
+to the same method are coalesced into one user-code call that receives a
+LIST of inputs and must return a list of the same length. A batch launches
+when it fills or batch_wait_timeout_s after its first request arrives — and
+the NEXT batch keeps forming while in-flight batches execute, so admission
+into batch N+1 overlaps batch N's compute (the "continuous" part).
 """
 
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import inspect
 import logging
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
 
+from ray_tpu._private.rpc import spawn as _spawn
+
 logger = logging.getLogger(__name__)
+
+
+class _BatchItem:
+    __slots__ = ("value", "future", "enqueued_at")
+
+    def __init__(self, value, future, enqueued_at):
+        self.value = value
+        self.future = future
+        self.enqueued_at = enqueued_at
+
+
+class _BatchStats:
+    """Batch-size / queue-age counters, exposed via Replica.get_metrics."""
+
+    __slots__ = (
+        "batches",
+        "requests",
+        "size_max",
+        "queue_age_sum_s",
+        "queue_age_max_s",
+    )
+
+    def __init__(self):
+        self.batches = 0
+        self.requests = 0
+        self.size_max = 0
+        self.queue_age_sum_s = 0.0
+        self.queue_age_max_s = 0.0
+
+    def observe(self, size: int, oldest_age_s: float) -> None:
+        self.batches += 1
+        self.requests += size
+        self.size_max = max(self.size_max, size)
+        self.queue_age_sum_s += oldest_age_s
+        self.queue_age_max_s = max(self.queue_age_max_s, oldest_age_s)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "batches": self.batches,
+            "requests": self.requests,
+            "size_max": self.size_max,
+            "size_avg": (self.requests / self.batches) if self.batches else 0.0,
+            "queue_age_avg_s": (
+                self.queue_age_sum_s / self.batches if self.batches else 0.0
+            ),
+            "queue_age_max_s": self.queue_age_max_s,
+        }
+
+
+class _BatchQueue:
+    """One per (replica, method): forms batches continuously.
+
+    The pump loop never blocks on execution — it hands a formed batch to a
+    spawned task (bounded by ``max_concurrent_batches``) and immediately
+    starts collecting the next one, so new requests are admitted into the
+    next batch while in-flight ones complete.
+    """
+
+    def __init__(
+        self,
+        method,
+        max_batch_size: int,
+        batch_wait_timeout_s: float,
+        max_concurrent_batches: int,
+        stats: _BatchStats,
+    ):
+        self._method = method
+        self._max = max(1, max_batch_size)
+        self._wait = max(0.0, batch_wait_timeout_s)
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._sem = asyncio.Semaphore(max(1, max_concurrent_batches))
+        self._stats = stats
+        self._pump_task = _spawn(self._pump())
+
+    def close(self) -> None:
+        self._pump_task.cancel()
+
+    async def submit(self, value: Any) -> Any:
+        loop = asyncio.get_running_loop()
+        item = _BatchItem(value, loop.create_future(), loop.time())
+        self._queue.put_nowait(item)
+        try:
+            return await item.future
+        except asyncio.CancelledError:
+            # Cut at the wire deadline before dispatch: the pump drops
+            # cancelled futures when forming, so a dead request never
+            # occupies a batch slot.
+            item.future.cancel()
+            raise
+
+    def _take_live(self, item: Optional[_BatchItem]) -> Optional[_BatchItem]:
+        if item is None or item.future.done():
+            return None
+        return item
+
+    async def _pump(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            first = self._take_live(await self._queue.get())
+            if first is None:
+                continue
+            batch = [first]
+            start = loop.time()
+            while len(batch) < self._max:
+                remaining = self._wait - (loop.time() - start)
+                if remaining <= 0:
+                    break
+                try:
+                    item = self._take_live(
+                        await asyncio.wait_for(
+                            self._queue.get(), timeout=remaining
+                        )
+                    )
+                except asyncio.TimeoutError:
+                    break
+                if item is not None:
+                    batch.append(item)
+            # Bound in-flight batches; formation of the next batch resumes
+            # as soon as the spawn below is off our hands.
+            await self._sem.acquire()
+            self._stats.observe(len(batch), loop.time() - batch[0].enqueued_at)
+            task = _spawn(self._run_batch(batch))
+            task.add_done_callback(lambda _t: self._sem.release())
+
+    async def _run_batch(self, batch: List[_BatchItem]) -> None:
+        inputs = [item.value for item in batch]
+        try:
+            if inspect.iscoroutinefunction(self._method):
+                results = await self._method(inputs)
+            else:
+                loop = asyncio.get_running_loop()
+                ctx = contextvars.copy_context()
+                results = await loop.run_in_executor(
+                    None, lambda: ctx.run(self._method, inputs)
+                )
+            if not isinstance(results, (list, tuple)) or len(results) != len(
+                batch
+            ):
+                raise TypeError(
+                    f"batched method returned "
+                    f"{type(results).__name__} of length "
+                    f"{len(results) if isinstance(results, (list, tuple)) else '?'}"
+                    f"; expected a list of {len(batch)} results"
+                )
+        except Exception as e:
+            for item in batch:
+                if not item.future.done():
+                    item.future.set_exception(e)
+            return
+        for item, result in zip(batch, results):
+            if not item.future.done():
+                item.future.set_result(result)
 
 
 class Replica:
@@ -28,6 +191,9 @@ class Replica:
         deployment_id_str: str,
         replica_id_str: str,
         user_config: Any = None,
+        max_batch_size: int = 1,
+        batch_wait_timeout_s: float = 0.01,
+        max_ongoing_requests: int = 16,
     ):
         cls = cloudpickle.loads(serialized_cls)
         self._deployment_id_str = deployment_id_str
@@ -35,6 +201,11 @@ class Replica:
         self._num_ongoing = 0
         self._total_served = 0
         self._shutting_down = False
+        self._max_batch_size = max(1, int(max_batch_size))
+        self._batch_wait_timeout_s = float(batch_wait_timeout_s)
+        self._max_ongoing_requests = max(1, int(max_ongoing_requests))
+        self._batch_queues: Dict[str, _BatchQueue] = {}
+        self._batch_stats = _BatchStats()
         if inspect.isfunction(cls):
             # Function deployments: wrap into a callable instance.
             fn = cls
@@ -59,6 +230,24 @@ class Replica:
 
     # -- data plane ----------------------------------------------------------
 
+    def _batch_queue_for(self, method_name: str) -> _BatchQueue:
+        bq = self._batch_queues.get(method_name)
+        if bq is None:
+            bq = _BatchQueue(
+                getattr(self._user, method_name),
+                self._max_batch_size,
+                self._batch_wait_timeout_s,
+                # Leave headroom so the next batch executes while the current
+                # one is in flight, without exceeding the replica's overall
+                # concurrency budget.
+                max_concurrent_batches=max(
+                    1, self._max_ongoing_requests // self._max_batch_size
+                ),
+                stats=self._batch_stats,
+            )
+            self._batch_queues[method_name] = bq
+        return bq
+
     async def handle_request(
         self, request_meta: Dict[str, Any], args: Tuple, kwargs: Dict
     ) -> Any:
@@ -75,14 +264,22 @@ class Replica:
             serve_api._multiplexed_model_id_ctx.set(model_id)
         try:
             method_name = request_meta.get("call_method", "__call__")
+            # Batchable shape: single positional payload, no kwargs, no
+            # per-request model id (multiplexed requests must not be fused
+            # across models).
+            if (
+                self._max_batch_size > 1
+                and len(args) == 1
+                and not kwargs
+                and not model_id
+            ):
+                return await self._batch_queue_for(method_name).submit(args[0])
             method = getattr(self._user, method_name)
             if inspect.iscoroutinefunction(method):
                 return await method(*args, **kwargs)
             loop = asyncio.get_running_loop()
             # copy_context: contextvars (multiplexed model id) must follow
             # the call onto the executor thread.
-            import contextvars
-
             ctx = contextvars.copy_context()
             return await loop.run_in_executor(
                 None, lambda: ctx.run(method, *args, **kwargs)
@@ -162,6 +359,7 @@ class Replica:
             "replica_id": self._replica_id_str,
             "num_ongoing_requests": self._num_ongoing,
             "total_served": self._total_served,
+            "batch": self._batch_stats.to_dict(),
         }
 
     async def check_health(self) -> bool:
@@ -180,6 +378,8 @@ class Replica:
         """Drain: wait for ongoing requests to finish (graceful shutdown,
         reference replica.py perform_graceful_shutdown)."""
         self._shutting_down = True
+        for bq in self._batch_queues.values():
+            bq.close()
         deadline = asyncio.get_running_loop().time() + timeout_s
         while self._num_ongoing > 0:
             if asyncio.get_running_loop().time() > deadline:

@@ -77,6 +77,9 @@ def deployment(
     name: Optional[str] = None,
     num_replicas: Union[int, str, None] = None,
     max_ongoing_requests: Optional[int] = None,
+    max_queued_requests: Optional[int] = None,
+    max_batch_size: Optional[int] = None,
+    batch_wait_timeout_s: Optional[float] = None,
     autoscaling_config: Union[AutoscalingConfig, dict, None] = None,
     user_config: Optional[Any] = None,
     health_check_period_s: Optional[float] = None,
@@ -92,6 +95,12 @@ def deployment(
             cfg.num_replicas = int(num_replicas)
         if max_ongoing_requests is not None:
             cfg.max_ongoing_requests = max_ongoing_requests
+        if max_queued_requests is not None:
+            cfg.max_queued_requests = max_queued_requests
+        if max_batch_size is not None:
+            cfg.max_batch_size = max_batch_size
+        if batch_wait_timeout_s is not None:
+            cfg.batch_wait_timeout_s = batch_wait_timeout_s
         ac = autoscaling_config
         if num_replicas == "auto" and ac is None:
             ac = AutoscalingConfig(min_replicas=1, max_replicas=8)

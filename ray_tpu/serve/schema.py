@@ -27,6 +27,15 @@ class AutoscalingConfig:
     downscale_delay_s: float = 60.0
     metrics_interval_s: float = 0.5
     look_back_period_s: float = 5.0
+    # Queue-driven scaling: routers report per-deployment queue depth
+    # (requests waiting for a replica slot); the controller smooths the
+    # total with this EWMA factor and adds it to ongoing load when sizing
+    # the replica set, so sustained queueing scales up even while every
+    # replica is saturated at max_ongoing_requests.
+    queue_ewma_alpha: float = 0.5
+    # Router metrics older than this are dropped from the depth sum
+    # (a dead router's last report must not pin the deployment scaled up).
+    queue_metric_staleness_s: float = 3.0
 
     def to_dict(self) -> Dict[str, Any]:
         return asdict(self)
@@ -42,6 +51,17 @@ class DeploymentConfig:
 
     num_replicas: int = 1
     max_ongoing_requests: int = 16
+    # Router-side queue cap: requests waiting for a replica slot beyond this
+    # are shed immediately with DeploymentOverloadedError (-1 -> the
+    # config.serve_max_queued_requests default).
+    max_queued_requests: int = -1
+    # Continuous batching (reference: @serve.batch / Orca-style iteration
+    # scheduling): >1 makes the replica coalesce concurrent requests to the
+    # same method into one user-code call with a list argument. A batch
+    # launches when full or batch_wait_timeout_s after its first request,
+    # and the next batch forms while in-flight ones execute.
+    max_batch_size: int = 1
+    batch_wait_timeout_s: float = 0.01
     user_config: Optional[Any] = None
     autoscaling_config: Optional[AutoscalingConfig] = None
     health_check_period_s: float = 2.0
@@ -76,6 +96,9 @@ class HTTPOptions:
     host: str = "127.0.0.1"
     port: int = 8000
     grpc_port: Optional[int] = None
+    # False skips the proxy actor entirely (handle-only serving — loadgen
+    # and the chaos serve suite drive the router directly).
+    enabled: bool = True
 
     def to_dict(self) -> Dict[str, Any]:
         return asdict(self)

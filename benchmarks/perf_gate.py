@@ -17,6 +17,10 @@ lower is better carry a ``ceiling`` instead (measured must stay at or
 below it). A metric present in the floors file but missing from the
 results is a failure too (a silently-dropped benchmark must not pass the
 gate).
+
+Runtime telemetry stays ENABLED for every gated run: the put/get/transfer
+floors therefore bound the instrumented hot paths, and the dedicated
+``telemetry_overhead_ns`` ceiling bounds the per-record cost itself.
 """
 
 from __future__ import annotations

@@ -238,6 +238,19 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     # cluster bring-up, and graceful shutdown before the loop is abandoned.
     "driver_bringup_timeout_s": 120.0,
     "driver_shutdown_timeout_s": 30.0,
+    # ---- runtime telemetry plane (_private/telemetry.py). ----
+    # Master switch for the per-process flush loops; the record hot paths
+    # are unconditional (a bound-cell float add) and stay on regardless.
+    "telemetry_enabled": True,
+    # Per-process snapshot-and-reset flush cadence over ReportTelemetry.
+    # 0 disables periodic flushing (exit flushes still run).
+    "telemetry_flush_interval_s": 2.0,
+    # Flight-recorder ring capacity (structured lifecycle events/process).
+    "telemetry_flight_capacity": 4096,
+    # A metrics snapshot (app-metric KV blob or telemetry gauge source)
+    # older than this is treated as a dead process's leftovers: gauges are
+    # dropped from /metrics and stale KV snapshots are GC'd.
+    "metrics_stale_after_s": 30.0,
 }
 
 

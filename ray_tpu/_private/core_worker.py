@@ -23,7 +23,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import cloudpickle
 
-from ray_tpu._private import rpc, serialization
+from ray_tpu._private import rpc, serialization, telemetry
 from ray_tpu._private.common import (
     ActorDiedError,
     ActorUnavailableError,
@@ -1085,6 +1085,10 @@ class CoreWorker:
     def start_background(self) -> None:
         self._loop = asyncio.get_running_loop()
         self._bg_tasks.append(rpc.spawn(self._flush_loop()))
+        # Periodic runtime-telemetry flush to the GCS aggregate. Idempotent
+        # per process: in an in-process cluster the driver's CoreWorker wins
+        # and the shared registry flushes once.
+        telemetry.start_flusher(self.gcs.call, self.worker_id, self.node_id)
 
     async def _flush_loop(self) -> None:
         while not self.closed:

@@ -24,7 +24,9 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 import msgpack
 
-from ray_tpu._private import aiocheck, rpc, wire
+from collections import deque
+
+from ray_tpu._private import aiocheck, rpc, telemetry, wire
 from ray_tpu._private.pubsub import Publisher
 from ray_tpu._private.common import PlacementGroupSpec, ResourceSet, config
 
@@ -160,12 +162,40 @@ class GcsServer:
         # subprocess flushes (ReportDeadlineStats deltas + exit-time flush).
         # The chaos no-call-outlives-deadline invariant reads `overruns`
         # here so worker-side overruns are visible, not just driver-side.
-        self.worker_deadline_stats: Dict[str, Any] = {
+        self.worker_deadline_stats: Dict[str, Any] = {  # telemetry: allow-adhoc-stats
             "met": 0,
             "shed": 0,
             "enforced": 0,
             "overruns": [],  # (worker_id, method, seconds late)
         }
+        # Cluster-wide runtime-telemetry aggregate keyed by
+        # (component, node, name), fed by per-process ReportTelemetry
+        # flushes (telemetry.py); the dashboard /metrics endpoint renders
+        # it as Prometheus text next to the app-metric export.
+        self.telemetry: Dict[str, Any] = telemetry.new_aggregate()
+        # Merged flight-recorder ring: lifecycle events drained from every
+        # reporting process, kept in arrival order (entries carry wall-clock
+        # timestamps; the dump step sorts). Sized for a whole cluster.
+        self.flight_events: deque = deque(
+            maxlen=8 * config.telemetry_flight_capacity
+        )
+        # Service-latency histogram observed around every async handler
+        # dispatch on this server (rpc.Connection dispatch_observer).
+        lat = telemetry.histogram(
+            "gcs",
+            "rpc_latency_s",
+            "GCS handler service latency by method",
+            buckets=telemetry.LATENCY_BUCKETS_S,
+        )
+        _lat_cells: Dict[str, Any] = {}
+
+        def _observe_latency(method: str, dt: float) -> None:
+            cell = _lat_cells.get(method)
+            if cell is None:
+                cell = _lat_cells[method] = lat.cell(method=method)
+            cell.observe(dt)
+
+        self.server.dispatch_observer = _observe_latency
         # Monotonic cluster-view version; every membership/resource change
         # bumps it and broadcasts a delta (reference: ray_syncer.h:88
         # bidirectional versioned sync streams).
@@ -387,6 +417,8 @@ class GcsServer:
         s.register("ReportActorReady", self._report_actor_ready)
         s.register("ReportWorkerDied", self._report_worker_died)
         s.register("ReportDeadlineStats", self._report_deadline_stats)
+        s.register("ReportTelemetry", self._report_telemetry)
+        s.register("GetTelemetry", self._get_telemetry)
         s.register("KillActor", self._kill_actor)
         s.register("KVPut", self._kv_put)
         s.register("KVGet", self._kv_get)
@@ -697,6 +729,9 @@ class GcsServer:
             await self._fail_actor(actor, p["error"], creation_failed=True)
             return {"ok": True}
         actor.state = ALIVE
+        telemetry.record_event(
+            "gcs", "actor_state", actor_id=actor.actor_id, state=ALIVE
+        )
         actor.addr = tuple(p["addr"])
         actor.worker_id = p["worker_id"]
         actor.node_id = p["node_id"]
@@ -715,6 +750,13 @@ class GcsServer:
         if actor.max_restarts == -1 or actor.num_restarts < actor.max_restarts:
             actor.num_restarts += 1
             actor.state = RESTARTING
+            telemetry.record_event(
+                "gcs",
+                "actor_state",
+                actor_id=actor.actor_id,
+                state=RESTARTING,
+                cause=cause,
+            )
             actor.addr = None
             logger.info(
                 "restarting actor %s (%d/%s): %s",
@@ -740,6 +782,9 @@ class GcsServer:
 
     async def _fail_actor(self, actor: ActorInfo, cause: str, creation_failed=False) -> None:
         actor.state = DEAD
+        telemetry.record_event(
+            "gcs", "actor_state", actor_id=actor.actor_id, state=DEAD, cause=cause
+        )
         self.events.emit(
             "ACTOR_DEAD",
             f"actor {actor.actor_id[:8]} died: {cause}",
@@ -786,6 +831,51 @@ class GcsServer:
         for method, late in p.get("overruns", []):
             agg["overruns"].append((wid, method, float(late)))
         return {"ok": True}
+
+    async def _report_telemetry(self, conn, p):
+        """Fold one process's runtime-telemetry flush (additive counter/
+        histogram deltas, gauge last-values, drained flight-recorder
+        events) into the cluster aggregate. RETRY_NONE like
+        ReportDeadlineStats: a dropped report rides the sender's next
+        flush instead of being re-issued."""
+        telemetry.ingest(self.telemetry, {"node": p["node"], "metrics": p["metrics"]})
+        src = p["source"]
+        for ts, comp, ev, fields in p.get("events", []):
+            fields = dict(fields)
+            fields.setdefault("source", src)
+            self.flight_events.append((ts, comp, ev, fields))
+        return {"ok": True}
+
+    def _drain_local_telemetry(self) -> None:
+        """Fold this process's own registry into the aggregate. Covers a
+        GCS running without any co-resident flusher; when a flusher IS
+        active in this process (in-process raylet/driver), it owns the
+        drain — snapshot-and-reset makes either owner exactly-once."""
+        if telemetry.flusher_active():
+            return
+        payload = telemetry.flush_delta("gcs", "gcs")
+        if payload is None:
+            return
+        telemetry.ingest(self.telemetry, payload)
+        for ts, comp, ev, fields in payload.get("events", []):
+            fields = dict(fields)
+            fields.setdefault("source", "gcs")
+            self.flight_events.append((ts, comp, ev, fields))
+
+    async def _get_telemetry(self, conn, p):
+        """The runtime-metric aggregate plus the deadline-stats aggregate
+        (dashboard /metrics render input)."""
+        self._drain_local_telemetry()
+        wds = self.worker_deadline_stats
+        return {
+            "telemetry": self.telemetry,
+            "worker_deadline_stats": {
+                "met": wds["met"],
+                "shed": wds["shed"],
+                "enforced": wds["enforced"],
+                "overruns": [list(o) for o in wds["overruns"]],
+            },
+        }
 
     async def _get_actor(self, conn, p):
         actor = self.actors.get(p["actor_id"])

@@ -17,10 +17,34 @@ import logging
 import time
 from typing import Dict, List, Optional, Tuple
 
-from ray_tpu._private import rpc, shm
+from ray_tpu._private import rpc, shm, telemetry
 from ray_tpu._private.common import ObjectLostError, config
 
 logger = logging.getLogger(__name__)
+
+_TEL_PUT_BYTES = telemetry.counter(
+    "object", "put_bytes", "bytes written into the local shm arena"
+)
+_TEL_GET_BYTES = telemetry.counter(
+    "object", "get_bytes", "bytes mapped from the local shm arena by get()"
+)
+_TEL_PUT_LAT = telemetry.histogram(
+    "object", "put_latency_s", "plasma put (create+write+seal) latency",
+    buckets=telemetry.LATENCY_BUCKETS_S,
+)
+_TEL_GET_LAT = telemetry.histogram(
+    "object", "get_latency_s", "plasma get round-trip latency",
+    buckets=telemetry.LATENCY_BUCKETS_S,
+)
+_TEL_PULLS = telemetry.counter(
+    "object", "pulls", "remote-object pulls requested via the local raylet"
+)
+_TEL_RELEASE_FLUSHES = telemetry.counter(
+    "object", "release_flushes", "debounced batched-release flushes"
+)
+_TEL_RELEASE_OIDS = telemetry.counter(
+    "object", "release_oids", "object holds dropped via batched release"
+)
 
 # Memory-store entry kinds.
 INLINE = "inline"  # payload bytes present locally
@@ -113,11 +137,14 @@ class PlasmaClient:
         return view[off : off + size]
 
     async def put_serialized(self, oid: str, serialized) -> None:
+        t0 = time.monotonic()
         size = max(1, serialized.total_size)
         reply = await self.conn.call("ObjCreate", {"oid": oid, "size": size, "pin": True})
         if reply.get("exists"):
             return  # already stored (e.g. deterministic re-execution)
         serialized.write_to(self._slice(reply))
+        _TEL_PUT_BYTES.inc(size)
+        _TEL_PUT_LAT.observe(time.monotonic() - t0)
         # Seal as a one-way push: same-connection FIFO means our own later
         # ObjGet/ObjCreate calls observe the seal, and remote readers reach
         # the raylet after the owner advertises the object — both ordered
@@ -125,6 +152,7 @@ class PlasmaClient:
         self.conn.push_nowait("ObjSeal", {"oid": oid})
 
     async def put_bytes(self, oid: str, payload: bytes) -> None:
+        t0 = time.monotonic()
         reply = await self.conn.call(
             "ObjCreate", {"oid": oid, "size": max(1, len(payload)), "pin": True}
         )
@@ -132,10 +160,13 @@ class PlasmaClient:
             return
         shm.copy_into(self._slice(reply), payload)
         self.conn.push_nowait("ObjSeal", {"oid": oid})
+        _TEL_PUT_BYTES.inc(max(1, len(payload)))
+        _TEL_PUT_LAT.observe(time.monotonic() - t0)
 
     async def get(
         self, oids: List[str], timeout: Optional[float] = None, block: bool = True
     ) -> Tuple[Dict[str, memoryview], List[str]]:
+        t0 = time.monotonic()
         reply = await self.conn.call(
             "ObjGet",
             {"oids": oids, "timeout": timeout, "block": block},
@@ -145,6 +176,8 @@ class PlasmaClient:
         for oid, meta in reply["found"].items():
             self.held[oid] = self.held.get(oid, 0) + 1
             found[oid] = self._slice(meta)
+            _TEL_GET_BYTES.inc(meta["size"])
+        _TEL_GET_LAT.observe(time.monotonic() - t0)
         return found, reply["missing"]
 
     async def contains(self, oids: List[str]) -> Dict[str, bool]:
@@ -157,6 +190,7 @@ class PlasmaClient:
         """Ask the local raylet to fetch a remote object, then map it.
         purpose feeds the raylet's prioritized pull admission (reference:
         pull_manager.h): "get" > "wait" > "task_arg"."""
+        _TEL_PULLS.inc()
         meta = await self.conn.call(
             "PullObject",
             {"oid": oid, "from_addr": list(from_addr), "purpose": purpose},
@@ -197,6 +231,8 @@ class PlasmaClient:
         pending, self._release_pending = self._release_pending, set()
         if not pending or self.conn.closed:
             return
+        _TEL_RELEASE_FLUSHES.inc()
+        _TEL_RELEASE_OIDS.inc(len(pending))
         task = rpc.spawn(self.release_many(list(pending)))
         # Retrieve any exception so a closed connection doesn't log noise.
         task.add_done_callback(

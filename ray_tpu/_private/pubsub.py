@@ -15,10 +15,20 @@ import logging
 from collections import deque
 from typing import Any, Dict
 
-from ray_tpu._private import rpc
+from ray_tpu._private import rpc, telemetry
 from ray_tpu._private.common import config
 
 logger = logging.getLogger(__name__)
+
+_TEL_PUBLISHED = telemetry.counter(
+    "gcs", "pubsub_published", "messages published to the GCS pubsub"
+)
+_TEL_FANOUT = telemetry.counter(
+    "gcs", "pubsub_fanout", "per-subscriber deliveries enqueued (fan-out)"
+)
+_TEL_DROPPED = telemetry.counter(
+    "gcs", "pubsub_dropped", "messages shed from slow subscribers' queues"
+)
 
 
 class _SubscriberState:
@@ -58,6 +68,7 @@ class Publisher:
     def publish(self, channel: str, msg: Any) -> None:
         """Enqueue to every subscriber; returns immediately (never blocks the
         caller on a slow subscriber's socket)."""
+        _TEL_PUBLISHED.inc()
         subs = self.channels.get(channel)
         if not subs:
             return
@@ -66,9 +77,11 @@ class Publisher:
             if state.conn.closed:
                 subs.pop(id(state.conn), None)
                 continue
+            _TEL_FANOUT.inc()
             if len(state.queue) == state.queue.maxlen:
                 state.dropped += 1
                 self.total_dropped += 1
+                _TEL_DROPPED.inc()
                 if state.dropped in (1, 100, 10000):
                     logger.warning(
                         "pubsub subscriber %s slow on %r: %d messages dropped",

@@ -21,7 +21,17 @@ import heapq
 import itertools
 from typing import List, Tuple
 
+from ray_tpu._private import telemetry
+
 PRIORITY = {"get": 0, "wait": 1, "task_arg": 2}
+
+_TEL_STALLED = telemetry.counter(
+    "object", "pull_streams_stalled", "inbound chunk streams declared stalled"
+)
+_TEL_REREQUESTED = telemetry.counter(
+    "object", "pull_streams_rerequested",
+    "stalled chunk streams re-requested from the source",
+)
 
 
 class PullStalled(Exception):
@@ -135,12 +145,14 @@ class PullManager:
                 last, last_change = cur, now
             elif now - last_change >= self.stall_timeout_s:
                 self.stalled_streams += 1
+                _TEL_STALLED.inc()
                 raise PullStalled(
                     f"chunk stream stalled at {cur!r} for "
                     f"{now - last_change:.1f}s"
                 )
             if now >= deadline:
                 self.stalled_streams += 1
+                _TEL_STALLED.inc()
                 raise PullStalled(f"chunk stream incomplete after {timeout}s")
 
     def stats(self) -> dict:

@@ -16,10 +16,20 @@ import asyncio
 import logging
 from typing import Dict, Tuple
 
-from ray_tpu._private import rpc
+from ray_tpu._private import rpc, telemetry
 from ray_tpu._private.common import adaptive_chunk_size, config
 
 logger = logging.getLogger(__name__)
+
+_TEL_PUSHES = telemetry.counter(
+    "object", "pushes_completed", "source-side object pushes completed"
+)
+_TEL_PUSH_CHUNKS = telemetry.counter(
+    "object", "push_chunks_sent", "one-way data chunks streamed to peers"
+)
+_TEL_PUSH_BYTES = telemetry.counter(
+    "object", "transfer_bytes_out", "object bytes pushed to remote nodes"
+)
 
 
 class PushManager:
@@ -33,7 +43,7 @@ class PushManager:
         self._conn_futs: Dict[Tuple[str, int], asyncio.Future] = {}
         # Global chunk budget across all destinations.
         self._sem = asyncio.Semaphore(max(1, config.push_manager_max_chunks))
-        self.stats = {
+        self.stats = {  # telemetry: allow-adhoc-stats (pre-telemetry node_stats surface)
             "pushes_started": 0,
             "pushes_completed": 0,
             "pushes_deduped": 0,
@@ -57,6 +67,7 @@ class PushManager:
         try:
             await self._do_push(oid, dest)
             self.stats["pushes_completed"] += 1
+            _TEL_PUSHES.inc()
             fut.set_result(True)
         except BaseException as e:
             if not fut.done():
@@ -122,6 +133,8 @@ class PushManager:
                             f"push to {dest} stalled (drain timeout)"
                         )
                     self.stats["chunks_sent"] += 1
+                    _TEL_PUSH_CHUNKS.inc()
+                    _TEL_PUSH_BYTES.inc(n)
                 finally:
                     self.stats["inflight_chunks"] -= 1
                     self._sem.release()

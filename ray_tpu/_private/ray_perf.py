@@ -64,6 +64,34 @@ def _bench_release_batched() -> float:
     return rate
 
 
+def _bench_telemetry_overhead() -> float:
+    """Nanoseconds per hot-path telemetry record (one bound counter inc +
+    one histogram observe) — the price every instrumented site pays. Gated
+    with a ceiling: a regression here (a lock on the record path, an
+    allocation per event) taxes every RPC frame and object operation."""
+    from ray_tpu._private import telemetry
+
+    c = telemetry.counter("perf", "overhead_probe", "overhead bench").default
+    h = telemetry.histogram(
+        "perf", "overhead_probe_s", "overhead bench",
+        buckets=telemetry.LATENCY_BUCKETS_S,
+    ).default
+    n = 200_000
+    for _ in range(10_000):  # warmup
+        c.inc()
+        h.observe(0.001)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            c.inc()
+            h.observe(0.001)
+        dt = time.perf_counter() - t0
+        best = min(best, dt / n * 1e9)
+    print(f"telemetry record overhead: {best:.0f} ns")
+    return best
+
+
 def _bench_transfer_16mb() -> float:
     """Two-node 16MB object transfers (PushChunk blob sidecar): each cycle
     produces fresh objects on node A and consumes them on node B, so every
@@ -220,6 +248,7 @@ def main(json_path: str = "") -> Dict[str, float]:
     ray_tpu.shutdown()
 
     results["transfer_16mb_per_s"] = _bench_transfer_16mb()
+    results["telemetry_overhead_ns"] = _bench_telemetry_overhead()
     if json_path:
         with open(json_path, "w") as f:
             json.dump(results, f, indent=2)

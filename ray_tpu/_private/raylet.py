@@ -25,7 +25,8 @@ import time
 from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Set, Tuple
 
-from ray_tpu._private import aiocheck, external_storage, rpc, shm
+from ray_tpu._private import aiocheck, external_storage, rpc, shm, telemetry
+from ray_tpu._private import pull_manager as pull_manager_mod
 from ray_tpu._private.pull_manager import PullStalled
 from ray_tpu._private.push_manager import PushManager
 from ray_tpu._private.common import ResourceSet, adaptive_chunk_size, config
@@ -33,6 +34,41 @@ from ray_tpu._private.gcs import GcsClient
 from ray_tpu._private.store_core import make_store_core
 
 logger = logging.getLogger(__name__)
+
+# Lease/worker-pool counters (cells bound per raylet in __init__ so
+# in-process multi-raylet clusters attribute correctly) and object-store
+# lifecycle counters. Gauges refresh from _tel_refresh_gauges at each
+# pool/lease mutation.
+_TEL_LEASE_GRANTED = telemetry.counter(
+    "raylet", "lease_granted", "worker leases committed (grant ledger entries)"
+)
+_TEL_LEASE_RELEASED = telemetry.counter(
+    "raylet", "lease_released", "leases released (worker returned or killed)"
+)
+_TEL_LEASE_CANCELLED = telemetry.counter(
+    "raylet", "lease_cancelled", "queued lease requests cancelled"
+)
+_TEL_LEASE_DUPLICATE = telemetry.counter(
+    "raylet", "lease_duplicate_avoided",
+    "duplicate lease grants answered idempotently via the ledger",
+)
+_TEL_WORKERS_STARTED = telemetry.counter(
+    "raylet", "workers_started", "worker processes spawned"
+)
+_TEL_WORKERS_EXITED = telemetry.counter(
+    "raylet", "workers_exited", "worker processes reaped"
+)
+_TEL_WORKERS = telemetry.gauge("raylet", "workers", "worker processes attached")
+_TEL_WORKERS_IDLE = telemetry.gauge(
+    "raylet", "workers_idle", "idle pooled workers"
+)
+_TEL_LEASES_ACTIVE = telemetry.gauge("raylet", "leases_active", "live leases")
+_TEL_OBJ_SEALED = telemetry.counter(
+    "object", "sealed", "objects sealed in the local store"
+)
+_TEL_OBJ_EVICTED = telemetry.counter(
+    "object", "evicted", "sealed objects LRU-evicted under allocation pressure"
+)
 
 
 def detect_tpu_resources() -> Dict[str, float]:
@@ -302,6 +338,21 @@ class _ArenaChunkSink:
 
 
 class Raylet:
+    # Class-level fallbacks (unlabeled cells, placeholder node id) so
+    # ledger/pool helpers stay callable on partially-constructed instances
+    # (tests build bare Raylets with object.__new__); __init__ rebinds them
+    # with the node label.
+    node_id = "?"
+    _tel_lease_granted = _TEL_LEASE_GRANTED.cell()
+    _tel_lease_released = _TEL_LEASE_RELEASED.cell()
+    _tel_lease_cancelled = _TEL_LEASE_CANCELLED.cell()
+    _tel_lease_duplicate = _TEL_LEASE_DUPLICATE.cell()
+    _tel_workers_started = _TEL_WORKERS_STARTED.cell()
+    _tel_workers_exited = _TEL_WORKERS_EXITED.cell()
+    _tel_workers = _TEL_WORKERS.cell()
+    _tel_workers_idle = _TEL_WORKERS_IDLE.cell()
+    _tel_leases_active = _TEL_LEASES_ACTIVE.cell()
+
     def __init__(
         self,
         gcs_addr: Tuple[str, int],
@@ -441,6 +492,19 @@ class Raylet:
         # but the lease is not in `leases` yet, so ledger observers must
         # treat the node as busy while this is nonzero.
         self.grants_in_flight = 0
+
+        # Telemetry cells bound to this raylet (in-process clusters run
+        # several raylets in one registry; the label keeps them apart).
+        _nid = self.node_id[:8]
+        self._tel_lease_granted = _TEL_LEASE_GRANTED.cell(raylet=_nid)
+        self._tel_lease_released = _TEL_LEASE_RELEASED.cell(raylet=_nid)
+        self._tel_lease_cancelled = _TEL_LEASE_CANCELLED.cell(raylet=_nid)
+        self._tel_lease_duplicate = _TEL_LEASE_DUPLICATE.cell(raylet=_nid)
+        self._tel_workers_started = _TEL_WORKERS_STARTED.cell(raylet=_nid)
+        self._tel_workers_exited = _TEL_WORKERS_EXITED.cell(raylet=_nid)
+        self._tel_workers = _TEL_WORKERS.cell(raylet=_nid)
+        self._tel_workers_idle = _TEL_WORKERS_IDLE.cell(raylet=_nid)
+        self._tel_leases_active = _TEL_LEASES_ACTIVE.cell(raylet=_nid)
 
         # Placement group bundles committed on this node:
         # pg_id -> {"base": ResourceSet deducted, "group": ResourceSet added}
@@ -801,6 +865,11 @@ class Raylet:
         handle = self.workers.get(worker_id) or WorkerHandle(worker_id, None)
         handle.proc = proc
         self.workers[worker_id] = handle
+        self._tel_workers_started.inc()
+        telemetry.record_event(
+            "raylet", "worker_started", worker_id=worker_id, node=self.node_id[:8]
+        )
+        self._tel_refresh_gauges()
         if handle.kill_requested:
             self._kill_worker_proc(handle)
         # Log pipeline (reference: log_monitor.py tailing session/logs/*):
@@ -918,6 +987,15 @@ class Raylet:
         del self.workers[handle.worker_id]
         if handle in self.idle_workers:
             self.idle_workers.remove(handle)
+        self._tel_workers_exited.inc()
+        telemetry.record_event(
+            "raylet",
+            "worker_exit",
+            worker_id=handle.worker_id,
+            node=self.node_id[:8],
+            cause=cause,
+        )
+        self._tel_refresh_gauges()
         if handle.lease_id and handle.lease_id in self.leases:
             del self.leases[handle.lease_id]
             self._mark_lease_released(handle.lease_id)
@@ -1313,12 +1391,27 @@ class Raylet:
         # Burn the id so a late-arriving duplicate frame cannot re-queue a
         # grantable request for it.
         self._burn_lease_id(lease_id)
+        self._tel_lease_cancelled.inc()
+        telemetry.record_event(
+            "raylet", "lease_cancelled", lease_id=lease_id, node=self.node_id[:8]
+        )
         return {"ok": True}
 
     _GRANT_LEDGER_CAP = 4096
 
+    def _tel_refresh_gauges(self) -> None:
+        """Re-sample the worker-pool/lease gauges (three float stores);
+        called from every pool or lease-table mutation site."""
+        self._tel_workers.set(len(self.workers))
+        self._tel_workers_idle.set(len(self.idle_workers))
+        self._tel_leases_active.set(len(self.leases))
+
     def _record_granted(self, lease_id: str) -> None:
         self.granted_lease_ids[lease_id] = True  # True = live (not released)
+        self._tel_lease_granted.inc()
+        telemetry.record_event(
+            "raylet", "lease_granted", lease_id=lease_id, node=self.node_id[:8]
+        )
         while len(self.granted_lease_ids) > self._GRANT_LEDGER_CAP:
             self.granted_lease_ids.popitem(last=False)
 
@@ -1356,6 +1449,10 @@ class Raylet:
         grant failed or the lease was already released.
         """
         self.duplicate_lease_grants_avoided += 1
+        self._tel_lease_duplicate.inc()
+        telemetry.record_event(
+            "raylet", "lease_duplicate", lease_id=lease_id, node=self.node_id[:8]
+        )
         loop = asyncio.get_running_loop()
         deadline = loop.time() + 30.0
         while loop.time() < deadline:
@@ -1479,6 +1576,7 @@ class Raylet:
         handle.leased_since = time.monotonic()  # type: ignore[attr-defined]
         handle.job_id = req.payload.get("job_id") or handle.job_id
         self.leases[req.lease_id] = handle
+        self._tel_refresh_gauges()
         if not req.fut.done():
             req.fut.set_result(self._grant_reply(handle, req.lease_id))
         else:  # caller gave up; return resources
@@ -1509,6 +1607,7 @@ class Raylet:
             self.idle_workers.append(handle)
         else:
             self._kill_worker_proc(handle)
+        self._tel_refresh_gauges()
 
     def _free_lease_resources(self, handle: WorkerHandle) -> None:
         demand = getattr(handle, "demand", None)
@@ -1523,6 +1622,14 @@ class Raylet:
         self._mark_lease_released(lease_id)
         if handle is None:
             return None
+        self._tel_lease_released.inc()
+        telemetry.record_event(
+            "raylet",
+            "lease_released",
+            lease_id=lease_id,
+            node=self.node_id[:8],
+            dirty=bool(dirty),
+        )
         handle.lease_id = None
         if handle.actor_id is None:
             # Pooled worker returning to idle: drop the lease's job
@@ -1535,6 +1642,7 @@ class Raylet:
         elif handle.worker_id in self.workers:
             handle.idle_since = time.monotonic()
             self.idle_workers.append(handle)
+        self._tel_refresh_gauges()
         return handle
 
     async def _return_worker(self, conn, p):
@@ -1726,6 +1834,11 @@ class Raylet:
         candidates.sort()
         for _, vic in candidates:
             self.store.free(vic)
+            _TEL_OBJ_EVICTED.inc()
+            telemetry.record_event(
+                "object", "freed", oid=vic[:16], node=self.node_id[:8],
+                reason="lru_evict",
+            )
             self.obj_last_access.pop(vic, None)
             offset = self.store.alloc(oid, size, pin)
             if offset >= 0:
@@ -2019,6 +2132,13 @@ class Raylet:
                 offset = self._try_alloc(oid, size, pin)
                 if offset >= 0:
                     self.obj_last_access[oid] = time.monotonic()
+                    telemetry.record_event(
+                        "object",
+                        "created",
+                        oid=oid[:16],
+                        size=size,
+                        node=self.node_id[:8],
+                    )
                     return {
                         "arena": self.arena_name,
                         "offset": offset,
@@ -2041,6 +2161,10 @@ class Raylet:
         if self.store.lookup(oid) is None:
             raise rpc.RpcError(f"seal of unknown object {oid[:12]}")
         self.store.seal(oid)
+        _TEL_OBJ_SEALED.inc()
+        telemetry.record_event(
+            "object", "sealed", oid=oid[:16], node=self.node_id[:8]
+        )
         self.obj_last_access[oid] = time.monotonic()
         for fut in self.obj_waiters.pop(oid, []):
             if not fut.done():
@@ -2267,6 +2391,11 @@ class Raylet:
                         break
                     rerequests += 1
                     self.pull_manager.rerequested_streams += 1
+                    pull_manager_mod._TEL_REREQUESTED.inc()
+                    telemetry.record_event(
+                        "object", "pull_rerequest", oid=oid[:16],
+                        node=self.node_id[:8], attempt=rerequests,
+                    )
                     logger.info(
                         "push stream for %s stalled (%s); re-requesting "
                         "(%d/%d)",

@@ -66,6 +66,7 @@ from typing import Any, Awaitable, Callable, Dict, Iterator, List, Optional, Tup
 
 import msgpack
 
+from ray_tpu._private import telemetry
 from ray_tpu._private.common import config
 
 
@@ -106,6 +107,44 @@ _KIND_BLOB = 4
 _KIND_BLOB_REP = 5
 
 _MAX_FRAME = 1 << 31
+
+# Per-kind frame/byte counters, cells bound once at import (indexable by the
+# wire kind, so the send/receive hot paths do one list index + float add).
+# Blob kinds count the sidecar bytes too — the data plane is the point.
+_KIND_NAMES = ("req", "rep", "err", "push", "blob", "blob_rep")
+_TEL_FRAMES_OUT = [
+    telemetry.counter(
+        "rpc", "frames_sent", "frames written, by wire kind"
+    ).cell(kind=k)
+    for k in _KIND_NAMES
+]
+_TEL_BYTES_OUT = [
+    telemetry.counter(
+        "rpc", "bytes_sent", "wire bytes written (control + blob sidecars)"
+    ).cell(kind=k)
+    for k in _KIND_NAMES
+]
+_TEL_FRAMES_IN = [
+    telemetry.counter(
+        "rpc", "frames_received", "frames decoded, by wire kind"
+    ).cell(kind=k)
+    for k in _KIND_NAMES
+]
+_TEL_BYTES_IN = telemetry.counter(
+    "rpc", "bytes_received", "raw socket bytes received"
+)
+_TEL_DL_MET = telemetry.counter(
+    "rpc", "deadline_met", "handlers finished inside their wire deadline"
+)
+_TEL_DL_SHED = telemetry.counter(
+    "rpc", "deadline_shed", "requests dropped as already expired"
+)
+_TEL_DL_ENFORCED = telemetry.counter(
+    "rpc", "deadline_enforced", "handlers cancelled at their wire deadline"
+)
+_TEL_DL_OVERRUNS = telemetry.counter(
+    "rpc", "deadline_overruns", "handlers that outlived deadline + grace"
+)
 
 # _flush joins adjacent small buffers into one transport.write; buffers at or
 # above this size are written individually so large blob memoryviews go to
@@ -412,6 +451,7 @@ class _RpcProtocol(asyncio.Protocol):
         self._drain_waiters.clear()
 
     def data_received(self, data: bytes) -> None:
+        _TEL_BYTES_IN.inc(len(data))
         view = memoryview(data)
         try:
             while True:
@@ -464,6 +504,7 @@ class _RpcProtocol(asyncio.Protocol):
         size = msg[4]
         if not isinstance(size, int) or size < 0 or size > _MAX_FRAME:
             raise RpcError(f"invalid blob length {size!r}")
+        _TEL_FRAMES_IN[msg[1]].inc()
         sink, external = self._conn._select_blob_sink(msg, size)
         if size == 0:
             self._conn._on_blob_complete(msg, sink, external)
@@ -489,8 +530,13 @@ class Connection:
         on_close: Optional[Callable[["Connection"], None]] = None,
         sync_handlers: Optional[Dict[str, Callable]] = None,
         blob_factories: Optional[Dict[str, Callable]] = None,
+        dispatch_observer: Optional[Callable[[str, float], None]] = None,
     ):
         self._handlers = handlers
+        # Optional ``(method, seconds)`` callback fired after each async
+        # handler dispatch — the GCS attaches its service-latency histogram
+        # here (telemetry.py). None (the default) costs one branch.
+        self._dispatch_observer = dispatch_observer
         # Blob sink factories: ``factory(conn, payload, size) -> sink|None``
         # invoked inline from the read path when a kind-4 control frame for
         # that method arrives; None declines (the blob is drained and
@@ -548,10 +594,15 @@ class Connection:
             total = sum(b.nbytes for b in buffers)
             out = [_packb([msg[0], kind, msg[2], msg[3], total])]
             out.extend(buffers)
+            _TEL_FRAMES_OUT[kind].inc()
+            _TEL_BYTES_OUT[kind].inc(len(out[0]) + total)
             return out
         if len(msg) > 4 and msg[4] is not None:
             msg = [msg[0], msg[1], msg[2], msg[3], msg[4] - self._loop.time()]
-        return [_packb(msg)]
+        packed = _packb(msg)
+        _TEL_FRAMES_OUT[kind].inc()
+        _TEL_BYTES_OUT[kind].inc(len(packed))
+        return [packed]
 
     def _send_nowait(self, msg) -> None:
         if self._closed:
@@ -830,6 +881,7 @@ class Connection:
 
     def _on_message(self, msg) -> None:
         msgid, kind, method, payload = msg[0], msg[1], msg[2], msg[3]
+        _TEL_FRAMES_IN[kind].inc()
         if kind == _KIND_REQ:
             deadline = None
             if len(msg) > 4 and msg[4] is not None:
@@ -837,6 +889,10 @@ class Connection:
                 if ttl <= 0:
                     # Shed stale work: the caller has already given up.
                     deadline_stats.shed += 1
+                    _TEL_DL_SHED.inc()
+                    telemetry.record_event(
+                        "rpc", "deadline_shed", method=method, late_s=-ttl
+                    )
                     self.reply_error_nowait(
                         msgid,
                         method,
@@ -888,6 +944,8 @@ class Connection:
         # the ambient deadline here scopes it to this handler and every call
         # it makes downstream.
         _ambient_deadline.set(deadline)
+        obs = self._dispatch_observer
+        t0 = self._loop.time() if obs is not None else 0.0
         try:
             if handler is None:
                 raise RpcError(f"no handler for method {method!r}")
@@ -896,6 +954,8 @@ class Connection:
             else:
                 result = await self._run_deadlined(handler, method, payload, deadline)
         except Exception as e:
+            if obs is not None:
+                obs(method, self._loop.time() - t0)
             # Any handler failure — including ConnectionLost from a dial the
             # handler made to a third party — must produce an error reply, or
             # the caller waits out its full timeout.
@@ -908,6 +968,8 @@ class Connection:
             else:
                 logger.exception("push handler %s failed", method)
             return
+        if obs is not None:
+            obs(method, self._loop.time() - t0)
         if msgid is not None:
             try:
                 if isinstance(result, Blob):
@@ -932,6 +994,10 @@ class Connection:
         remaining = deadline - self._loop.time()
         if remaining <= 0:
             deadline_stats.shed += 1
+            _TEL_DL_SHED.inc()
+            telemetry.record_event(
+                "rpc", "deadline_shed", method=method, late_s=-remaining
+            )
             raise DeadlineExceeded(
                 f"{method} shed before dispatch: deadline expired "
                 f"{-remaining:.3f}s ago"
@@ -940,6 +1006,10 @@ class Connection:
             result = await asyncio.wait_for(handler(self, payload), remaining)
         except asyncio.TimeoutError:
             deadline_stats.enforced += 1
+            _TEL_DL_ENFORCED.inc()
+            telemetry.record_event(
+                "rpc", "deadline_enforced", method=method, budget_s=remaining
+            )
             raise DeadlineExceeded(
                 f"{method} handler cancelled at its deadline "
                 f"({remaining:.3f}s budget on arrival)"
@@ -948,8 +1018,13 @@ class Connection:
             late = self._loop.time() - deadline
             if late > config.rpc_deadline_grace_s:
                 deadline_stats.overruns.append((method, late))
+                _TEL_DL_OVERRUNS.inc()
+                telemetry.record_event(
+                    "rpc", "deadline_overrun", method=method, late_s=late
+                )
             elif late <= 0:
                 deadline_stats.met += 1
+                _TEL_DL_MET.inc()
         return result
 
     # -- lifecycle -----------------------------------------------------------
@@ -1024,6 +1099,9 @@ class Server:
         self._server: Optional[asyncio.AbstractServer] = None
         self.connections: set = set()
         self._on_disconnect: Optional[Callable[[Connection], None]] = None
+        # Per-dispatch ``(method, seconds)`` hook, copied onto every
+        # accepted connection (service-latency telemetry; see Connection).
+        self.dispatch_observer: Optional[Callable[[str, float], None]] = None
 
     def handler(self, name: str):
         def deco(fn):
@@ -1057,6 +1135,7 @@ class Server:
             on_close=self._conn_closed,
             sync_handlers=self._sync_handlers,
             blob_factories=self._blob_factories,
+            dispatch_observer=self.dispatch_observer,
         )
         self.connections.add(conn)
         return conn._protocol
@@ -1232,7 +1311,18 @@ class RetryableConnection:
         self._rng = rng or random.Random()
         self._lock: Optional[asyncio.Lock] = None  # lazy: loop-bound
         self._closed = False
-        self.stats = {"redials": 0, "retries": 0, "queued": 0}
+        # Legacy per-channel dict kept for direct readers (tests, repr);
+        # the cluster-visible copies are the telemetry cells below.
+        self.stats = {"redials": 0, "retries": 0, "queued": 0}  # telemetry: allow-adhoc-stats
+        self._tel_redials = telemetry.counter(
+            "rpc", "redials", "reconnects of a retryable channel"
+        ).cell(channel=name)
+        self._tel_retries = telemetry.counter(
+            "rpc", "retries", "calls transparently re-issued after a failure"
+        ).cell(channel=name)
+        self._tel_queued = telemetry.counter(
+            "rpc", "retry_queued", "calls that waited out a reconnect"
+        ).cell(channel=name)
 
     @property
     def closed(self) -> bool:
@@ -1263,6 +1353,7 @@ class RetryableConnection:
         queued = self._lock.locked()
         if queued:
             self.stats["queued"] += 1
+            self._tel_queued.inc()
         async with self._lock:
             conn = self.conn
             if conn is not None and not conn.closed:
@@ -1272,6 +1363,8 @@ class RetryableConnection:
             conn = await self._dial()
             self.conn = conn
             self.stats["redials"] += 1
+            self._tel_redials.inc()
+            telemetry.record_event("rpc", "redial", channel=self._name)
             if self._on_reconnect is not None:
                 await self._on_reconnect(conn)
             return conn
@@ -1325,6 +1418,10 @@ class RetryableConnection:
                     if remaining <= delay:
                         raise
                 self.stats["retries"] += 1
+                self._tel_retries.inc()
+                telemetry.record_event(
+                    "rpc", "retry", channel=self._name, method=method
+                )
                 logger.debug(
                     "%s: retrying %s after %s (attempt %d, sleeping %.3fs)",
                     self._name, method, type(e).__name__, attempt, delay,

@@ -1,0 +1,583 @@
+"""Runtime telemetry plane: hot-path metrics core + flight recorder.
+
+Analog of the reference's internal stats pipeline (src/ray/stats/metric.h
+feeding the MetricsAgent, python/ray/_private/metrics_agent.py), but for
+*this* runtime's own counters — the numbers that previously lived in
+ad-hoc dicts (``rpc.deadline_stats``, ``RetryableConnection.stats``, the
+raylet grant ledger, plasma push/pull counters, ``router.stats()``) and
+died with the process. Application metrics keep their own pipeline
+(``ray_tpu/util/metrics.py``); the dashboard merges both exports on
+``/metrics``.
+
+Design constraints, in order:
+
+1. **Amortized-zero-cost record.** Instrumentation sites bind a *cell*
+   once (module import / object construction) and the hot path is a bound
+   method doing one float add — no dict lookup, no lock, no branch on a
+   config flag. Everything here runs on the owning process's event loop
+   (or is tolerant of a lost increment under the GIL), so cells are
+   lock-free; locks guard only registration, which is cold.
+2. **Snapshot-and-reset flush.** ``flush_delta()`` drains counters and
+   histograms as additive deltas with no awaits between read and reset
+   (same contract as worker_main's ``_deadline_stats_delta``), so flushes
+   from multiple drainers in one process — e.g. an in-process raylet's
+   flush loop racing the GCS's local drain — each carry a disjoint slice
+   and the aggregate stays exactly-once. Gauges report last value and are
+   never reset.
+3. **One wire shape.** The same payload rides ``ReportTelemetry`` (worker
+   subprocess -> GCS), the GCS's local drain, and ``loadgen --json``; the
+   GCS folds it into one aggregate keyed by (component, node, name) and
+   the dashboard renders that as Prometheus text.
+
+The **flight recorder** is a fixed-size ring of structured lifecycle
+events (lease granted/released, object sealed/freed, actor state edges,
+retry/redial, shed/enforce, replica evict). ``record_event`` appends a
+tuple — cheap enough for hot paths. The flusher drains local events to
+the GCS's merged ring; the chaos runner dumps ring + aggregate into a
+time-ordered JSONL timeline next to the failing seed on any invariant
+violation.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu._private.common import config
+
+COUNTER = "counter"
+GAUGE = "gauge"
+HISTOGRAM = "histogram"
+
+# Default latency buckets (seconds): microseconds to tens of seconds.
+LATENCY_BUCKETS_S = (
+    0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0
+)
+# Default size buckets (bytes): 256 B to 256 MiB.
+SIZE_BUCKETS = (
+    256, 4096, 65536, 1 << 20, 16 << 20, 256 << 20
+)
+
+
+def _labels_key(labels: Dict[str, str]) -> str:
+    return json.dumps(sorted(labels.items()))
+
+
+class _Cell:
+    """One (family, labelset) scalar. ``inc``/``set`` are the hot path."""
+
+    __slots__ = ("v",)
+
+    def __init__(self):
+        self.v = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        self.v += n
+
+    def set(self, v: float) -> None:
+        self.v = v
+
+
+class _HistCell:
+    """One (family, labelset) fixed-bucket histogram."""
+
+    __slots__ = ("bounds", "counts", "sum", "total")
+
+    def __init__(self, bounds: Sequence[float]):
+        self.bounds = bounds
+        self.counts = [0] * (len(bounds) + 1)
+        self.sum = 0.0
+        self.total = 0
+
+    def observe(self, v: float) -> None:
+        # Linear scan beats bisect for <=12 buckets and avoids an import;
+        # typical observations land in the first few buckets anyway.
+        for i, b in enumerate(self.bounds):
+            if v <= b:
+                self.counts[i] += 1
+                break
+        else:
+            self.counts[-1] += 1
+        self.sum += v
+        self.total += 1
+
+
+class Family:
+    """A named metric and its per-labelset cells.
+
+    Hot paths call ``family.cell(**labels)`` once at bind time and then
+    ``cell.inc(...)`` forever after; ``family.inc()`` etc. operate on the
+    unlabeled default cell for sites without label dimensions.
+    """
+
+    __slots__ = (
+        "component", "name", "kind", "help", "buckets", "_cells", "_default"
+    )
+
+    def __init__(self, component, name, kind, help="", buckets=None):
+        self.component = component
+        self.name = name
+        self.kind = kind
+        self.help = help
+        self.buckets = tuple(buckets) if buckets else None
+        self._cells: Dict[str, Any] = {}
+        self._default = None
+
+    def _new_cell(self):
+        if self.kind == HISTOGRAM:
+            return _HistCell(self.buckets or LATENCY_BUCKETS_S)
+        return _Cell()
+
+    def cell(self, **labels):
+        key = _labels_key(labels)
+        c = self._cells.get(key)
+        if c is None:
+            c = self._cells[key] = self._new_cell()
+        return c
+
+    @property
+    def default(self):
+        c = self._default
+        if c is None:
+            c = self._default = self.cell()
+        return c
+
+    # Convenience passthroughs for unlabeled sites.
+    def inc(self, n: float = 1.0) -> None:
+        self.default.inc(n)
+
+    def set(self, v: float) -> None:
+        self.default.set(v)
+
+    def observe(self, v: float) -> None:
+        self.default.observe(v)
+
+
+_registry_lock = threading.Lock()
+_registry: Dict[Tuple[str, str], Family] = {}
+
+
+def _family(component: str, name: str, kind: str, help: str, buckets=None) -> Family:
+    key = (component, name)
+    with _registry_lock:
+        fam = _registry.get(key)
+        if fam is None:
+            fam = _registry[key] = Family(component, name, kind, help, buckets)
+        return fam
+
+
+def counter(component: str, name: str, help: str = "") -> Family:
+    """Monotonic counter, flushed as additive deltas. Rendered with a
+    Prometheus ``_total`` suffix."""
+    return _family(component, name, COUNTER, help)
+
+
+def gauge(component: str, name: str, help: str = "") -> Family:
+    """Point-in-time value; last writer wins, never reset. Stale gauges
+    (source stopped flushing) age out of the export."""
+    return _family(component, name, GAUGE, help)
+
+
+def histogram(
+    component: str, name: str, help: str = "", buckets: Sequence[float] = ()
+) -> Family:
+    """Fixed-bucket histogram, flushed as additive bucket-count deltas."""
+    return _family(component, name, HISTOGRAM, help, buckets or None)
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+# ---------------------------------------------------------------------------
+
+
+class FlightRecorder:
+    """Fixed-size ring of structured lifecycle events.
+
+    Each entry is ``(wall_ts, component, event, fields)``; wall-clock
+    timestamps let rings from different processes merge into one ordered
+    timeline (the loop-time clocks are per-process).
+    """
+
+    def __init__(self, capacity: Optional[int] = None):
+        self._ring: deque = deque(
+            maxlen=capacity or config.telemetry_flight_capacity
+        )
+
+    def record(self, component: str, event: str, **fields) -> None:
+        self._ring.append((time.time(), component, event, fields))
+
+    def snapshot(self) -> List[tuple]:
+        return list(self._ring)
+
+    def drain(self) -> List[tuple]:
+        evs = list(self._ring)
+        self._ring.clear()
+        return evs
+
+    def clear(self) -> None:
+        self._ring.clear()
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+
+_flight: Optional[FlightRecorder] = None
+
+
+def flight() -> FlightRecorder:
+    global _flight
+    if _flight is None:
+        _flight = FlightRecorder()
+    return _flight
+
+
+def record_event(component: str, event: str, **fields) -> None:
+    """Append one lifecycle event to this process's ring (hot-path safe:
+    a deque append)."""
+    fl = _flight
+    if fl is None:
+        fl = flight()
+    fl._ring.append((time.time(), component, event, fields))
+
+
+def events_to_wire(events: List[tuple]) -> List[list]:
+    return [[ts, comp, ev, fields] for ts, comp, ev, fields in events]
+
+
+# ---------------------------------------------------------------------------
+# Snapshot-and-reset flush (per-process side)
+# ---------------------------------------------------------------------------
+
+
+def _collect(reset: bool) -> List[dict]:
+    """Serialize every family with non-empty state; optionally drain
+    counters/histograms (gauges always report-and-keep). No awaits — the
+    caller relies on read-and-reset being one atomic loop step."""
+    with _registry_lock:
+        fams = list(_registry.values())
+    out: List[dict] = []
+    for fam in fams:
+        series: List[list] = []
+        for key, cell in fam._cells.items():
+            if fam.kind == HISTOGRAM:
+                if cell.total == 0:
+                    continue
+                series.append(
+                    [key, {"counts": list(cell.counts), "sum": cell.sum,
+                           "total": cell.total}]
+                )
+                if reset:
+                    cell.counts = [0] * len(cell.counts)
+                    cell.sum = 0.0
+                    cell.total = 0
+            else:
+                if fam.kind == COUNTER and cell.v == 0:
+                    continue
+                series.append([key, cell.v])
+                if reset and fam.kind == COUNTER:
+                    cell.v = 0.0
+        if not series:
+            continue
+        out.append(
+            {
+                "c": fam.component,
+                "n": fam.name,
+                "k": fam.kind,
+                "h": fam.help,
+                "b": list(fam.buckets) if fam.buckets else None,
+                "s": series,
+            }
+        )
+    return out
+
+
+def flush_delta(
+    source: str, node: str, drain_events: bool = True
+) -> Optional[dict]:
+    """Snapshot-and-reset this process's telemetry as a ReportTelemetry
+    payload; None when there is nothing to report."""
+    metrics = _collect(reset=True)
+    events = events_to_wire(flight().drain()) if drain_events else []
+    if not metrics and not events:
+        return None
+    payload = {"source": source, "node": node, "metrics": metrics}
+    if events:
+        payload["events"] = events
+    return payload
+
+
+def restore_delta(payload: dict) -> None:
+    """Fold an undelivered flush back into the local cells so the next
+    flush carries it (same at-least-once compromise as
+    worker_main._restore_deadline_delta; ReportTelemetry is RETRY_NONE)."""
+    for m in payload.get("metrics", []):
+        fam = _family(m["c"], m["n"], m["k"], m.get("h", ""), m.get("b"))
+        for key, val in m["s"]:
+            labels = dict(json.loads(key))
+            cell = fam.cell(**labels)
+            if fam.kind == HISTOGRAM:
+                cell.counts = [a + b for a, b in zip(cell.counts, val["counts"])]
+                cell.sum += val["sum"]
+                cell.total += val["total"]
+            elif fam.kind == COUNTER:
+                cell.v += val
+            # gauges were not reset; nothing to restore
+    ring = flight()._ring
+    for ts, comp, ev, fields in reversed(payload.get("events", [])):
+        ring.appendleft((ts, comp, ev, fields))
+
+
+def peek(source: str = "local", node: str = "local") -> dict:
+    """Non-destructive snapshot in the same wire shape (loadgen --json)."""
+    return {
+        "source": source,
+        "node": node,
+        "metrics": _collect(reset=False),
+        "events_pending": len(flight()),
+    }
+
+
+def reset_all() -> None:
+    """Zero every cell and clear the flight ring (chaos per-seed reset,
+    tests). Families stay registered — bound cells keep working."""
+    with _registry_lock:
+        fams = list(_registry.values())
+    for fam in fams:
+        for cell in fam._cells.values():
+            if fam.kind == HISTOGRAM:
+                cell.counts = [0] * len(cell.counts)
+                cell.sum = 0.0
+                cell.total = 0
+            else:
+                cell.v = 0.0
+    flight().clear()
+
+
+# ---------------------------------------------------------------------------
+# Periodic flusher (one per process, whoever has a GCS channel first)
+# ---------------------------------------------------------------------------
+
+_flusher_started = False
+
+
+def flusher_active() -> bool:
+    return _flusher_started
+
+
+async def flush_once(call: Callable, source: str, node: str) -> None:
+    payload = flush_delta(source, node)
+    if payload is None:
+        return
+    try:
+        await call("ReportTelemetry", payload)
+    except Exception:
+        restore_delta(payload)
+
+
+def start_flusher(call: Callable, source: str, node: str) -> bool:
+    """Start this process's periodic telemetry flush loop. Idempotent:
+    the first caller (driver CoreWorker, worker CoreWorker, or a raylet
+    running in its own process) wins; extra calls are no-ops so an
+    in-process cluster doesn't flush the shared registry N times.
+    ``call`` is an async (method, payload) -> reply over a GCS channel.
+    Returns True when this call started the loop."""
+    global _flusher_started
+    interval = config.telemetry_flush_interval_s
+    if _flusher_started or not config.telemetry_enabled or interval <= 0:
+        return False
+    _flusher_started = True
+
+    async def _loop():
+        import asyncio
+
+        while True:
+            await asyncio.sleep(interval)
+            await flush_once(call, source, node)
+
+    from ray_tpu._private import rpc  # lazy: rpc imports telemetry
+
+    rpc.spawn(_loop())
+    return True
+
+
+def reset_flusher_for_test() -> None:
+    global _flusher_started
+    _flusher_started = False
+
+
+# ---------------------------------------------------------------------------
+# GCS-side aggregate, keyed by (component, node, name)
+# ---------------------------------------------------------------------------
+
+
+def new_aggregate() -> dict:
+    """The GCS's cluster-wide runtime-metric state. Wire-friendly from
+    the start: GetTelemetry returns it verbatim. Series keys are
+    ``"<node>|<labels_json>"``."""
+    return {"meta": {}, "counters": {}, "hists": {}, "gauges": {}}
+
+
+def ingest(agg: dict, payload: dict, now: Optional[float] = None) -> None:
+    """Fold one ReportTelemetry payload (additive deltas) into the
+    aggregate. Counter/histogram deltas accumulate; gauges overwrite with
+    a receive timestamp so the renderer can age out dead sources."""
+    now = time.time() if now is None else now
+    node = payload.get("node", "?")
+    for m in payload.get("metrics", []):
+        mkey = f"{m['c']}.{m['n']}"
+        meta = agg["meta"].get(mkey)
+        if meta is None:
+            agg["meta"][mkey] = {
+                "kind": m["k"], "help": m.get("h", ""), "buckets": m.get("b")
+            }
+        for lkey, val in m["s"]:
+            skey = f"{node}|{lkey}"
+            if m["k"] == HISTOGRAM:
+                tbl = agg["hists"].setdefault(mkey, {})
+                cur = tbl.get(skey)
+                if cur is None:
+                    tbl[skey] = {
+                        "counts": list(val["counts"]),
+                        "sum": val["sum"],
+                        "total": val["total"],
+                    }
+                else:
+                    cur["counts"] = [
+                        a + b for a, b in zip(cur["counts"], val["counts"])
+                    ]
+                    cur["sum"] += val["sum"]
+                    cur["total"] += val["total"]
+            elif m["k"] == GAUGE:
+                agg["gauges"].setdefault(mkey, {})[skey] = [float(val), now]
+            else:
+                tbl = agg["counters"].setdefault(mkey, {})
+                tbl[skey] = tbl.get(skey, 0.0) + float(val)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus rendering (dashboard side)
+# ---------------------------------------------------------------------------
+
+
+def _prom_name(mkey: str, kind: str) -> str:
+    name = "ray_tpu_" + mkey.replace(".", "_").replace("-", "_")
+    if kind == COUNTER and not name.endswith("_total"):
+        name += "_total"
+    return name
+
+
+def _label_str(skey: str, extra: str = "") -> str:
+    node, _, lkey = skey.partition("|")
+    labels = dict(json.loads(lkey)) if lkey else {}
+    labels["node"] = node
+    parts = [f'{k}="{v}"' for k, v in sorted(labels.items())]
+    if extra:
+        parts.append(extra)
+    return ",".join(parts)
+
+
+def render_runtime_prometheus(
+    agg: dict,
+    worker_deadline_stats: Optional[dict] = None,
+    now: Optional[float] = None,
+    stale_after_s: Optional[float] = None,
+) -> str:
+    """Render the GCS aggregate as Prometheus text.
+
+    ``worker_deadline_stats`` (the GCS's ReportDeadlineStats aggregate)
+    is emitted as the same ``ray_tpu_rpc_deadline_*_total`` families under
+    ``node="_worker_aggregate"`` — it overlaps the per-node telemetry
+    series by construction (both count worker-side enforcement), so sum
+    one or the other, not both. Gauges whose source stopped flushing more
+    than ``stale_after_s`` ago are dropped instead of served forever.
+    """
+    now = time.time() if now is None else now
+    if stale_after_s is None:
+        stale_after_s = config.metrics_stale_after_s
+    lines: List[str] = []
+    extra_counters: Dict[str, Dict[str, float]] = {}
+    if worker_deadline_stats:
+        wds = worker_deadline_stats
+        for short, v in (
+            ("met", wds.get("met", 0)),
+            ("shed", wds.get("shed", 0)),
+            ("enforced", wds.get("enforced", 0)),
+            ("overruns", len(wds.get("overruns", ()))),
+        ):
+            extra_counters[f"rpc.deadline_{short}"] = {
+                "_worker_aggregate|": float(v)
+            }
+
+    mkeys = set(agg["meta"]) | set(extra_counters)
+    for mkey in sorted(mkeys):
+        meta = agg["meta"].get(
+            mkey, {"kind": COUNTER, "help": "", "buckets": None}
+        )
+        kind = meta["kind"]
+        pname = _prom_name(mkey, kind)
+        if meta.get("help"):
+            lines.append(f"# HELP {pname} {meta['help']}")
+        lines.append(f"# TYPE {pname} {kind}")
+        if kind == HISTOGRAM:
+            bounds = meta.get("buckets") or list(LATENCY_BUCKETS_S)
+            for skey, h in sorted(agg["hists"].get(mkey, {}).items()):
+                base = _label_str(skey)
+                cum = 0
+                for bound, c in zip(bounds, h["counts"]):
+                    cum += c
+                    lb = base + ("," if base else "") + f'le="{bound}"'
+                    lines.append(f"{pname}_bucket{{{lb}}} {cum}")
+                cum += h["counts"][-1]
+                lb = base + ("," if base else "") + 'le="+Inf"'
+                lines.append(f"{pname}_bucket{{{lb}}} {cum}")
+                braces = f"{{{base}}}" if base else ""
+                lines.append(f"{pname}_sum{braces} {h['sum']}")
+                lines.append(f"{pname}_count{braces} {h['total']}")
+        elif kind == GAUGE:
+            for skey, (v, ts) in sorted(agg["gauges"].get(mkey, {}).items()):
+                if now - ts > stale_after_s:
+                    continue
+                base = _label_str(skey)
+                braces = f"{{{base}}}" if base else ""
+                lines.append(f"{pname}{braces} {v}")
+        else:
+            series = dict(agg["counters"].get(mkey, {}))
+            for skey, v in extra_counters.get(mkey, {}).items():
+                series[skey] = series.get(skey, 0.0) + v
+            for skey, v in sorted(series.items()):
+                base = _label_str(skey)
+                braces = f"{{{base}}}" if base else ""
+                lines.append(f"{pname}{braces} {v}")
+    if not lines:
+        return ""
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Flight-recorder timeline dump (chaos triage)
+# ---------------------------------------------------------------------------
+
+
+def merged_timeline(*event_lists: List[tuple]) -> List[dict]:
+    """Merge per-process event lists into one time-ordered timeline of
+    JSON-able dicts."""
+    merged: List[tuple] = []
+    for evs in event_lists:
+        merged.extend(tuple(e) for e in evs)
+    merged.sort(key=lambda e: e[0])
+    return [
+        {"ts": ts, "component": comp, "event": ev, **dict(fields)}
+        for ts, comp, ev, fields in merged
+    ]
+
+
+def dump_timeline(path: str, *event_lists: List[tuple]) -> int:
+    """Write a merged, time-ordered JSONL timeline; returns event count."""
+    timeline = merged_timeline(*event_lists)
+    with open(path, "w") as f:
+        for entry in timeline:
+            f.write(json.dumps(entry) + "\n")
+    return len(timeline)

@@ -189,6 +189,15 @@ SCHEMAS: Dict[str, WireSchema] = {
     # region as a kind-4 blob which the server reads back as "data".
     "CPut": _s([], ["payload", "data"], blob="request"),
     # -- logs / observability ------------------------------------------------
+    # Runtime-telemetry flush (telemetry.py flush_delta): counter/histogram
+    # deltas plus drained flight-recorder events. Additive like
+    # ReportDeadlineStats, so the same RETRY_NONE reasoning applies — an
+    # undelivered payload is folded back locally and rides the next flush.
+    "ReportTelemetry": _s(
+        ["source", "node", "metrics"], ["events"], retry=RETRY_NONE
+    ),
+    # Read of the GCS telemetry aggregate (dashboard /metrics).
+    "GetTelemetry": _s([], retry=RETRY_SAFE),
     "GetLog": _s(
         [], ["filename", "worker_id", "stream", "tail"], retry=RETRY_SAFE
     ),

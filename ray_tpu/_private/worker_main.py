@@ -24,7 +24,7 @@ from typing import Any, Dict, Optional
 
 import cloudpickle
 
-from ray_tpu._private import rpc, serialization
+from ray_tpu._private import rpc, serialization, telemetry
 from ray_tpu._private.common import TaskError, TaskSpec, config
 from ray_tpu._private.core_worker import CoreWorker, ObjectRef
 from ray_tpu.util import tracing
@@ -957,6 +957,17 @@ class Executor:
                 )
             except Exception:
                 pass
+        # Same for the runtime-telemetry registry: counters recorded since
+        # the last periodic flush (and any undrained flight events) ride one
+        # bounded final report instead of dying with the process.
+        tel = telemetry.flush_delta(self.core.worker_id, self.core.node_id)
+        if tel is not None:
+            try:
+                await asyncio.wait_for(
+                    self.core.gcs.call("ReportTelemetry", tel), timeout=1.0
+                )
+            except Exception:
+                telemetry.restore_delta(tel)
         asyncio.get_running_loop().call_later(0.05, os._exit, 0)
         return {"ok": True}
 

@@ -407,7 +407,7 @@ class _Session:
 
 def run_seed(session: _Session, scenario: Scenario, seed: int,
              verbose: bool = False) -> SeedResult:
-    from ray_tpu._private import rpc
+    from ray_tpu._private import rpc, telemetry
     from ray_tpu.chaos import interceptors, invariants
     from ray_tpu.chaos.nemesis import Nemesis
 
@@ -430,6 +430,12 @@ def run_seed(session: _Session, scenario: Scenario, seed: int,
         if gcs is not None:
             gcs.worker_deadline_stats.update(met=0, shed=0, enforced=0)
             gcs.worker_deadline_stats["overruns"].clear()
+        # Per-seed telemetry: zero the registry and both flight-recorder
+        # rings so a violation's dump holds THIS seed's timeline only.
+        telemetry.reset_all()
+        if gcs is not None:
+            gcs.telemetry = telemetry.new_aggregate()
+            gcs.flight_events.clear()
         return interceptors.install(schedule)
 
     async def _uninstall():
@@ -675,6 +681,7 @@ def run_scenario(scenario: Scenario, seeds: List[int], corpus: Optional[str],
                     print(f"      {v}")
                 if corpus:
                     _append_corpus(corpus, result)
+                    _dump_flight(corpus, session, result)
                 # One bad seed must not poison the next: fresh cluster.
                 session.close()
                 session = _Session(scenario)
@@ -686,6 +693,30 @@ def run_scenario(scenario: Scenario, seeds: List[int], corpus: Optional[str],
 def _append_corpus(path: str, result: SeedResult) -> None:
     with open(path, "a") as f:
         f.write(json.dumps(result.to_wire(), sort_keys=True) + "\n")
+
+
+def _dump_flight(corpus: str, session: _Session, result: SeedResult) -> Optional[str]:
+    """Write the merged flight-recorder timeline for a failing seed next to
+    the replay corpus: the GCS's ingested ring (events drained from worker
+    and driver flushes) merged with this process's undrained local ring,
+    sorted by wall-clock timestamp."""
+    from ray_tpu._private import telemetry
+
+    gcs = session.cluster.gcs_server
+    ingested = list(gcs.flight_events) if gcs is not None else []
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(corpus)),
+        f"flight_{result.scenario}_{result.seed}.jsonl",
+    )
+    try:
+        n = telemetry.dump_timeline(
+            path, ingested, telemetry.flight().snapshot()
+        )
+    except Exception as e:  # triage artifact must never mask the violation
+        print(f"      flight dump failed: {type(e).__name__}: {e}")
+        return None
+    print(f"      flight recorder: {n} events -> {path}")
+    return path
 
 
 def _load_corpus(path: str) -> List[dict]:

@@ -411,26 +411,49 @@ class Dashboard:
         return web.json_response(out)
 
     async def _metrics(self, request):
-        """Prometheus text exposition merged across all workers (the
-        reference MetricsAgent role)."""
+        """Prometheus text exposition: application metrics merged across all
+        workers (the reference MetricsAgent role) followed by the runtime
+        telemetry aggregate pulled from the GCS (GetTelemetry)."""
+        import time as _time
+
         from aiohttp import web
 
+        from ray_tpu._private import telemetry
+        from ray_tpu._private.common import config
         from ray_tpu.util.metrics import METRICS_NS, render_prometheus
 
         keys = (await self._gcs("KVKeys", {"ns": METRICS_NS, "prefix": ""})).get(
             "keys", []
         )
+        now = _time.time()
+        stale_after = config.metrics_stale_after_s
         per_worker = {}
         for key in keys:
             blob = (await self._gcs("KVGet", {"ns": METRICS_NS, "key": key})).get(
                 "value"
             )
-            if blob:
-                per_worker[key] = json.loads(blob)
-        return web.Response(
-            text=render_prometheus(per_worker),
-            content_type="text/plain",
-        )
+            if not blob:
+                continue
+            snap = json.loads(blob)
+            # Age out snapshots from workers that stopped flushing (dead
+            # worker must not serve its last values forever). Unstamped
+            # snapshots predate the _ts field and are kept.
+            ts = snap.get("_ts")
+            if ts is not None and now - ts > stale_after:
+                await self._gcs("KVDel", {"ns": METRICS_NS, "key": key})
+                continue
+            per_worker[key] = snap
+        text = render_prometheus(per_worker)
+        try:
+            reply = await self._gcs("GetTelemetry", {})
+        except Exception:
+            reply = None
+        if reply:
+            text += telemetry.render_runtime_prometheus(
+                reply["telemetry"],
+                worker_deadline_stats=reply.get("worker_deadline_stats"),
+            )
+        return web.Response(text=text, content_type="text/plain")
 
     async def _task_summary(self, request):
         from aiohttp import web

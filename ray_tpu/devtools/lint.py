@@ -2,9 +2,10 @@
 
 Runs every static pass over the package and exits non-zero on any finding:
 the asyncio hazard linter (aio_lint), the RPC wire cross-checker
-(rpc_check), the paired-resource lifecycle pass (lifecycle), and the
-protocol FSM checker (protocols). This is the CI lint job's entry point;
-``make lint`` wraps it.
+(rpc_check), the paired-resource lifecycle pass (lifecycle), the protocol
+FSM checker (protocols), and the telemetry-registry pass (telemetry_lint,
+no ad-hoc stats dicts in runtime code). This is the CI lint job's entry
+point; ``make lint`` wraps it.
 """
 
 from __future__ import annotations
@@ -13,9 +14,15 @@ import argparse
 import sys
 from typing import List, Optional
 
-from ray_tpu.devtools import aio_lint, lifecycle, protocols, rpc_check
+from ray_tpu.devtools import (
+    aio_lint,
+    lifecycle,
+    protocols,
+    rpc_check,
+    telemetry_lint,
+)
 
-_PASSES = "aio-lint + rpc-check + lifecycle + protocols"
+_PASSES = "aio-lint + rpc-check + lifecycle + protocols + telemetry-lint"
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -31,6 +38,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     findings.extend(rpc_check.check(paths))
     findings.extend(lifecycle.lint_paths(paths))
     findings.extend(protocols.check(paths))
+    findings.extend(telemetry_lint.lint_paths(paths))
     findings.sort(key=lambda f: (f.path, f.line, f.col))
     for f in findings:
         print(f)

@@ -26,7 +26,7 @@ import asyncio
 import json
 from typing import Any, Dict, List, Optional
 
-from ray_tpu._private import rpc
+from ray_tpu._private import rpc, telemetry
 from ray_tpu._private.common import config
 from ray_tpu.serve._private.common import DeploymentOverloadedError
 
@@ -291,6 +291,9 @@ def run_smoke(
         closed, opened, router_stats = w.run_async(
             _phases(), timeout=closed_duration_s + open_duration_s + 60
         )
+        # Runtime-telemetry snapshot (non-destructive) while the cluster is
+        # still up: the serve/rpc/object counters the run just exercised.
+        tel_snapshot = telemetry.peek("loadgen", "loadgen")
     finally:
         try:
             serve.shutdown()
@@ -300,6 +303,7 @@ def run_smoke(
 
     out = to_gate_json(closed, opened)
     out["router"] = router_stats
+    out["telemetry"] = tel_snapshot
     if json_path:
         with open(json_path, "w") as f:
             json.dump(out, f, indent=2)

@@ -20,6 +20,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
 
+from ray_tpu._private import telemetry
 from ray_tpu._private import worker as worker_mod
 from ray_tpu._private.common import config as _config
 from ray_tpu._private.gcs import DEAD as ACTOR_DEAD
@@ -149,6 +150,12 @@ def autoscale_tick(state: _DeploymentState, ac: AutoscalingConfig, now: float):
         state.above_since = None
         state.below_since = None
     return None
+
+
+_TEL_AUTOSCALE = telemetry.counter(
+    "serve", "autoscale_decisions",
+    "autoscaler target changes that survived hysteresis",
+)
 
 
 class ServeController:
@@ -703,6 +710,13 @@ class ServeController:
                 state.target_replicas,
                 new_target,
                 state.queue_ewma,
+            )
+            direction = "up" if new_target > state.target_replicas else "down"
+            _TEL_AUTOSCALE.cell(direction=direction).inc()
+            telemetry.record_event(
+                "serve", "autoscale", deployment=str(state.dep_id),
+                direction=direction, old=state.target_replicas,
+                new=new_target,
             )
             state.current_target = new_target
 

@@ -23,6 +23,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
 
+from ray_tpu._private import telemetry
 from ray_tpu._private.rpc import spawn as _spawn
 
 logger = logging.getLogger(__name__)
@@ -35,6 +36,13 @@ class _BatchItem:
         self.value = value
         self.future = future
         self.enqueued_at = enqueued_at
+
+
+_TEL_BATCH_SIZE = telemetry.histogram(
+    "serve", "batch_size",
+    "dynamic-batch sizes launched by replica batch queues",
+    buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0),
+).default
 
 
 class _BatchStats:
@@ -61,6 +69,7 @@ class _BatchStats:
         self.size_max = max(self.size_max, size)
         self.queue_age_sum_s += oldest_age_s
         self.queue_age_max_s = max(self.queue_age_max_s, oldest_age_s)
+        _TEL_BATCH_SIZE.observe(size)
 
     def to_dict(self) -> Dict[str, Any]:
         return {

@@ -33,7 +33,7 @@ import random
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
-from ray_tpu._private import rpc
+from ray_tpu._private import rpc, telemetry
 from ray_tpu._private.common import (
     ActorDiedError,
     ActorUnavailableError,
@@ -48,9 +48,28 @@ from ray_tpu.serve._private.long_poll import LongPollClient
 
 logger = logging.getLogger(__name__)
 
+_TEL_SHED_QUEUE = telemetry.counter(
+    "serve", "shed_queue_full", "requests shed at the door: queue cap reached"
+)
+_TEL_SHED_DEADLINE = telemetry.counter(
+    "serve", "shed_deadline",
+    "requests shed at admission: budget below service estimate",
+)
+_TEL_COMPLETED = telemetry.counter(
+    "serve", "requests_completed", "requests completed through the router"
+)
+_TEL_EVICTED = telemetry.counter(
+    "serve", "replicas_evicted", "replicas locally evicted as observed-dead"
+)
+_TEL_SERVICE_TIME = telemetry.histogram(
+    "serve", "service_time_s",
+    "end-to-end request service time observed by the router",
+    buckets=telemetry.LATENCY_BUCKETS_S,
+)
+
 
 class _ReplicaSet:
-    def __init__(self):
+    def __init__(self, dep: str = "?"):
         self.replicas: List[RunningReplicaInfo] = []
         self.handles: Dict[str, ActorHandle] = {}
         self.ongoing: Dict[str, int] = {}
@@ -70,6 +89,14 @@ class _ReplicaSet:
         self.deadline_failures = 0
         self.completed = 0
         self.evicted = 0
+        self.dep = dep
+        # Telemetry twins of the counters above, labeled by deployment
+        # (the plain ints stay: loadgen/chaos read them via stats()).
+        self._tel_shed_queue = _TEL_SHED_QUEUE.cell(deployment=dep)
+        self._tel_shed_deadline = _TEL_SHED_DEADLINE.cell(deployment=dep)
+        self._tel_completed = _TEL_COMPLETED.cell(deployment=dep)
+        self._tel_evicted = _TEL_EVICTED.cell(deployment=dep)
+        self._tel_service_time = _TEL_SERVICE_TIME.cell(deployment=dep)
 
     def update(self, infos: List[RunningReplicaInfo]) -> None:
         self.replicas = infos
@@ -103,6 +130,11 @@ class _ReplicaSet:
         if len(self.replicas) == before:
             return
         self.evicted += 1
+        self._tel_evicted.inc()
+        telemetry.record_event(
+            "serve", "replica_evict", deployment=self.dep,
+            replica=replica_id_str,
+        )
         self.handles.pop(replica_id_str, None)
         self.ongoing.pop(replica_id_str, None)
         for mid, rid in list(self.model_affinity.items()):
@@ -121,6 +153,8 @@ class _ReplicaSet:
 
     def observe_service_time(self, seconds: float) -> None:
         self.completed += 1
+        self._tel_completed.inc()
+        self._tel_service_time.observe(seconds)
         if self.ewma_service_s is None:
             self.ewma_service_s = seconds
         else:
@@ -147,7 +181,7 @@ class Router:
     def _replica_set(self, deployment_id_str: str) -> _ReplicaSet:
         rs = self._sets.get(deployment_id_str)
         if rs is None:
-            rs = _ReplicaSet()
+            rs = _ReplicaSet(deployment_id_str)
             self._sets[deployment_id_str] = rs
         return rs
 
@@ -266,6 +300,11 @@ class Router:
         need = rs.ewma_service_s * config.serve_admission_safety_factor
         if remaining < need:
             rs.shed_deadline += 1
+            rs._tel_shed_deadline.inc()
+            telemetry.record_event(
+                "serve", "admission_shed", deployment=dep,
+                reason="deadline_unreachable",
+            )
             raise DeploymentOverloadedError(
                 dep,
                 "deadline_unreachable",
@@ -323,6 +362,11 @@ class Router:
         cap = rs.queue_cap()
         if rs.queued >= cap:
             rs.shed_queue_full += 1
+            rs._tel_shed_queue.inc()
+            telemetry.record_event(
+                "serve", "admission_shed", deployment=deployment_id_str,
+                reason="queue_full",
+            )
             raise DeploymentOverloadedError(
                 deployment_id_str,
                 "queue_full",

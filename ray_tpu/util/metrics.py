@@ -156,6 +156,9 @@ def _flush_once() -> None:
     payload = _collect_local()
     if not payload:
         return
+    # Stamped so the dashboard can age out snapshots from dead workers
+    # (a worker that stops flushing must not serve its last values forever).
+    payload["_ts"] = time.time()
     key = f"{core.worker_id}"
 
     async def _push():
@@ -190,6 +193,8 @@ def render_prometheus(per_worker: Dict[str, dict]) -> str:
     merged: Dict[str, dict] = {}
     for snapshot in per_worker.values():
         for name, entry in snapshot.items():
+            if name.startswith("_"):  # bookkeeping keys ("_ts"), not metrics
+                continue
             dst = merged.setdefault(
                 name,
                 {

@@ -4,7 +4,7 @@
 PYTHON ?= python
 SANITIZER ?= address
 
-.PHONY: lint test sanitize wire-docs protocols build chaos loadgen
+.PHONY: lint test sanitize wire-docs protocols build chaos loadgen perf
 
 lint:
 	$(PYTHON) -m ray_tpu.devtools.lint
@@ -51,6 +51,17 @@ protocols:
 # admitted request overruns its deadline.
 loadgen:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m ray_tpu.loadgen --smoke
+
+# Perf floors (CI perf-smoke job runs the same commands): the ray_perf
+# microbenchmark suite — tasks/actors/put/get plus the streaming-ingest
+# leg (ingest_rows_per_s) — and the serve loadgen smoke, gated together
+# against benchmarks/perf_floors.json.
+perf:
+	timeout -k 10 900 env JAX_PLATFORMS=cpu \
+		$(PYTHON) -m ray_tpu._private.ray_perf --json /tmp/perf.json
+	timeout -k 10 300 env JAX_PLATFORMS=cpu \
+		$(PYTHON) -m ray_tpu.loadgen --smoke --json /tmp/serve_load.json
+	$(PYTHON) benchmarks/perf_gate.py /tmp/perf.json /tmp/serve_load.json
 
 SEEDS ?= 20
 LATENCY_SEEDS ?= 10

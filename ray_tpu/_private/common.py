@@ -251,6 +251,15 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     # older than this is treated as a dead process's leftovers: gauges are
     # dropped from /metrics and stale KV snapshots are GC'd.
     "metrics_stale_after_s": 30.0,
+    # ---- Data-layer ingest pipeline (docs/perf.md "Ingest pipeline"). ----
+    # How many block fetches iter_blocks/DataIterator keep in flight so
+    # object-store pull overlaps batch assembly instead of serializing
+    # against it. 1 reverts to serial get-per-block.
+    "data_fetch_lookahead": 4,
+    # streaming_split consumers iterate blocks in completion order (one
+    # straggler read task delays only itself). Dataset-level iteration
+    # (iter_batches/take/...) always preserves order regardless.
+    "data_split_preserve_order": False,
 }
 
 

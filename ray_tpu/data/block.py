@@ -5,6 +5,7 @@ the per-block kernels (slice, sort, hash-partition) that map tasks run."""
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
 import numpy as np
@@ -19,6 +20,23 @@ from ray_tpu.data.tensor_extension import (
 # A batch/table column name used when the data is just values, not mappings
 # (reference: ray.data uses __value__ the same way via TENSOR_COLUMN_NAME).
 VALUE_COL = "__value__"
+
+
+@dataclass(frozen=True)
+class BlockMeta:
+    """Per-block metadata that travels NEXT TO the block ref, not inside it
+    (reference: BlockMetadata in block.py riding RefBundles through the
+    streaming executor). Stage tasks return ``(block, meta)`` via
+    ``num_returns=2`` so dispatch decisions (limit cutoffs, zip alignment,
+    repartition ranges, row counts) read a tiny inline object instead of
+    paying a counter-task round trip per block."""
+
+    num_rows: int
+    size_bytes: int
+
+
+def meta_for(block: pa.Table) -> BlockMeta:
+    return BlockMeta(num_rows=block.num_rows, size_bytes=block.nbytes)
 
 
 def rows_to_block(rows: Sequence[Any]) -> pa.Table:

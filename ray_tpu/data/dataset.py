@@ -151,12 +151,20 @@ class Dataset:
     def _executor(self) -> StreamingExecutor:
         return StreamingExecutor(self._parallelism)
 
-    def iter_block_refs(self) -> Iterator[Any]:
+    def iter_bundles(self) -> Iterator[Any]:
+        """Stream RefBundles (block ref + metadata) for the applied plan."""
         yield from self._executor().execute(self._ops)
 
+    def iter_block_refs(self) -> Iterator[Any]:
+        for b in self.iter_bundles():
+            yield b.block
+
     def iter_blocks(self) -> Iterator[pa.Table]:
-        for ref in self.iter_block_refs():
-            yield ray_tpu.get(ref)
+        from ray_tpu.data.iterator import iter_blocks_pipelined
+
+        # Lookahead keeps K object-store fetches in flight so pull overlaps
+        # whatever the consumer does with each block.
+        yield from iter_blocks_pipelined(self.iter_block_refs())
 
     def materialize(self) -> "Dataset":
         """Execute now; the result holds concrete blocks
@@ -182,13 +190,15 @@ class Dataset:
         return out
 
     def count(self) -> int:
-        """Row count without moving row data to the driver (counts computed
-        by remote tasks over the block refs)."""
-        from ray_tpu.data._execution import _num_rows, _remote
+        """Row count without moving row data to the driver: sums the
+        BlockMeta riding next to every block ref (one batched inline get —
+        zero counter tasks, zero block fetches)."""
+        from ray_tpu.data._execution import resolve_metas
 
-        counter = _remote(_num_rows, num_cpus=0.5)
-        refs = [counter.remote(r) for r in self.iter_block_refs()]
-        return sum(ray_tpu.get(refs)) if refs else 0
+        bundles = list(self.iter_bundles())
+        if not bundles:
+            return 0
+        return sum(m.num_rows for m in resolve_metas(bundles))
 
     def schema(self) -> Optional[pa.Schema]:
         for blk in self.iter_blocks():
@@ -247,10 +257,26 @@ class Dataset:
         ops = self._ops
         if equal:
             ops = ops + [Repartition(num_blocks=n * 4)]
+        if n == 1:
+            # Single consumer: no cross-consumer queueing to coordinate, so
+            # skip the actor entirely — the iterator drives the executor
+            # in-process (fast path; promoted to a coordinator only if the
+            # iterator is pickled to a trainer worker).
+            return [
+                DataIterator(
+                    None,
+                    0,
+                    _local_plan=cloudpickle.dumps(ops),
+                    _parallelism=self._parallelism,
+                )
+            ]
         cls = ray_tpu.remote(_SplitCoordinator)
-        coord = cls.options(max_concurrency=max(4, n + 1), num_cpus=0.5).remote(
-            cloudpickle.dumps(ops), n, self._parallelism
-        )
+        # 2 slots per split: the DataIterator keeps one next_refs RPC in
+        # flight ahead, and an abandoned consumer's stale call may still be
+        # blocked server-side when the split starts its next epoch.
+        coord = cls.options(
+            max_concurrency=max(4, 2 * n + 2), num_cpus=0.5
+        ).remote(cloudpickle.dumps(ops), n, self._parallelism)
         return [DataIterator(coord, i) for i in range(n)]
 
     # -- writes --------------------------------------------------------------

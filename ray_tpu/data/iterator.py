@@ -6,12 +6,36 @@ StreamSplitDataIterator — an actor serves blocks to N consumers).
 
 from __future__ import annotations
 
+import collections
+import time
 from typing import Any, Dict, Iterator, List, Optional
 
 import pyarrow as pa
 
 import ray_tpu
+from ray_tpu._private import telemetry
+from ray_tpu._private.common import config
 from ray_tpu.data import block as B
+
+# docs/observability.md: component "data".
+_BATCH_ASSEMBLY = telemetry.histogram(
+    "data", "batch_assembly_s", "slice+concat+format per emitted batch"
+)
+_PREFETCH_DEPTH = telemetry.gauge(
+    "data", "prefetch_queue_depth", "batches ready ahead of the consumer"
+)
+_BYTES_FETCHED = telemetry.counter(
+    "data", "bytes_fetched", "block bytes materialized on the consumer"
+)
+_SPLIT_QUEUE_DEPTH = telemetry.gauge(
+    "data", "split_queue_depth", "blocks buffered across split queues"
+)
+_SPLIT_DISPATCHED = telemetry.counter(
+    "data", "split_blocks_dispatched", "blocks routed to a split queue"
+)
+_SPLIT_STEALS = telemetry.counter(
+    "data", "split_steals", "tail blocks claimed from a lagging split"
+)
 
 
 def batches_from_blocks(
@@ -20,28 +44,102 @@ def batches_from_blocks(
     batch_format: str = "numpy",
     drop_last: bool = False,
 ) -> Iterator[Any]:
-    """Re-chunk a stream of blocks into fixed-size batches."""
+    """Re-chunk a stream of blocks into fixed-size batches.
+
+    Copy budget (docs/perf.md): an offset cursor walks the queued tables and
+    emits each batch from zero-copy ``pa.Table.slice`` views, concatenating
+    ONLY when a batch spans a block boundary. The remainder of a block is
+    never re-copied per batch (the old path paid concat + two slice copies
+    of the whole buffer for every emitted batch).
+    """
     if batch_size is None:
         for blk in blocks:
             if blk.num_rows:
                 yield B.block_to_batch(blk, batch_format)
         return
-    buf: List[pa.Table] = []
-    buffered = 0
+    hist = _BATCH_ASSEMBLY.cell()
+    buf: collections.deque = collections.deque()
+    off = 0  # rows of buf[0] already emitted
+    buffered = 0  # unemitted rows across buf
     for blk in blocks:
         if blk.num_rows == 0:
             continue
         buf.append(blk)
         buffered += blk.num_rows
         while buffered >= batch_size:
-            merged = B.concat_blocks(buf)
-            batch = B.slice_block(merged, 0, batch_size)
-            rest = B.slice_block(merged, batch_size, merged.num_rows)
-            buf = [rest] if rest.num_rows else []
-            buffered = rest.num_rows
-            yield B.block_to_batch(batch, batch_format)
+            t0 = time.perf_counter()
+            need = batch_size
+            parts: List[pa.Table] = []
+            while need:
+                head = buf[0]
+                take = min(head.num_rows - off, need)
+                parts.append(head.slice(off, take))
+                off += take
+                need -= take
+                if off == head.num_rows:
+                    buf.popleft()
+                    off = 0
+            buffered -= batch_size
+            batch = parts[0] if len(parts) == 1 else B.concat_blocks(parts)
+            out = B.block_to_batch(batch, batch_format)
+            hist.observe(time.perf_counter() - t0)
+            yield out
     if buffered and not drop_last:
-        yield B.block_to_batch(B.concat_blocks(buf), batch_format)
+        t0 = time.perf_counter()
+        parts = [buf[0].slice(off)] + list(buf)[1:]
+        batch = parts[0] if len(parts) == 1 else B.concat_blocks(parts)
+        out = B.block_to_batch(batch, batch_format)
+        hist.observe(time.perf_counter() - t0)
+        yield out
+
+
+def iter_blocks_pipelined(
+    refs: Iterator[Any], lookahead: Optional[int] = None
+) -> Iterator[pa.Table]:
+    """Fetch blocks with up to ``lookahead`` gets in flight, yielding in
+    input order — object-store pull overlaps batch assembly instead of
+    serializing against it (reference: prefetch_blocks in the iterator
+    path). ``ray_tpu.get`` is thread-safe (worker.run_async bridges onto
+    the owner's event loop), so a small thread pool is all this needs."""
+    if lookahead is None:
+        lookahead = config.data_fetch_lookahead
+    bytes_cell = _BYTES_FETCHED.cell()
+
+    def _fetch(ref):
+        blk = ray_tpu.get(ref)
+        bytes_cell.inc(blk.nbytes)
+        return blk
+
+    refs = iter(refs)
+    if lookahead <= 1:
+        try:
+            for ref in refs:
+                yield _fetch(ref)
+        finally:
+            close = getattr(refs, "close", None)
+            if close is not None:
+                close()
+        return
+    from concurrent.futures import ThreadPoolExecutor
+
+    pool = ThreadPoolExecutor(
+        max_workers=lookahead, thread_name_prefix="block-fetch"
+    )
+    pending: collections.deque = collections.deque()
+    try:
+        for ref in refs:
+            pending.append(pool.submit(_fetch, ref))
+            if len(pending) >= lookahead:
+                yield pending.popleft().result()
+        while pending:
+            yield pending.popleft().result()
+    finally:
+        for f in pending:
+            f.cancel()
+        pool.shutdown(wait=False)
+        close = getattr(refs, "close", None)
+        if close is not None:
+            close()
 
 
 def prefetch_iterator(it: Iterator[Any], n: int) -> Iterator[Any]:
@@ -58,6 +156,7 @@ def prefetch_iterator(it: Iterator[Any], n: int) -> Iterator[Any]:
     q: "queue.Queue" = queue.Queue(maxsize=n)
     _END = object()
     stop = threading.Event()
+    depth = _PREFETCH_DEPTH.cell()
 
     def _put(item) -> bool:
         # Bounded put that gives up when the consumer abandoned the
@@ -66,6 +165,7 @@ def prefetch_iterator(it: Iterator[Any], n: int) -> Iterator[Any]:
         while not stop.is_set():
             try:
                 q.put(item, timeout=0.2)
+                depth.set(q.qsize())
                 return True
             except queue.Full:
                 continue
@@ -95,6 +195,7 @@ def prefetch_iterator(it: Iterator[Any], n: int) -> Iterator[Any]:
     try:
         while True:
             item = q.get()
+            depth.set(q.qsize())
             if item is _END:
                 return
             if isinstance(item, BaseException):
@@ -126,7 +227,10 @@ class _SplitCoordinator:
     drain their queue on demand. The producer blocks when every queue is at
     its cap, so backpressure reaches the executor's submit window and the
     dataset never has to fit in the object store. First-batch latency is one
-    block, not one epoch.
+    block, not one epoch. The executor runs with
+    ``preserve_order=config.data_split_preserve_order`` (default False):
+    splits shard the stream anyway, so blocks dispatch in completion order
+    and a straggler read task delays only itself.
 
     Dispatch (the reference OutputSplitter's equal=False load balancing):
     each block goes to the least-loaded non-full queue, so a stalled or
@@ -147,9 +251,11 @@ class _SplitCoordinator:
 
     # Handed-out refs are pinned for this many subsequent next_refs calls of
     # the same split: the owner (this actor) must keep a ref alive until the
-    # borrower has fetched the payload, and the consumer fetches group k
-    # before requesting group k+1.
-    _PIN_GROUPS = 2
+    # borrower has fetched the payload. 3 (not 2) because the consumer
+    # requests group k+1 while group k's fetches are still in flight (the
+    # DataIterator RPC lookahead) — groups k, k+1, k+2 may all have
+    # unfinished fetches when k+2 is handed out.
+    _PIN_GROUPS = 3
     # Seconds after producer completion before an idle split may steal from
     # a split that never joined this epoch. Trade-off: shorter means a
     # sole sequential consumer finishes sooner; longer protects a
@@ -185,6 +291,9 @@ class _SplitCoordinator:
         self._handed: Dict[int, Any] = {
             i: collections.deque(maxlen=self._PIN_GROUPS) for i in range(n)
         }
+        self._depth_cell = _SPLIT_QUEUE_DEPTH.cell()
+        self._dispatched_cell = _SPLIT_DISPATCHED.cell()
+        self._steals_cell = _SPLIT_STEALS.cell()
 
     # -- producer ------------------------------------------------------------
 
@@ -207,8 +316,12 @@ class _SplitCoordinator:
             from ray_tpu.data._execution import StreamingExecutor
 
             try:
-                ex = StreamingExecutor(self.parallelism)
-                for ref in ex.execute(self.ops):
+                ex = StreamingExecutor(
+                    self.parallelism,
+                    preserve_order=config.data_split_preserve_order,
+                )
+                for bundle in ex.execute(self.ops):
+                    ref = bundle.block
                     with self._cond:
                         while (
                             self._epoch == epoch
@@ -231,6 +344,8 @@ class _SplitCoordinator:
                         self._rr = (dest + 1) % self.n
                         self._queues[dest].append(ref)
                         self._buffered += 1
+                        self._dispatched_cell.inc()
+                        self._depth_cell.set(self._buffered)
                         self._cond.notify_all()
             except BaseException as e:  # surfaced to every consumer
                 with self._cond:
@@ -276,6 +391,7 @@ class _SplitCoordinator:
                 q = self._queues[split_idx]
                 self._buffered -= len(q)
                 q.clear()
+                self._depth_cell.set(self._buffered)
                 self._finished.add(split_idx)
                 self._cond.notify_all()
             # Wants the NEXT epoch: wait until every joined split drained
@@ -303,18 +419,32 @@ class _SplitCoordinator:
             self._joined.add(split_idx)
             return self._epoch
 
-    def next_refs(self, split_idx: int, max_n: int = 4, timeout: float = 300.0):
+    def next_refs(
+        self,
+        split_idx: int,
+        max_n: int = 4,
+        timeout: float = 300.0,
+        epoch: Optional[int] = None,
+    ):
         """Claim up to max_n block refs for this split.
 
         Returns (refs, done): done=True means the epoch is exhausted and no
         further refs will arrive. Blocks until at least one ref is available
         or the epoch ends; raises the producer's error if execution failed.
+
+        ``epoch`` (from start_epoch) fences stale calls: the DataIterator
+        keeps one next_refs RPC in flight ahead, so a consumer that abandons
+        iteration can leave a blocked call behind — when the epoch advances,
+        that call must return empty instead of eating the new epoch's
+        blocks.
         """
         import time as _time
 
         deadline = _time.monotonic() + timeout
         with self._cond:
             while True:
+                if epoch is not None and self._epoch != epoch:
+                    return [], True  # stale pre-fetch from a finished pass
                 if self._producer_error is not None:
                     raise self._producer_error
                 src = self._queues[split_idx]
@@ -333,18 +463,20 @@ class _SplitCoordinator:
                     ]
                     if candidates:
                         src = max(candidates, key=len)
+                        self._steals_cell.inc()
                 if src:
                     refs = []
                     while src and len(refs) < max_n:
                         refs.append(src.popleft())
                     self._buffered -= len(refs)
+                    self._depth_cell.set(self._buffered)
                     done = self._producer_done and self._buffered == 0
                     if done:
                         self._finished.add(split_idx)
                     # Pin: the bounded deque drops groups handed out
                     # _PIN_GROUPS calls ago — by then the consumer has
-                    # fetched them (it requests group k+1 only after
-                    # consuming group k).
+                    # fetched them (with the RPC lookahead, group k's
+                    # fetches finish before group k+2 is requested).
                     self._handed[split_idx].append(refs)
                     self._cond.notify_all()  # wake the producer (queue space)
                     return refs, done
@@ -362,25 +494,72 @@ class _SplitCoordinator:
 
 class DataIterator:
     """Per-consumer view of a streaming split; picklable (ships the
-    coordinator actor handle)."""
+    coordinator actor handle).
 
-    def __init__(self, coordinator, split_idx: int):
+    Single-split fast path: ``streaming_split(1)`` constructs this with a
+    plan blob and NO coordinator — one consumer needs no cross-consumer
+    queueing, so iteration drives the StreamingExecutor in-process (each
+    pass is a fresh epoch, same semantics) and skips the actor spawn plus
+    a per-group RPC round trip. Pickling ships the plan blob itself: the
+    receiving process (a trainer worker is a full ray worker, exactly what
+    the coordinator actor would have been) drives its own local execution.
+    With one split there is one consumer, so "each consumer executes the
+    plan" and "one shared execution" coincide; pickling stays free of side
+    effects (no actor spawn mid-serialization, which may run on the event
+    loop thread)."""
+
+    def __init__(
+        self,
+        coordinator,
+        split_idx: int,
+        _local_plan: Optional[bytes] = None,
+        _parallelism: int = 8,
+    ):
         self._coord = coordinator
         self._idx = split_idx
+        self._local_plan = _local_plan
+        self._par = _parallelism
 
-    def _blocks(self) -> Iterator[pa.Table]:
-        ray_tpu.get(self._coord.start_epoch.remote(self._idx))
+    def _local_blocks(self) -> Iterator[pa.Table]:
+        import cloudpickle
+
+        from ray_tpu.data._execution import StreamingExecutor
+
+        ops = cloudpickle.loads(self._local_plan)
+        ex = StreamingExecutor(
+            self._par, preserve_order=config.data_split_preserve_order
+        )
+
+        def refs():
+            for bundle in ex.execute(ops):
+                yield bundle.block
+
+        yield from iter_blocks_pipelined(refs())
+
+    def _ref_stream(self, epoch: int) -> Iterator[Any]:
+        """Yield this split's block refs, keeping ONE next_refs RPC in
+        flight ahead: the request for group k+1 rides the wire while group
+        k's blocks are fetched (coordinator pinning covers the overlap —
+        see _SplitCoordinator._PIN_GROUPS)."""
+        nxt = self._coord.next_refs.remote(self._idx, epoch=epoch)
         while True:
-            refs, done = ray_tpu.get(
-                self._coord.next_refs.remote(self._idx)
-            )
+            refs, done = ray_tpu.get(nxt)
+            if not done:
+                nxt = self._coord.next_refs.remote(self._idx, epoch=epoch)
             for ref in refs:
-                # Direct object-store fetch: zero-copy shm view for local
-                # blocks, chunked pull for remote ones — the data plane
-                # never flows through the coordinator actor.
-                yield ray_tpu.get(ref)
+                yield ref
             if done:
                 return
+
+    def _blocks(self) -> Iterator[pa.Table]:
+        if self._coord is None:
+            yield from self._local_blocks()
+            return
+        epoch = ray_tpu.get(self._coord.start_epoch.remote(self._idx))
+        # Direct object-store fetch: zero-copy shm view for local blocks,
+        # chunked pull for remote ones — the data plane never flows through
+        # the coordinator actor. Pipelined so fetch overlaps assembly.
+        yield from iter_blocks_pipelined(self._ref_stream(epoch))
 
     def iter_batches(
         self,
@@ -417,4 +596,4 @@ class DataIterator:
         return Dataset([FromBlocks(list(self._blocks()))])
 
     def __reduce__(self):
-        return (DataIterator, (self._coord, self._idx))
+        return (DataIterator, (self._coord, self._idx, self._local_plan, self._par))

@@ -8,7 +8,9 @@ code is invisible to all of that — it works in the one code path that
 reads it and silently disappears from cluster-wide observability.
 
 This pass flags dict-literal assignments to ``*stats``-named targets inside
-``_private`` packages (``ray_tpu/_private/``, ``ray_tpu/serve/_private/``).
+``_private`` packages (``ray_tpu/_private/``, ``ray_tpu/serve/_private/``)
+and the instrumented data layer (``ray_tpu/data/`` — runtime code since the
+ingest pipeline gained telemetry families).
 Legacy dicts that intentionally stay (they back an existing ``stats()``
 surface consumed by loadgen/chaos) carry an explicit waiver:
 
@@ -55,7 +57,14 @@ def _target_name(node: ast.AST) -> Optional[str]:
 
 def _in_private_pkg(path: str) -> bool:
     parts = os.path.normpath(path).split(os.sep)
-    return "_private" in parts
+    if "_private" in parts:
+        return True
+    # The data layer is runtime code with registered telemetry families;
+    # hold it to the same no-ad-hoc-stats bar.
+    for i, p in enumerate(parts[:-1]):
+        if p == "ray_tpu" and parts[i + 1] == "data":
+            return True
+    return False
 
 
 def lint_file(path: str) -> List[Finding]:

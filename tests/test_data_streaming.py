@@ -1,0 +1,345 @@
+"""Streaming ingest fast-path tests: metadata plumbing, bounded windows,
+completion-order output, the zero-copy batcher, fused read->map stages and
+fast teardown (reference model: python/ray/data/tests/test_streaming_*)."""
+
+import pickle
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rd
+from ray_tpu.data import _execution as E
+from ray_tpu.data import block as B
+from ray_tpu.data.iterator import batches_from_blocks, iter_blocks_pipelined
+
+
+class _SubmitSpy:
+    """Record the function name of every TASK submitted through
+    ray_tpu.remote (actors pass through untouched)."""
+
+    def __init__(self, monkeypatch):
+        self.names = []
+        orig_remote = ray_tpu.remote
+        spy = self
+
+        class _Tracking:
+            def __init__(self, fn_name, wrapped):
+                self._fn_name = fn_name
+                self._wrapped = wrapped
+
+            def remote(self, *ta, **tk):
+                spy.names.append(self._fn_name)
+                return self._wrapped.remote(*ta, **tk)
+
+            def options(self, **opts):
+                return _Tracking(self._fn_name, self._wrapped.options(**opts))
+
+        def tracking_remote(*a, **k):
+            if a and callable(a[0]) and not isinstance(a[0], type):
+                return _Tracking(a[0].__name__, orig_remote(*a, **k))
+            if not a and k:
+                # decorator-with-options form: remote(num_cpus=1)(fn_or_cls)
+                def deco(obj):
+                    wrapped = orig_remote(**k)(obj)
+                    if isinstance(obj, type):
+                        return wrapped
+                    return _Tracking(obj.__name__, wrapped)
+
+                return deco
+            return orig_remote(*a, **k)
+
+        monkeypatch.setattr(E.ray_tpu, "remote", tracking_remote)
+
+    def count(self, name):
+        return sum(1 for n in self.names if n == name)
+
+
+def test_metadata_rides_with_refs(ray_start_regular):
+    """Every stage task returns (block, meta) via num_returns=2; the meta
+    matches the materialized block exactly, for map, repartition, sort and
+    groupby stages alike."""
+    ds = (
+        rd.range(40, parallelism=4)
+        .map_batches(lambda b: {"id": b["id"], "y": b["id"] * 2})
+        .repartition(3)
+    )
+    bundles = list(ds.iter_bundles())
+    assert len(bundles) == 3
+    metas = E.resolve_metas(bundles)
+    blocks = ray_tpu.get([b.block for b in bundles])
+    for meta, blk in zip(metas, blocks):
+        assert meta.num_rows == blk.num_rows
+        assert meta.size_bytes == blk.nbytes
+    assert sum(m.num_rows for m in metas) == 40
+
+    for ds2 in (
+        rd.range(20, parallelism=3).sort("id"),
+        rd.range(20, parallelism=3).groupby("id").count(),
+    ):
+        bundles = list(ds2.iter_bundles())
+        metas = E.resolve_metas(bundles)
+        blocks = ray_tpu.get([b.block for b in bundles])
+        for meta, blk in zip(metas, blocks):
+            assert meta.num_rows == blk.num_rows
+
+
+def test_resolve_metas_caches_and_batches(ray_start_regular):
+    """resolve_metas resolves ref-typed metas with one batched get and
+    caches the concrete BlockMeta on the bundle."""
+    bundles = list(rd.range(30, parallelism=3).iter_bundles())
+    assert all(isinstance(b.meta, ray_tpu.ObjectRef) for b in bundles)
+    metas = E.resolve_metas(bundles)
+    assert all(isinstance(b.meta, B.BlockMeta) for b in bundles)
+    # Second resolve is a pure cache hit (no refs left to fetch).
+    assert E.resolve_metas(bundles) == metas
+
+
+def test_no_counter_round_trips(ray_start_regular, monkeypatch):
+    """Limit / zip / repartition / count dispatch on bundled metadata: the
+    only tasks submitted are the data-bearing stage kernels — no per-block
+    row-counting task exists anywhere in the pipeline."""
+    spy = _SubmitSpy(monkeypatch)
+    ds = rd.range(40, parallelism=4)
+    assert ds.count() == 40
+    assert ds.limit(11).count() == 11
+    assert ds.repartition(3).count() == 40
+    z = rd.range(8, parallelism=2).zip(
+        rd.range(8, parallelism=2).map_batches(lambda b: {"o": b["id"] + 1})
+    )
+    assert z.count() == 8
+    data_kernels = {
+        "_exec_read",
+        "_exec_map",
+        "_slice_concat",
+        "_zip_tables",
+        "_partition_block",
+        "_merge_sort",
+        "_merge_shuffle",
+        "_merge_groupby",
+        "_sample_block",
+    }
+    assert spy.names, "spy saw no submissions"
+    assert set(spy.names) <= data_kernels, set(spy.names) - data_kernels
+
+
+def test_bounded_in_flight_submissions(ray_start_regular, monkeypatch):
+    """Pulling one block from a 64-task read submits O(parallelism) tasks,
+    not the whole stage (backpressure reaches the submit window)."""
+    spy = _SubmitSpy(monkeypatch)
+    ds = rd.range(64, parallelism=64)
+    ex = E.StreamingExecutor(4)
+    it = ex.execute(ds._ops)
+    next(it)
+    assert 0 < spy.count("_exec_read") <= 2 * 4 + 1, spy.count("_exec_read")
+    it.close()  # teardown; remaining tasks never submit
+    assert spy.count("_exec_read") <= 2 * 4 + 2
+
+
+def test_completion_order_yields_all_blocks(ray_start_regular):
+    """preserve_order=False yields every block exactly once, a slow first
+    task does not stall later blocks, and preserve_order=True keeps
+    submission order."""
+
+    def make_ops(sleep_first):
+        def synth(b):
+            if sleep_first and int(np.asarray(b["id"]).reshape(-1)[0]) == 0:
+                time.sleep(2.0)
+            return {"id": b["id"]}
+
+        return rd.range(8, parallelism=8).map_batches(synth, batch_size=1)._ops
+
+    # Warm the worker pool so spawn latency doesn't mask completion order.
+    assert rd.range(8, parallelism=8).count() == 8
+
+    ex = E.StreamingExecutor(8, preserve_order=False)
+    t0 = time.perf_counter()
+    got = []
+    first_yield_at = None
+    for bundle in ex.execute(make_ops(sleep_first=True)):
+        if first_yield_at is None:
+            first_yield_at = time.perf_counter() - t0
+        got.extend(ray_tpu.get(bundle.block).column("id").to_pylist())
+    assert sorted(got) == list(range(8))
+    # The straggler (block 0, sleeping 2s) was NOT the first block out —
+    # a finished block jumped the queue well before the straggler was done.
+    assert got[0] != 0, got
+    assert first_yield_at < 1.9, first_yield_at
+
+    ex = E.StreamingExecutor(8, preserve_order=True)
+    ordered = []
+    for bundle in ex.execute(make_ops(sleep_first=False)):
+        ordered.extend(ray_tpu.get(bundle.block).column("id").to_pylist())
+    assert ordered == list(range(8))
+
+
+def test_read_map_fusion():
+    """A task-pool MapBlocks directly after Read folds INTO the read task:
+    one fused stage, no intermediate block."""
+    ds = rd.range(8, parallelism=2).map_batches(lambda b: {"y": b["id"] * 3})
+    fused = E._fuse_maps(list(ds._ops))
+    assert len(fused) == 1
+    assert isinstance(fused[0], E.Read)
+    assert "MapBatches" in fused[0].name
+    out = fused[0].read_tasks[0]()
+    assert out.column("y").to_pylist() == [0, 3, 6, 9]
+    # Actor-pool stages must NOT fuse (they need the pool).
+    ds2 = rd.range(8, parallelism=2).map_batches(
+        type("U", (), {"__call__": lambda self, b: b}), concurrency=1
+    )
+    fused2 = E._fuse_maps(list(ds2._ops))
+    assert len(fused2) == 2
+
+
+def _tables(*row_counts):
+    out = []
+    base = 0
+    for n in row_counts:
+        out.append(pa.table({"v": list(range(base, base + n))}))
+        base += n
+    return out
+
+
+def test_batcher_block_boundaries_and_drop_last():
+    # Batch spans three blocks; remainder emitted when drop_last=False.
+    blocks = _tables(3, 2, 4)  # 9 rows
+    batches = list(batches_from_blocks(iter(blocks), 4, "pyarrow", False))
+    assert [b.num_rows for b in batches] == [4, 4, 1]
+    assert [v for b in batches for v in b.column("v").to_pylist()] == list(
+        range(9)
+    )
+    # drop_last drops the short tail.
+    batches = list(batches_from_blocks(iter(blocks), 4, "pyarrow", True))
+    assert [b.num_rows for b in batches] == [4, 4]
+    # Exact block boundary: no concat, batch IS a zero-copy slice.
+    blocks = _tables(4, 4)
+    batches = list(batches_from_blocks(iter(blocks), 4, "pyarrow", False))
+    assert [b.num_rows for b in batches] == [4, 4]
+    # Empty blocks are skipped, including a trailing one.
+    blocks = [pa.table({"v": []}), *_tables(2, 3), pa.table({"v": []})]
+    batches = list(batches_from_blocks(iter(blocks), 5, "pyarrow", False))
+    assert [b.num_rows for b in batches] == [5]
+    # batch_size=None passes blocks through unchanged.
+    out = list(batches_from_blocks(iter(_tables(2, 3)), None, "pyarrow"))
+    assert [b.num_rows for b in out] == [2, 3]
+
+
+def test_batcher_slices_are_zero_copy():
+    """A batch emitted from inside one block shares that block's buffers."""
+    blk = pa.table({"v": np.arange(64, dtype=np.int64)})
+    batches = list(batches_from_blocks(iter([blk]), 16, "pyarrow", False))
+    assert len(batches) == 4
+    src = blk.column("v").chunk(0).buffers()[1]
+    for b in batches:
+        bufs = b.column("v").chunk(0).buffers()
+        assert bufs[1].address == src.address or (
+            src.address <= bufs[1].address < src.address + src.size
+        )
+
+
+def test_iter_blocks_pipelined_order_and_close(ray_start_regular):
+    refs = [ray_tpu.put(t) for t in _tables(2, 3, 4, 1)]
+    closed = []
+
+    def ref_gen():
+        try:
+            yield from refs
+        finally:
+            closed.append(True)
+
+    got = list(iter_blocks_pipelined(ref_gen(), lookahead=3))
+    assert [t.num_rows for t in got] == [2, 3, 4, 1]
+    assert closed == [True]
+    # Abandonment also closes the source generator.
+    closed.clear()
+    it = iter_blocks_pipelined(ref_gen(), lookahead=3)
+    next(it)
+    it.close()
+    assert closed == [True]
+
+
+def test_streaming_split_single_is_local_fast_path(ray_start_regular):
+    """streaming_split(1) runs in-process (no coordinator actor); pickling
+    ships the plan, so a remote consumer drives its own local execution."""
+    ds = rd.range(24, parallelism=4)
+    (it,) = ds.streaming_split(1)
+    assert it._coord is None
+    seen = []
+    for b in it.iter_batches(batch_size=5):
+        seen.extend(b["id"].tolist())
+    assert sorted(seen) == list(range(24))
+    # Second epoch works (fresh local execution per pass).
+    assert it._coord is None
+    seen2 = [v for b in it.iter_batches(batch_size=None) for v in b["id"]]
+    assert sorted(seen2) == list(range(24))
+    # Pickle round-trip carries the plan; the clone iterates independently
+    # (one split == the whole dataset) and no actor is ever spawned.
+    clone = pickle.loads(pickle.dumps(it))
+    assert it._coord is None and clone._coord is None
+    seen3 = [v for b in clone.iter_batches(batch_size=6) for v in b["id"]]
+    assert sorted(seen3) == list(range(24))
+
+
+def test_streaming_split_single_shipped_to_task(ray_start_regular):
+    """A fast-path DataIterator survives ray serialization as a task arg:
+    the receiving worker drives the execution itself."""
+    (it,) = rd.range(12, parallelism=3).streaming_split(1)
+
+    @ray_tpu.remote
+    def consume(shard):
+        return sorted(
+            v for b in shard.iter_batches(batch_size=4) for v in b["id"]
+        )
+
+    assert ray_tpu.get(consume.remote(it), timeout=120) == list(range(12))
+
+
+def test_streaming_split_completion_order_covers_rows(ray_start_regular):
+    """Default split dispatch is completion-order; every row still arrives
+    exactly once across splits."""
+    ds = rd.range(36, parallelism=6)
+    shards = ds.streaming_split(2)
+    seen = []
+    for s in shards:
+        for b in s.iter_batches(batch_size=None):
+            seen.extend(b["id"].tolist())
+    assert sorted(seen) == list(range(36))
+
+
+def test_abandoned_actor_stage_teardown_is_fast(ray_start_regular):
+    """Breaking out of iteration over an actor-pool stage cancels the
+    undelivered window instead of riding it out: teardown completes far
+    sooner than executing every remaining (slow) block would take."""
+
+    class SlowUdf:
+        def __call__(self, batch):
+            time.sleep(0.5)
+            return batch
+
+    ds = rd.range(16, parallelism=16).map_batches(
+        SlowUdf, concurrency=1, batch_size=None
+    )
+    it = ds.iter_batches(batch_size=None, prefetch_batches=0)
+    next(it)
+    t0 = time.perf_counter()
+    it.close()  # abandon: 14+ blocks never delivered
+    dt = time.perf_counter() - t0
+    # Riding out the remaining blocks serially would cost >= 5s; the
+    # cancel-or-seal teardown only waits for the in-flight window.
+    assert dt < 4.0, f"teardown took {dt:.1f}s"
+
+
+def _family_total(family):
+    return sum(c.v for c in family._cells.values())
+
+
+def test_ingest_telemetry_counters_move(ray_start_regular):
+    before_blocks = _family_total(E._BLOCKS_PRODUCED)
+    before_resolves = _family_total(E._META_RESOLVES)
+    ds = rd.range(32, parallelism=4)
+    assert ds.count() == 32
+    list(ds.iter_batches(batch_size=8))
+    assert _family_total(E._BLOCKS_PRODUCED) > before_blocks
+    assert _family_total(E._META_RESOLVES) > before_resolves

@@ -65,6 +65,7 @@ perf:
 
 SEEDS ?= 20
 LATENCY_SEEDS ?= 10
+SCHED_SEEDS ?= 10
 chaos:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m ray_tpu.chaos --check-determinism \
 		--suite full --seeds $(SEEDS)
@@ -72,3 +73,5 @@ chaos:
 		--seeds $(SEEDS)
 	env JAX_PLATFORMS=cpu $(PYTHON) -m ray_tpu.chaos --suite latency \
 		--seeds $(LATENCY_SEEDS)
+	env JAX_PLATFORMS=cpu $(PYTHON) -m ray_tpu.chaos --suite sched \
+		--seeds $(SCHED_SEEDS)

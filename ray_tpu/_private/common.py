@@ -70,6 +70,12 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     # scheduler_spread_threshold).
     "scheduler_spread_threshold": 0.5,
     "scheduler_top_k_fraction": 0.2,
+    # Cluster-view delta batching: the GCS coalesces node resource/membership
+    # changes for this long before publishing one versioned delta on
+    # "syncer:nodes". 0 publishes immediately (one delta per mutation); at
+    # hundreds of nodes batching caps the broadcast fan-out at
+    # subscribers/batch_ms msgs/s instead of subscribers*grants/s.
+    "scheduler_view_batch_ms": 0,
     # Object spilling (reference: local_object_manager.cc +
     # external_storage.py): sealed objects are written to disk when the shm
     # arena fills and restored on access. Empty dir -> default under /tmp.

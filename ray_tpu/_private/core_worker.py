@@ -366,9 +366,11 @@ class _ShapePool:
 
     def __init__(self, resources, pg_id, bundle_index, strategy=None):
         self.idle: List[Lease] = []
-        # Work items in FIFO order. Each is either ("task", wire) — a
-        # callback-dispatched task submission — or ("waiter", future) — an
-        # async acquire() that receives the lease itself.
+        # Work items in FIFO order. Each is ("task", wire, None) — a
+        # callback-dispatched task submission — or ("waiter", future, hints)
+        # — an async acquire() that receives the lease itself, with optional
+        # arg-locality hints ({"host:port": weight}) forwarded to the raylet
+        # on the next lease request of this shape.
         self.pending: "deque" = deque()
         self.inflight = 0
         # lease_ids of in-flight RequestWorkerLease RPCs still cancellable on
@@ -450,16 +452,21 @@ class LeasePool:
             key, wire.get("resources") or {}, wire.get("pg_id"),
             wire.get("bundle_index", -1), strategy,
         )
-        pool.pending.append(("task", wire))
+        pool.pending.append(("task", wire, None))
         self._pump(key, pool)
 
     async def acquire(
-        self, resources: Dict[str, int], pg_id=None, bundle_index=None, strategy=None
+        self,
+        resources: Dict[str, int],
+        pg_id=None,
+        bundle_index=None,
+        strategy=None,
+        locality: Optional[Dict[str, float]] = None,
     ) -> Lease:
         key = self.shape_key(resources, pg_id, bundle_index, strategy)
         pool = self._pool(key, resources, pg_id, bundle_index, strategy)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        pool.pending.append(("waiter", fut))
+        pool.pending.append(("waiter", fut, locality))
         self._pump(key, pool)
         return await fut
 
@@ -487,7 +494,7 @@ class LeasePool:
                 if lease.outstanding >= allowed:
                     i += 1
                     continue
-                kind, item = pending.popleft()
+                kind, item, _hints = pending.popleft()
                 if kind == "waiter":
                     # Waiters check the lease out exclusively.
                     pool.idle.pop(i)
@@ -599,14 +606,64 @@ class LeasePool:
         if pool.idle:
             self._schedule_idle_sweep(key, pool)
 
+    def _pool_locality_hints(self, pool: _ShapePool) -> Optional[Dict[str, float]]:
+        """Aggregate arg-location hints over the next few queued work items
+        so the raylet can prefer a node already holding their args. Only
+        acquire() waiters carry hints (the dependency-free fast path has no
+        plasma args by construction); weights count objects per holder."""
+        hints: Optional[Dict[str, float]] = None
+        scanned = 0
+        for _kind, _item, h in pool.pending:
+            if h:
+                if hints is None:
+                    hints = {}
+                for addr_key, w in h.items():
+                    hints[addr_key] = hints.get(addr_key, 0.0) + w
+            scanned += 1
+            if scanned >= 8:
+                break
+        return hints
+
+    async def _gcs_spill_target(self, pool: _ShapePool):
+        """Hop-cap fallback: per-raylet views lag under churn, and a chain of
+        stale spillback suggestions can loop (A->B->A). The GCS node table is
+        authoritative — pick its least-utilized ALIVE node that can ever fit
+        the demand and pin the request there (spilled_from), where it queues
+        until resources free instead of bouncing further."""
+        try:
+            reply = await self.core.gcs.call("GetAllNodes")
+        except rpc.RpcError:
+            return None
+        demand = ResourceSet.from_units(pool.resources or {})
+        best = None
+        best_util = None
+        for n in reply["nodes"]:
+            if n.get("state") != "ALIVE":
+                continue
+            if not pool.pg_id and not demand.is_subset_of(
+                ResourceSet.from_units(n["total"])
+            ):
+                # (PG demands are rewritten to group-scoped names on the
+                # raylet; raw names can't be checked against totals here.)
+                continue
+            util = 0.0
+            for k, tot in n["total"].items():
+                if tot > 0 and not k.startswith("node:"):
+                    util = max(util, 1.0 - n["available"].get(k, 0) / tot)
+            if best_util is None or util < best_util:
+                best, best_util = n, util
+        return None if best is None else best["addr"]
+
     async def _request_lease(self, key, pool: _ShapePool) -> None:
         from ray_tpu._private.ids import fast_unique_hex
 
         lease_id = fast_unique_hex()
         raylet_conn = self.core.raylet_conn
         pool.inflight_ids.add(lease_id)
+        locality = self._pool_locality_hints(pool)
         try:
             hops = 0
+            used_gcs_fallback = False
             while True:
                 reply = await raylet_conn.call(
                     "RequestWorkerLease",
@@ -617,6 +674,7 @@ class LeasePool:
                         "bundle_index": pool.bundle_index,
                         "strategy": pool.strategy,
                         "spilled_from": hops > 0,
+                        "locality": locality,
                         # Owning job: the raylet's memory-monitor kill policy
                         # groups leased workers by owner for fair shedding.
                         "job_id": self.core.job_id,
@@ -655,14 +713,31 @@ class LeasePool:
                     )
                 hops += 1
                 if hops > 4:
-                    raise rpc.RpcError("lease spillback loop exceeded 4 hops")
+                    if used_gcs_fallback:
+                        raise rpc.RpcError(
+                            "lease spillback loop exceeded 4 hops after "
+                            "GCS-view fallback"
+                        )
+                    # Spillback chain overran its budget (stale views can
+                    # bounce a request between nodes): re-anchor on the GCS
+                    # global view instead of failing the task.
+                    used_gcs_fallback = True
+                    target_addr = await self._gcs_spill_target(pool)
+                    if target_addr is None:
+                        raise rpc.RpcError(
+                            f"no node can host resources {pool.resources} "
+                            "(cluster infeasible)"
+                        )
+                    hops = 1  # fresh budget; stays pinned (spilled_from)
+                    raylet_conn = await self.core.connect_to(tuple(target_addr))
+                    continue
                 raylet_conn = await self.core.connect_to(tuple(spill["addr"]))
         except Exception as e:
             pool.inflight -= 1
             pool.inflight_ids.discard(lease_id)
             # Fail one pending item (the request served one logical slot).
             while pool.pending:
-                kind, item = pool.pending.popleft()
+                kind, item, _hints = pool.pending.popleft()
                 if kind == "waiter":
                     if not item.done():
                         item.set_exception(e)
@@ -821,7 +896,7 @@ class LeasePool:
                 lease.outstanding -= 1
                 pool.total_outstanding -= 1
                 wire["_no_fastpath"] = True
-                pool.pending.append(("task", wire))
+                pool.pending.append(("task", wire, None))
                 self._lease_available(key, pool, lease)
             else:  # 2: channel lost — normal worker-death retry machinery
                 lease.fp_channel = False
@@ -883,7 +958,7 @@ class LeasePool:
             loop = asyncio.get_running_loop()
             loop.call_later(
                 min(1.0, 0.1 * (attempt + 1)),
-                lambda: (pool.pending.append(("task", wire)), self._pump(key, pool)),
+                lambda: (pool.pending.append(("task", wire, None)), self._pump(key, pool)),
             )
         else:
             core._finish_task_error(
@@ -2115,11 +2190,37 @@ class CoreWorker:
         if waits:
             await asyncio.gather(*waits)
 
+    def _arg_locality(self, wire: dict) -> Optional[Dict[str, float]]:
+        """Locations of the task's plasma-resident args as addr-keyed
+        weights ("host:port" -> object count). Deps are resolved by the time
+        this runs, so the memory store knows each primary copy's raylet
+        (put_plasma_marker); entries carry no sizes, so weights count
+        objects, not bytes."""
+        deps = wire.get("dependencies")
+        if not deps:
+            return None
+        from ray_tpu._private.object_store import IN_PLASMA
+
+        hints: Dict[str, float] = {}
+        for oid, _owner in deps:
+            entry = self.memory_store.get(oid)
+            if (
+                entry is not None
+                and entry.kind == IN_PLASMA
+                and entry.plasma_addr
+            ):
+                addr_key = f"{entry.plasma_addr[0]}:{entry.plasma_addr[1]}"
+                hints[addr_key] = hints.get(addr_key, 0.0) + 1.0
+        return hints or None
+
     async def _lease_and_push(self, wire: dict) -> dict:
         resources = wire.get("resources") or {}
         pg_id, bundle_index = wire.get("pg_id"), wire.get("bundle_index", -1)
         strategy = wire.get("scheduling_strategy")
-        lease = await self.lease_pool.acquire(resources, pg_id, bundle_index, strategy)
+        lease = await self.lease_pool.acquire(
+            resources, pg_id, bundle_index, strategy,
+            locality=self._arg_locality(wire),
+        )
         dirty = False
         entry = None
         try:

@@ -18,6 +18,7 @@ the node dead, which drives actor restarts and PG rescheduling.
 from __future__ import annotations
 
 import asyncio
+import bisect
 import logging
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
@@ -197,9 +198,27 @@ class GcsServer:
 
         self.server.dispatch_observer = _observe_latency
         # Monotonic cluster-view version; every membership/resource change
-        # bumps it and broadcasts a delta (reference: ray_syncer.h:88
-        # bidirectional versioned sync streams).
+        # bumps it and broadcasts the scheduling head (reference:
+        # ray_syncer.h:88 versioned sync streams). The GCS is the one place
+        # that sees every resource report, so IT maintains the
+        # utilization-sorted order incrementally (O(log n) bisect per
+        # report) and subscribers receive only the sorted head — the
+        # least-utilized candidate set every top-k/spillback pick needs.
+        # Broadcasting full per-node deltas instead would cost every
+        # subscriber O(dirty) decode+apply per flush, which measured
+        # O(N^2) cluster-wide during lease storms.
         self.view_version = 0
+        # Membership/total-capacity epoch: keys subscriber-side caches that
+        # only depend on cluster shape (e.g. the SPREAD ring).
+        self.view_epoch = 0
+        self._util_sorted: List[Tuple[float, str]] = []  # (util, node_id)
+        self._node_utils: Dict[str, float] = {}
+        # Head batching (scheduler_view_batch_ms): mutations coalesce for
+        # one window and flush as a single versioned head broadcast, so a
+        # grant storm at N nodes costs subscribers/window broadcasts
+        # instead of subscribers*grants.
+        self._view_dirty = False
+        self._view_flush_handle: Optional[asyncio.TimerHandle] = None
         # Structured events (reference: src/ray/util/event.cc): durable
         # JSONL + queryable ring, served via ListEvents.
         from ray_tpu._private.events import EventLogger
@@ -395,6 +414,9 @@ class GcsServer:
 
     async def stop(self) -> None:
         self._stopping = True
+        if self._view_flush_handle is not None:
+            self._view_flush_handle.cancel()
+            self._view_flush_handle = None
         if self._scheduler_task:
             self._scheduler_task.cancel()
         for t in self._bg_tasks:
@@ -443,12 +465,86 @@ class GcsServer:
 
     # -- nodes --------------------------------------------------------------
 
-    def _bump_view(self, node: "NodeInfo") -> None:
-        """One cluster-view mutation: bump the version and broadcast the
-        delta so every raylet's local view converges without polling."""
+    @staticmethod
+    def _util_of(total: Dict[str, int], available: Dict[str, int]) -> float:
+        util = 0.0
+        for k, tot in total.items():
+            if tot > 0 and not k.startswith("node:"):
+                util = max(util, 1.0 - available.get(k, 0) / tot)
+        return util
+
+    def _bump_view(self, node: "NodeInfo", membership: bool = False) -> None:
+        """One cluster-view mutation: refresh the node's slot in the
+        utilization-sorted index (O(log n)), then broadcast the scheduling
+        head so every raylet's candidate set converges without polling.
+        ``membership=True`` (join/death/total change) also bumps the shape
+        epoch that invalidates subscriber-side rings. With
+        scheduler_view_batch_ms > 0 the broadcast is coalesced into the
+        next flush window instead of published immediately."""
+        nid = node.node_id
+        old = self._node_utils.pop(nid, None)
+        if old is not None:
+            i = bisect.bisect_left(self._util_sorted, (old, nid))
+            if i < len(self._util_sorted) and self._util_sorted[i] == (old, nid):
+                del self._util_sorted[i]
+        if node.state == NODE_ALIVE:
+            util = self._util_of(node.total, node.available)
+            bisect.insort(self._util_sorted, (util, nid))
+            self._node_utils[nid] = util
+        if membership:
+            self.view_epoch += 1
+        batch_ms = config.scheduler_view_batch_ms
+        if batch_ms <= 0:
+            self._publish_view_head()
+            return
+        self._view_dirty = True
+        if self._view_flush_handle is None:
+            self._view_flush_handle = asyncio.get_running_loop().call_later(
+                batch_ms / 1000.0, self._flush_view_head
+            )
+
+    def _flush_view_head(self) -> None:
+        self._view_flush_handle = None
+        if not self._view_dirty or self._stopping:
+            return
+        self._view_dirty = False
+        self._publish_view_head()
+
+    # The head is capped: a pick only ever samples among the least-utilized
+    # candidates, and past a few dozen the marginal spread quality is nil
+    # while broadcast decode cost at N subscribers is linear in head size.
+    _VIEW_HEAD_CAP = 16
+
+    def _publish_view_head(self) -> None:
+        """Broadcast {"v", "epoch", "n", "head"}: the n alive-node count
+        plus the ``head`` least-utilized nodes in utilization order —
+        everything the hybrid top-k pick and spillback targeting consume,
+        sized O(head cap) regardless of cluster size."""
         self.view_version += 1
+        head = []
+        for util, nid in self._util_sorted:
+            node = self.nodes.get(nid)
+            if node is None or node.state != NODE_ALIVE:
+                continue
+            head.append(
+                {
+                    "node_id": nid,
+                    "addr": list(node.addr),
+                    "total": node.total,
+                    "available": node.available,
+                    "util": util,
+                }
+            )
+            if len(head) >= self._VIEW_HEAD_CAP:
+                break
         self._publish_msg(
-            "syncer:nodes", {"v": self.view_version, "node": node.to_wire()}
+            "syncer:nodes",
+            {
+                "v": self.view_version,
+                "epoch": self.view_epoch,
+                "n": len(self._util_sorted),
+                "head": head,
+            },
         )
 
     async def _register_node(self, conn, p):
@@ -462,7 +558,7 @@ class GcsServer:
             resources=p["resources"],
         )
         self._publish_msg("nodes", {"event": "added", "node": info.to_wire()})
-        self._bump_view(info)
+        self._bump_view(info, membership=True)
         self._wake_scheduler.set()
         return {"ok": True, "session_name": self.session_name}
 
@@ -487,6 +583,7 @@ class GcsServer:
         return {
             "nodes": [n.to_wire() for n in self.nodes.values()],
             "v": self.view_version,
+            "epoch": self.view_epoch,
         }
 
     async def _update_resources(self, conn, p):
@@ -499,9 +596,8 @@ class GcsServer:
                 return {"ok": True, "stale": True}
             if rv is not None:
                 node.report_version = rv
-            changed = node.available != p["available"] or (
-                p.get("total") and node.total != p["total"]
-            )
+            total_changed = bool(p.get("total")) and node.total != p["total"]
+            changed = node.available != p["available"] or total_changed
             node.available = p["available"]
             node.last_seen = time.monotonic()
             if p.get("total"):
@@ -509,7 +605,7 @@ class GcsServer:
             if changed:
                 # No-change heartbeats (idle 1s reports) must not fan out
                 # O(N^2) deltas across the cluster.
-                self._bump_view(node)
+                self._bump_view(node, membership=total_changed)
                 self._wake_scheduler.set()
         return {"ok": True}
 
@@ -542,7 +638,7 @@ class GcsServer:
             graceful=graceful,
         )
         self._publish_msg("nodes", {"event": "removed", "node": node.to_wire()})
-        self._bump_view(node)
+        self._bump_view(node, membership=True)
         # Fail/restart actors that lived there.
         for actor in list(self.actors.values()):
             if actor.node_id == node_id and actor.state in (ALIVE, PENDING_CREATION, RESTARTING):

@@ -73,6 +73,10 @@ class Publisher:
         if not subs:
             return
         frame = {"channel": channel, "msg": msg}
+        # Pack once, write the same bytes to every subscriber (None while a
+        # chaos interceptor is installed -> per-subscriber packing below).
+        packed = rpc.pack_push("Pub", frame)
+        item = frame if packed is None else packed
         for state in list(subs.values()):
             if state.conn.closed:
                 subs.pop(id(state.conn), None)
@@ -89,7 +93,7 @@ class Publisher:
                         channel,
                         state.dropped,
                     )
-            state.queue.append(frame)
+            state.queue.append(item)
             if not state.draining:
                 state.draining = True
                 rpc.spawn(self._drain(state))
@@ -97,9 +101,12 @@ class Publisher:
     async def _drain(self, state: _SubscriberState) -> None:
         try:
             while state.queue:
-                frame = state.queue.popleft()
+                item = state.queue.popleft()
                 try:
-                    state.conn.push_nowait("Pub", frame)
+                    if isinstance(item, bytes):
+                        state.conn.push_packed_nowait(item)
+                    else:
+                        state.conn.push_nowait("Pub", item)
                     # Backpressure on THIS subscriber's transport only.
                     await state.conn.drain()
                 except (rpc.ConnectionLost, rpc.RpcError):

@@ -64,6 +64,52 @@ def _bench_release_batched() -> float:
     return rate
 
 
+def _bench_sched() -> Dict[str, float]:
+    """Scheduler throughput on the simulated cluster: N raylets (real lease
+    scheduler, loopback RPC, in-process stub workers — sim_cluster.py) with
+    10k 1-CPU lease/release cycles driven through the core_worker spillback
+    protocol at bounded concurrency. Runs after shutdown(): the sim owns
+    its own loop and config env."""
+    import os
+
+    from ray_tpu._private.sim_cluster import SimCluster, SimLeaseClient
+
+    nodes = int(os.environ.get("RAY_TPU_SCHED_BENCH_NODES", "500"))
+    tasks = int(os.environ.get("RAY_TPU_SCHED_BENCH_TASKS", "10000"))
+    concurrency = int(os.environ.get("RAY_TPU_SCHED_BENCH_CONCURRENCY", "64"))
+    cluster = SimCluster(nodes).start()
+    client = SimLeaseClient(cluster)
+
+    async def schedule_all(n: int) -> None:
+        sem = asyncio.Semaphore(concurrency)
+        entries = [tuple(r.addr) for r in cluster.raylets.values()]
+
+        async def one(i: int) -> None:
+            async with sem:
+                await client.lease_cycle(
+                    {"CPU": 1.0}, entry_addr=entries[i % len(entries)]
+                )
+
+        await asyncio.gather(*(one(i) for i in range(n)))
+
+    try:
+        cluster.run(schedule_all(min(tasks, 500)), timeout=120)  # warmup
+        t0 = time.perf_counter()
+        cluster.run(schedule_all(tasks), timeout=600)
+        dt = time.perf_counter() - t0
+    finally:
+        cluster.run(client.close(), timeout=30)
+        cluster.shutdown()
+    rate = tasks / dt
+    wall_10k = dt * (10_000 / tasks)
+    print(f"sched leases ({nodes} sim nodes): {rate:.1f} /s")
+    print(f"time to schedule 10k tasks: {wall_10k:.2f} s")
+    return {
+        "leases_per_s": rate,
+        "time_to_schedule_10k_tasks_s": wall_10k,
+    }
+
+
 def _bench_telemetry_overhead() -> float:
     """Nanoseconds per hot-path telemetry record (one bound counter inc +
     one histogram observe) — the price every instrumented site pays. Gated
@@ -278,6 +324,7 @@ def main(json_path: str = "") -> Dict[str, float]:
     ray_tpu.shutdown()
 
     results["transfer_16mb_per_s"] = _bench_transfer_16mb()
+    results.update(_bench_sched())
     results["telemetry_overhead_ns"] = _bench_telemetry_overhead()
     if json_path:
         with open(json_path, "w") as f:

@@ -63,6 +63,27 @@ _TEL_WORKERS_IDLE = telemetry.gauge(
     "raylet", "workers_idle", "idle pooled workers"
 )
 _TEL_LEASES_ACTIVE = telemetry.gauge("raylet", "leases_active", "live leases")
+_TEL_LEASE_GRANT_LATENCY = telemetry.histogram(
+    "raylet", "lease_grant_latency_s",
+    "queue-to-grant latency of worker lease requests",
+    buckets=telemetry.LATENCY_BUCKETS_S,
+)
+_TEL_LEASE_SPILLBACKS = telemetry.counter(
+    "raylet", "lease_spillbacks",
+    "lease requests redirected to another node (one per spillback hop)",
+)
+_TEL_LOCALITY_HITS = telemetry.counter(
+    "raylet", "locality_hits",
+    "lease requests placed on a node already holding the task's args",
+)
+_TEL_LOCALITY_MISSES = telemetry.counter(
+    "raylet", "locality_misses",
+    "lease requests with locality hints placed on a non-hinted node",
+)
+_TEL_NODE_UTIL = telemetry.gauge(
+    "raylet", "node_utilization",
+    "max per-resource utilization of this node (0..1)",
+)
 _TEL_OBJ_SEALED = telemetry.counter(
     "object", "sealed", "objects sealed in the local store"
 )
@@ -266,6 +287,23 @@ class _Zygote:
                 await self.proc.wait()
 
 
+class _SimWorkerConn:
+    """Stand-in worker link for simulated-cluster raylets (sim_workers=True):
+    satisfies the liveness checks the grant/duplicate/release paths make
+    (closed flag, push_nowait) without a process or socket behind it."""
+
+    __slots__ = ("closed",)
+
+    def __init__(self):
+        self.closed = False
+
+    def push_nowait(self, method: str, payload: dict) -> None:
+        pass
+
+    def close(self) -> None:
+        self.closed = True
+
+
 class WorkerHandle:
     def __init__(self, worker_id: str, proc=None):
         self.worker_id = worker_id
@@ -287,6 +325,7 @@ class LeaseRequest:
         self.lease_id = lease_id
         self.demand = demand
         self.payload = payload
+        self.queued_at = time.monotonic()  # grant-latency histogram origin
         self.fut: asyncio.Future = asyncio.get_running_loop().create_future()
 
 
@@ -352,6 +391,11 @@ class Raylet:
     _tel_workers = _TEL_WORKERS.cell()
     _tel_workers_idle = _TEL_WORKERS_IDLE.cell()
     _tel_leases_active = _TEL_LEASES_ACTIVE.cell()
+    _tel_grant_latency = _TEL_LEASE_GRANT_LATENCY.cell()
+    _tel_spillbacks = _TEL_LEASE_SPILLBACKS.cell()
+    _tel_locality_hits = _TEL_LOCALITY_HITS.cell()
+    _tel_locality_misses = _TEL_LOCALITY_MISSES.cell()
+    _tel_node_util = _TEL_NODE_UTIL.cell()
 
     def __init__(
         self,
@@ -364,8 +408,15 @@ class Raylet:
         node_id: Optional[str] = None,
         labels: Optional[Dict[str, str]] = None,
         worker_env: Optional[Dict[str, str]] = None,
+        sim_workers: bool = False,
     ):
         from ray_tpu._private.ids import NodeID
+
+        # Simulated-cluster mode: grants attach in-process stub workers
+        # instead of forking real worker subprocesses, so hundreds of
+        # raylets fit in one process (tests/test_scale.py harness).
+        self.sim_workers = sim_workers
+        self._sim_worker_seq = 0
 
         self.node_id = node_id or NodeID.from_random().hex()
         self.session_name = session_name
@@ -505,6 +556,11 @@ class Raylet:
         self._tel_workers = _TEL_WORKERS.cell(raylet=_nid)
         self._tel_workers_idle = _TEL_WORKERS_IDLE.cell(raylet=_nid)
         self._tel_leases_active = _TEL_LEASES_ACTIVE.cell(raylet=_nid)
+        self._tel_grant_latency = _TEL_LEASE_GRANT_LATENCY.cell(raylet=_nid)
+        self._tel_spillbacks = _TEL_LEASE_SPILLBACKS.cell(raylet=_nid)
+        self._tel_locality_hits = _TEL_LOCALITY_HITS.cell(raylet=_nid)
+        self._tel_locality_misses = _TEL_LOCALITY_MISSES.cell(raylet=_nid)
+        self._tel_node_util = _TEL_NODE_UTIL.cell(raylet=_nid)
 
         # Placement group bundles committed on this node:
         # pg_id -> {"base": ResourceSet deducted, "group": ResourceSet added}
@@ -512,15 +568,31 @@ class Raylet:
         self.pg_committed: Dict[str, Tuple[ResourceSet, ResourceSet]] = {}
 
         self._resources_dirty = asyncio.Event()
+        # Full cluster view: pull-based with a ~1s TTL, consumed only by
+        # cold paths (node affinity, label pick, locality hints beyond the
+        # head, spillback fallback). The per-lease hot path never walks it.
         self._view: List[dict] = []
         self._view_time = 0.0
-        self._spread_rr = 0
-        self._view_fetch = None
-        # Versioned delta-synced cluster view (reference: ray_syncer.h:88):
-        # the GCS broadcasts one delta per membership/resource change; a
-        # version gap (dropped under backpressure) forces a full resync.
         self._view_map: Dict[str, dict] = {}
-        self._view_version = -1
+        self._view_addr: Dict[str, str] = {}  # "host:port" -> node_id
+        self._view_fetched_epoch = -1
+        self._view_fetch = None
+        # Scheduling head (reference: ray_syncer.h:88, inverted): the GCS —
+        # the one process that sees every resource report — keeps the
+        # utilization-sorted order and broadcasts only the sorted head, so
+        # a flush costs each subscriber O(head cap) instead of O(changed
+        # nodes), and the per-lease pick walks the head: O(k), never
+        # O(cluster). Each message replaces the previous head wholesale.
+        self._head: List[dict] = []  # {node_id, addr, total, available, util}
+        self._head_addr_map: Optional[Dict[str, dict]] = None  # lazy
+        self._head_n = 0  # alive-node count cluster-wide
+        self._head_version = -1
+        # GCS shape epoch: bumped on membership/total-capacity change; keys
+        # the SPREAD ring cache (ring membership only depends on totals, not
+        # availability) and forces a full-view refetch when it moves.
+        self._head_epoch = -1
+        self._spread_rr = 0
+        self._spread_ring: Optional[Tuple[int, tuple, list]] = None
         # Monotonic version on our own resource reports so the GCS can drop
         # stale/out-of-order updates.
         self._report_version = 0
@@ -575,14 +647,15 @@ class Raylet:
                     "labels": self.labels,
                 },
             )
-            # Deltas missed during the outage are unrecoverable: force a
-            # snapshot resync before trusting the view again.
-            self._view_version = -1
+            # A restarted GCS numbers heads from zero: drop the stale head
+            # and view so the next broadcast/pick resyncs from scratch.
+            self._head_version = -1
+            self._view_time = 0.0
             self._mark_dirty()
 
         self.gcs.on_reconnect(_register)
         await _register(self.gcs)
-        await self.gcs.subscribe("syncer:nodes", self._on_view_delta)
+        await self.gcs.subscribe("syncer:nodes", self._on_view_head)
         self._tasks.append(rpc.spawn(self._resource_report_loop()))
         self._tasks.append(rpc.spawn(self._condemned_sweep_loop()))
         self._tasks.append(rpc.spawn(self._infeasible_retry_loop()))
@@ -611,6 +684,15 @@ class Raylet:
             await self.gcs.close()  # before anything else: no re-registration
         for t in self._tasks:
             t.cancel()
+        # Fail queued lease futures so their handler frames unwind now:
+        # callers get a retryable error (or already saw the link drop) and
+        # in-process harnesses (sim_cluster, chaos kill_raylet) don't
+        # accumulate orphaned handler tasks until their loop closes.
+        for req in self.pending_leases + self.infeasible_leases:
+            if not req.fut.done():
+                req.fut.set_exception(rpc.RpcError("raylet stopping"))
+        self.pending_leases.clear()
+        self.infeasible_leases.clear()
         procs = [w.proc for w in list(self.workers.values()) if w.proc is not None]
         for w in list(self.workers.values()):
             # Graceful first: the worker's Exit handler flushes and exits 0;
@@ -793,6 +875,7 @@ class Raylet:
 
     def _mark_dirty(self) -> None:
         self._resources_dirty.set()
+        self._tel_node_util.set(self._local_util())
 
     # -- worker pool ---------------------------------------------------------
 
@@ -1051,7 +1134,30 @@ class Raylet:
             # without its raylet link is unmanageable.
             self._kill_worker_proc(handle)
 
+    def _make_sim_worker(self) -> WorkerHandle:
+        self._sim_worker_seq += 1
+        wid = f"simw-{self.node_id[:8]}-{self._sim_worker_seq}"
+        handle = WorkerHandle(wid)
+        handle.sim = True  # type: ignore[attr-defined]
+        handle.conn = _SimWorkerConn()  # type: ignore[assignment]
+        handle.addr = tuple(self.addr)
+        handle.registered.set_result(True)
+        self.workers[wid] = handle
+        self._tel_workers_started.inc()
+        self._tel_refresh_gauges()
+        return handle
+
     def _kill_worker_proc(self, handle: WorkerHandle) -> None:
+        if getattr(handle, "sim", False):
+            # Simulated worker: no process to reap — finalize synchronously
+            # (conn closed + popped from the pool) so the exactly-once
+            # invariants see the same end state a real exit produces.
+            if handle.conn is not None:
+                handle.conn.close()
+            if self.workers.pop(handle.worker_id, None) is not None:
+                self._tel_workers_exited.inc()
+            self._tel_refresh_gauges()
+            return
         if handle.proc is None:
             # Fork still in flight: remember the kill; _start_worker
             # delivers it the moment the pid is known.
@@ -1067,6 +1173,8 @@ class Raylet:
             handle = self.idle_workers.pop()
             if handle.worker_id in self.workers and handle.conn and not handle.conn.closed:
                 return handle
+        if self.sim_workers:
+            return self._make_sim_worker()
         handle = await self._start_worker()
         await handle.registered
         return handle
@@ -1110,7 +1218,7 @@ class Raylet:
                         target = {"node_id": affinity, "addr": n["addr"]}
                     break
             if target is not None:
-                return {"spillback": target}
+                return self._spill_reply(target)
             if not strategy.get("soft"):
                 raise rpc.RpcError(
                     f"node affinity target {affinity[:8]} not in cluster "
@@ -1147,14 +1255,14 @@ class Raylet:
                         "with capacity for the demand"
                     )
                 if target["node_id"] != self.node_id:
-                    return {"spillback": target}
+                    return self._spill_reply(target)
                 # Local node is the pick: fall through to queue here.
                 strategy = {k: v for k, v in strategy.items() if k != "labels"}
         if not demand.is_subset_of(self.total):
             # Infeasible here — suggest spillback target from GCS view.
             target = await self._find_spillback_node(demand)
             if target is not None:
-                return {"spillback": target}
+                return self._spill_reply(target)
             # Cluster-wide infeasible: park on a SIDE queue and wait rather
             # than fail — the demand shows up in pending_demand, the
             # autoscaler can add a node that fits, and the retry loop spills
@@ -1171,16 +1279,38 @@ class Raylet:
             self.infeasible_leases.append(req)
             return await req.fut
         if not affinity and not p.get("spilled_from"):
-            # Scheduling policy (reference: hybrid_scheduling_policy.cc /
-            # scheduling_policy.h SPREAD): decide local-vs-remote before
-            # queueing. Spilled-over requests stay put to avoid ping-pong.
-            target = await self._policy_pick(demand, strategy)
-            if target is not None:
-                return {"spillback": target}
+            placed_by_locality = False
+            hints = p.get("locality") or {}
+            if hints:
+                # Locality-aware placement (reference: locality-aware lease
+                # policy): prefer a node already holding the task's args.
+                # Counted once per lease — spilled-over requests never
+                # re-enter this block.
+                await self._cluster_view()
+                pick = self._locality_pick(demand, hints)
+                if pick is None:
+                    self._tel_locality_misses.inc()
+                elif pick["node_id"] != self.node_id:
+                    self._tel_locality_hits.inc()
+                    return self._spill_reply(pick)
+                else:
+                    self._tel_locality_hits.inc()
+                    placed_by_locality = True
+            if not placed_by_locality:
+                # Scheduling policy (reference: hybrid_scheduling_policy.cc /
+                # scheduling_policy.h SPREAD): decide local-vs-remote before
+                # queueing. Spilled-over requests stay put to avoid ping-pong.
+                target = await self._policy_pick(demand, strategy)
+                if target is not None:
+                    return self._spill_reply(target)
         req = LeaseRequest(p["lease_id"], demand, p)
         self.pending_leases.append(req)
         self._try_grant_leases()
         return await req.fut
+
+    def _spill_reply(self, target: dict) -> dict:
+        self._tel_spillbacks.inc()
+        return {"spillback": target}
 
     # -- scheduling policy (reference: raylet/scheduling/policy/) ------------
 
@@ -1205,45 +1335,56 @@ class Raylet:
                     continue
                 self.infeasible_leases.remove(req)
                 if not req.fut.done():
-                    req.fut.set_result({"spillback": target})
+                    req.fut.set_result(self._spill_reply(target))
 
-    def _on_view_delta(self, msg: dict) -> None:
-        """One versioned cluster-view delta from the GCS (syncer push). In
-        sequence -> apply; any gap (drop under pubsub backpressure, missed
-        while reconnecting) -> full resync."""
+    @staticmethod
+    def _addr_key(addr) -> str:
+        return f"{addr[0]}:{addr[1]}"
+
+    def _on_view_head(self, msg: dict) -> None:
+        """One scheduling-head broadcast from the GCS: {"v", "epoch", "n",
+        "head"} where ``head`` is the head-cap least-utilized alive nodes in
+        utilization order. State-based, not delta-based — each message
+        replaces the previous head wholesale, so there is no sequence to
+        gap-detect and a dropped broadcast only costs freshness until the
+        next one. O(head cap) per flush regardless of cluster size."""
         v = msg.get("v", -1)
-        if self._view_version >= 0 and v == self._view_version + 1:
-            node = msg["node"]
-            if node.get("state") == "ALIVE":
-                self._view_map[node["node_id"]] = node
-            else:
-                self._view_map.pop(node["node_id"], None)
-            self._view_version = v
-            self._view = list(self._view_map.values())
-            self._view_time = time.monotonic()
+        if v <= self._head_version:
+            return  # stale replay / out-of-order
+        head = msg.get("head")
+        if head is None:
             return
-        if v <= self._view_version:
-            return  # stale replay
-        # Gap: resync from a snapshot.
-        if self._view_fetch is None:
-            self._view_fetch = rpc.spawn(self._fetch_view())
+        self._head_version = v
+        self._head = head
+        self._head_n = msg.get("n", len(head))
+        self._head_epoch = msg.get("epoch", -1)
+        self._head_addr_map = None  # rebuilt lazily (locality path only)
+
+    def _head_by_addr(self, key: str) -> Optional[dict]:
+        m = self._head_addr_map
+        if m is None:
+            m = self._head_addr_map = {
+                self._addr_key(n["addr"]): n for n in self._head
+            }
+        return m.get(key)
 
     async def _cluster_view(self) -> list:
-        """Delta-synced GCS node view (reference ray_syncer design): the
-        subscription keeps it current without polling; until the first
-        snapshot lands (or after a sync gap) fall back to a shared fetch —
-        a burst of policy decisions must wait for the view, not act on a
-        stale/empty one."""
-        if self._view_version >= 0:
-            return self._view
+        """Full GCS node view for the cold paths (node affinity, label
+        pick, locality hints beyond the head, spillback fallback):
+        pull-based with a ~1s TTL, refetched immediately when the GCS shape
+        epoch moved past our snapshot (membership/total change — a ring or
+        affinity decision must not run on departed-node data)."""
         now = time.monotonic()
-        if now - self._view_time > 1.0:
+        epoch_stale = (
+            self._head_epoch >= 0
+            and self._view_fetched_epoch != self._head_epoch
+        )
+        if now - self._view_time > 1.0 or epoch_stale:
             if self._view_fetch is None:
                 self._view_fetch = rpc.spawn(self._fetch_view())
-            fetch = self._view_fetch
             # CancelledError propagates (handler cancellation must win);
             # fetch errors leave the stale view in place.
-            await asyncio.shield(fetch)
+            await asyncio.shield(self._view_fetch)
         return self._view
 
     async def _fetch_view(self) -> None:
@@ -1251,10 +1392,12 @@ class Raylet:
             reply = await self.gcs.call("GetAllNodes")
             alive = [n for n in reply["nodes"] if n["state"] == "ALIVE"]
             self._view = alive
+            self._view_map = {n["node_id"]: n for n in alive}
+            self._view_addr = {
+                self._addr_key(n["addr"]): n["node_id"] for n in alive
+            }
             self._view_time = time.monotonic()
-            if "v" in reply:
-                self._view_map = {n["node_id"]: n for n in alive}
-                self._view_version = reply["v"]
+            self._view_fetched_epoch = reply.get("epoch", -1)
         except rpc.RpcError:
             pass
         finally:
@@ -1265,6 +1408,23 @@ class Raylet:
             if n["node_id"] == node_id:
                 return {"node_id": node_id, "addr": n["addr"]}
         return None
+
+    @staticmethod
+    def _node_total_rs(node: dict) -> ResourceSet:
+        """Lazily parsed ResourceSet for a view node's totals, cached on
+        the node dict (which is replaced wholesale on every delta, so the
+        cache invalidates for free)."""
+        rs = node.get("_total_rs")
+        if rs is None:
+            rs = node["_total_rs"] = ResourceSet.from_units(node["total"])
+        return rs
+
+    @staticmethod
+    def _node_avail_rs(node: dict) -> ResourceSet:
+        rs = node.get("_avail_rs")
+        if rs is None:
+            rs = node["_avail_rs"] = ResourceSet.from_units(node["available"])
+        return rs
 
     @staticmethod
     def _node_util(total: Dict[str, int], available: Dict[str, int]) -> float:
@@ -1286,6 +1446,10 @@ class Raylet:
         feasible nodes (randomization spreads herds of simultaneous
         schedulers). SPREAD: always place on the least-loaded feasible node,
         round-robin-ish via the same top-k randomization.
+
+        Per-lease work is O(k), not O(nodes): candidates come from the
+        GCS-sorted scheduling head the syncer broadcasts, and the SPREAD
+        ring is cached per (shape epoch, demand shape).
         """
         import random
 
@@ -1294,13 +1458,22 @@ class Raylet:
         if spread:
             # SPREAD: rotate over every node whose TOTAL fits the demand
             # (a lagging availability view must not collapse the rotation
-            # onto one node).
-            ring = [
-                n
-                for n in await self._cluster_view()
-                if demand.is_subset_of(ResourceSet.from_units(n["total"]))
-            ]
-            ring.sort(key=lambda n: n["node_id"])
+            # onto one node). Ring membership only changes with cluster
+            # membership/capacity, so the full-view scan is paid per shape
+            # epoch, not per lease.
+            key = tuple(sorted(demand.to_units().items()))
+            cached = self._spread_ring
+            epoch = self._head_epoch
+            if cached is not None and cached[0] == epoch and cached[1] == key:
+                ring = cached[2]
+            else:
+                ring = [
+                    n
+                    for n in await self._cluster_view()
+                    if demand.is_subset_of(self._node_total_rs(n))
+                ]
+                ring.sort(key=lambda n: n["node_id"])
+                self._spread_ring = (epoch, key, ring)
             if not ring:
                 return None
             pick = ring[self._spread_rr % len(ring)]
@@ -1310,28 +1483,76 @@ class Raylet:
             return {"node_id": pick["node_id"], "addr": pick["addr"]}
         if local_fits and self._local_util() <= config.scheduler_spread_threshold:
             return None
+        # Walk the GCS-sorted head ascending and stop after k feasible
+        # candidates — the k least-utilized nodes that can run the demand
+        # right now. Cold start (no broadcast yet): sort the pulled view.
+        head = self._head
+        n_alive = self._head_n
+        if not head:
+            head = sorted(
+                await self._cluster_view(),
+                key=lambda n: self._node_util(n["total"], n["available"]),
+            )
+            n_alive = len(head)
+        k = max(1, int(n_alive * config.scheduler_top_k_fraction))
         cands = []
-        for n in await self._cluster_view():
+        for n in head:
             if n["node_id"] == self.node_id:
                 continue
-            if demand.is_subset_of(ResourceSet.from_units(n["available"])):
-                cands.append(n)
+            if demand.is_subset_of(self._node_avail_rs(n)):
+                cands.append(
+                    (
+                        n.get("util", self._node_util(n["total"], n["available"])),
+                        n,
+                    )
+                )
+                if len(cands) >= k:
+                    break
         if not cands:
             return None
         below = [
-            n
-            for n in cands
-            if self._node_util(n["total"], n["available"])
-            < config.scheduler_spread_threshold
+            c for c in cands if c[0] < config.scheduler_spread_threshold
         ]
         pool = below or cands
-        pool.sort(key=lambda n: self._node_util(n["total"], n["available"]))
-        k = max(1, int(len(pool) * config.scheduler_top_k_fraction))
-        pick = random.choice(pool[:k])
-        pick_util = self._node_util(pick["total"], pick["available"])
+        pick_util, pick = random.choice(pool)
         if local_fits and self._local_util() <= pick_util:
             return None  # we're no worse than the best remote; stay local
         return {"node_id": pick["node_id"], "addr": pick["addr"]}
+
+    def _locality_pick(self, demand: ResourceSet, hints: Dict[str, float]):
+        """Locality-aware placement: among the nodes named by the task's arg
+        locations (addr-keyed weights from the owner), pick the
+        heaviest-weighted one that can run the demand RIGHT NOW — requiring
+        current availability keeps a saturated arg holder from queueing the
+        lease behind its backlog. Returns the pick ({"node_id", "addr"};
+        node_id == ours means stay local) or None when no hinted node is
+        feasible (a locality miss; the regular policy decides)."""
+        local_w = -1.0
+        self_key = self._addr_key(self.server.address)
+        if self_key in hints and demand.is_subset_of(self.available):
+            local_w = hints[self_key]
+        best_n = None
+        best_w = -1.0
+        for key, w in hints.items():
+            if key == self_key:
+                continue
+            # Head entries carry fresher availability than the TTL'd view —
+            # overlay them over the pulled snapshot.
+            n = self._head_by_addr(key)
+            if n is None:
+                nid = self._view_addr.get(key)
+                n = self._view_map.get(nid) if nid is not None else None
+            if n is None or not demand.is_subset_of(self._node_avail_rs(n)):
+                continue
+            if w > best_w:
+                best_n, best_w = n, w
+        if local_w >= best_w and local_w >= 0:
+            # Ties prefer local: the bytes are already here and the grant
+            # skips a spillback hop.
+            return {"node_id": self.node_id, "addr": list(self.server.address)}
+        if best_n is not None:
+            return {"node_id": best_n["node_id"], "addr": best_n["addr"]}
+        return None
 
     async def _label_pick(self, demand: ResourceSet, labels: dict):
         """NODE_LABEL policy: hard-eligible nodes, soft-matching preferred,
@@ -1578,6 +1799,7 @@ class Raylet:
         self.leases[req.lease_id] = handle
         self._tel_refresh_gauges()
         if not req.fut.done():
+            self._tel_grant_latency.observe(time.monotonic() - req.queued_at)
             req.fut.set_result(self._grant_reply(handle, req.lease_id))
         else:  # caller gave up; return resources
             self._release_lease(req.lease_id, dirty=False)
@@ -1650,15 +1872,39 @@ class Raylet:
         return {"ok": True}
 
     async def _find_spillback_node(self, demand: ResourceSet):
-        try:
-            reply = await self.gcs.call("GetAllNodes")
-        except rpc.RpcError:
-            return None
-        for n in reply["nodes"]:
-            if n["state"] != "ALIVE" or n["node_id"] == self.node_id:
+        """Least-utilized peer whose TOTAL fits the demand, preferring one
+        whose current availability fits. Served from the GCS-sorted
+        scheduling head — the old implementation issued a GetAllNodes RPC
+        and scanned every node per lease, which melts at hundreds of nodes.
+        Only when nothing in the head fits (a demand shape the least-loaded
+        nodes can't hold, e.g. a TPU lease amid idle CPU hosts) does it walk
+        the full TTL'd view."""
+        fallback = None
+        for n in self._head:
+            if n["node_id"] == self.node_id:
                 continue
-            if demand.is_subset_of(ResourceSet.from_units(n["total"])):
+            if not demand.is_subset_of(self._node_total_rs(n)):
+                continue
+            if demand.is_subset_of(self._node_avail_rs(n)):
                 return {"node_id": n["node_id"], "addr": n["addr"]}
+            if fallback is None:
+                fallback = {"node_id": n["node_id"], "addr": n["addr"]}
+        if fallback is not None:
+            return fallback
+        best = None
+        best_util = 2.0
+        for n in await self._cluster_view():
+            if n["node_id"] == self.node_id:
+                continue
+            if not demand.is_subset_of(self._node_total_rs(n)):
+                continue
+            util = self._node_util(n["total"], n["available"])
+            if demand.is_subset_of(self._node_avail_rs(n)):
+                util -= 1.0  # available-now beats merely total-feasible
+            if util < best_util:
+                best, best_util = n, util
+        if best is not None:
+            return {"node_id": best["node_id"], "addr": best["addr"]}
         return None
 
     async def _lease_worker_for_actor(self, conn, p):

@@ -265,6 +265,16 @@ def get_send_interceptor() -> Optional[Callable[["Connection", list], bool]]:
     return _send_interceptor
 
 
+def pack_push(method: str, payload: Any = None) -> Optional[bytes]:
+    """Pre-pack a one-way frame for fan-out via
+    ``Connection.push_packed_nowait``. Returns None while a fault
+    interceptor is installed: pre-packed bytes would bypass it, and a chaos
+    schedule must see (and be able to drop/delay) every individual frame."""
+    if _send_interceptor is not None:
+        return None
+    return _packb([0, _KIND_PUSH, method, payload])
+
+
 # Sentinel error string delivered to call_cb callbacks on connection loss
 # (distinguishes transport death from a handler-level error reply).
 _CONNECTION_LOST = "__connection_lost__"
@@ -748,6 +758,20 @@ class Connection:
     def push_nowait(self, method: str, payload: Any = None) -> None:
         """One-way message; no reply expected. Loop thread only."""
         self._send_nowait([0, _KIND_PUSH, method, payload])
+
+    def push_packed_nowait(self, packed: bytes) -> None:
+        """Write a frame pre-packed by ``pack_push`` — the broadcast fan-out
+        hot path: the publisher packs once and hands every subscriber the
+        same bytes instead of paying one msgpack encode per subscriber.
+        Loop thread only."""
+        if self._closed:
+            raise ConnectionLost("connection closed")
+        _TEL_FRAMES_OUT[_KIND_PUSH].inc()
+        _TEL_BYTES_OUT[_KIND_PUSH].inc(len(packed))
+        self._out.append(packed)
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            self._loop.call_soon(self._flush)
 
     async def push(self, method: str, payload: Any = None) -> None:
         self._send_nowait([0, _KIND_PUSH, method, payload])

@@ -153,7 +153,8 @@ SCHEMAS: Dict[str, WireSchema] = {
     # with the same lease_id mirrors the original grant outcome.
     "RequestWorkerLease": _s(
         ["lease_id", "resources"],
-        ["strategy", "pg_id", "bundle_index", "spilled_from", "job_id"],
+        ["strategy", "pg_id", "bundle_index", "spilled_from", "job_id",
+         "locality"],
         retry=RETRY_DEDUP,
         dedup_key="lease_id",
     ),

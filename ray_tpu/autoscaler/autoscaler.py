@@ -40,9 +40,18 @@ class Autoscaler:
     """Drive with repeated update() calls (or run() in a thread). Reads
     cluster state through the connected driver's state APIs."""
 
-    def __init__(self, provider, config: Optional[AutoscalerConfig] = None):
+    def __init__(
+        self,
+        provider,
+        config: Optional[AutoscalerConfig] = None,
+        state_fn=None,
+    ):
         self.provider = provider
         self.config = config or AutoscalerConfig()
+        # state_fn() -> per-node stats list (GetNodeStats shape). Default
+        # reads through the connected driver; the simulated-cluster harness
+        # injects its own collector since a SimCluster has no driver.
+        self._state_fn = state_fn
         self._tracked: Dict[str, _NodeTracker] = {}
         self._demand_since: Optional[float] = None
 
@@ -50,10 +59,12 @@ class Autoscaler:
 
     def _cluster_state(self) -> Tuple[int, List[dict]]:
         """-> (total pending leases, per-node stats)."""
-        from ray_tpu._private import worker as worker_mod
-        from ray_tpu.util.state.api import _each_raylet
+        if self._state_fn is not None:
+            stats = self._state_fn()
+        else:
+            from ray_tpu.util.state.api import _each_raylet
 
-        stats = _each_raylet({})
+            stats = _each_raylet({})
         pending = sum(s.get("pending_leases", 0) for s in stats)
         return pending, stats
 

@@ -65,13 +65,15 @@ class Scenario:
     name: str
     description: str
     specs: List[FaultSpec]
-    workload: str  # "tasks" | "transfer" | "serve"
+    workload: str  # "tasks" | "transfer" | "serve" | "sched"
     steps: int = 3
     nemesis: List[str] = field(default_factory=list)
     remote_node: bool = False  # add a {"victim": 2} node for cross-node work
     env: Dict[str, str] = field(default_factory=dict)
     # Re-add a victim node at the end of a seed run if nemesis removed one.
     repair: bool = False
+    # sched workload: size of the SimCluster (in-process raylets, no driver).
+    sim_nodes: int = 0
     # serve workload: per-request budget, and whether to tear down the
     # process-wide router between steps (it must rebuild from the controller).
     serve_timeout_s: float = 2.0
@@ -264,6 +266,19 @@ SCENARIOS: Dict[str, Scenario] = {
             repair=True,
             env=dict(_TRANSFER_ENV),
         ),
+        Scenario(
+            name="sched_storm",
+            description="120-node simulated cluster saturated with "
+            "concurrent lease bursts; raylets killed mid-spillback-chain, "
+            "clients re-anchor around the corpses, every surviving lease "
+            "ledger must balance exactly-once",
+            specs=[],
+            workload="sched",
+            steps=3,
+            nemesis=["kill_raylet", "kill_raylet"],
+            sim_nodes=120,
+            repair=True,
+        ),
     ]
 }
 
@@ -280,11 +295,14 @@ SUITES: Dict[str, List[str]] = {
     "serve": [
         "serve_replica_kill", "serve_deadline_storm", "serve_router_restart",
     ],
+    # Simulated-cluster scheduler scenarios: no driver, hundreds of
+    # in-process raylets (see _private/sim_cluster.py).
+    "sched": ["sched_storm"],
     "full": [
         "rpc_delay", "dup_lease", "chunk_loss", "reorder_push",
         "latency_storm", "latency_gcs_drop", "latency_gcs_restart",
         "serve_replica_kill", "serve_deadline_storm", "serve_router_restart",
-        "kill_worker", "gcs_restart", "kill_raylet",
+        "kill_worker", "gcs_restart", "kill_raylet", "sched_storm",
     ],
 }
 
@@ -663,8 +681,189 @@ def run_seed(session: _Session, scenario: Scenario, seed: int,
     )
 
 
+# -- simulated-cluster scheduler seeds ---------------------------------------
+
+# Lease cycles per step. With 120 4-CPU nodes and 2-CPU demands this holds
+# the fleet at ~85% utilization, so most requests funneled through the few
+# entry raylets must spill — kills then land mid-chain by construction.
+_SCHED_BURST = 200
+
+
+def run_sched_seed(cluster, client, scenario: Scenario, seed: int,
+                   verbose: bool = False) -> SeedResult:
+    """One seed of a ``sched`` scenario: saturating bursts of concurrent
+    lease cycles on a SimCluster while the nemesis kills raylets mid-
+    spillback-chain, then quiescence + the lease-exactly-once/ledger
+    invariants on the survivors. No driver, no workers — the control plane
+    under fire is the whole point."""
+    from ray_tpu._private import rpc
+    from ray_tpu._private import telemetry
+    from ray_tpu.chaos import invariants
+    from ray_tpu.chaos.nemesis import Nemesis
+
+    schedule = FaultSchedule(seed, scenario.specs)
+    plan = NemesisPlan(seed, scenario.nemesis, scenario.steps)
+    nemesis = Nemesis(cluster)
+    violations: List[str] = []
+    fired_all: List[str] = []
+
+    async def _reset():
+        # Same per-seed hygiene as run_seed: drained cluster, fresh deadline
+        # accounting and telemetry so check()/flight dumps see one seed only.
+        await invariants.quiesce(cluster, timeout=15.0)
+        rpc.deadline_stats.reset()
+        gcs = cluster.gcs_server
+        if gcs is not None:
+            gcs.worker_deadline_stats.update(met=0, shed=0, enforced=0)
+            gcs.worker_deadline_stats["overruns"].clear()
+            telemetry.reset_all()
+            gcs.telemetry = telemetry.new_aggregate()
+            gcs.flight_events.clear()
+
+    cluster.run(_reset(), timeout=30)
+
+    async def _sched_step(step: int, actions) -> None:
+        # Funnel every request through a handful of entry raylets: they
+        # saturate immediately, so the burst rides spillback chains across
+        # the fleet rather than granting at the front door.
+        entries = sorted(cluster.raylets)[: max(4, len(cluster.raylets) // 16)]
+        addrs = [tuple(cluster.raylets[nid].addr) for nid in entries]
+
+        async def one(i):
+            await client.lease_cycle(
+                {"CPU": 2.0},
+                entry_addr=addrs[(seed + i) % len(addrs)],
+                hold_s=0.02,
+            )
+
+        burst = asyncio.gather(
+            *(one(i) for i in range(_SCHED_BURST)), return_exceptions=True
+        )
+        await asyncio.sleep(0.05)  # let chains get in flight before killing
+        for action, pick in actions:
+            desc = await nemesis.fire(action, pick)
+            if desc:
+                fired_all.append(desc)
+                if verbose:
+                    print(f"      nemesis: {desc}")
+        results = await burst
+        errors = [r for r in results if isinstance(r, BaseException)]
+        if errors:
+            sample = "; ".join(f"{type(e).__name__}: {e}" for e in errors[:3])
+            violations.append(
+                f"workload: step {step}: {len(errors)}/{len(results)} lease "
+                f"cycles failed ({sample})"
+            )
+
+    try:
+        for step in range(scenario.steps):
+            cluster.run(_sched_step(step, plan.at_step(step)), timeout=180)
+    finally:
+        if scenario.repair:
+            # Autoscaler analog: restore the fleet to its nominal size so
+            # the next seed starts from the scenario's shape.
+            while len(cluster.raylets) < scenario.sim_nodes:
+                cluster.add_node()
+
+    async def _converge():
+        await invariants.quiesce(cluster, timeout=30.0)
+        return await invariants.check(cluster)
+
+    try:
+        violations.extend(str(v) for v in cluster.run(_converge(), timeout=60))
+    except Exception as e:
+        violations.append(f"convergence: {type(e).__name__}: {e}")
+
+    # Scheduler-specific exactly-once: every cycle released its grant (or
+    # the grant died with its raylet), so no survivor may still hold one.
+    for raylet in list(cluster.raylets.values()):
+        if raylet.leases:
+            violations.append(
+                f"lease-exactly-once: node {raylet.node_id[:8]} still holds "
+                f"{len(raylet.leases)} grant(s) after every cycle released"
+            )
+
+    # Probe: the surviving cluster still grants fresh leases.
+    async def _probe():
+        grant = await client.lease({"CPU": 1.0}, timeout=30.0)
+        await client.release(grant)
+
+    try:
+        cluster.run(_probe(), timeout=45)
+    except Exception as e:
+        violations.append(
+            f"probe: fresh lease failed: {type(e).__name__}: {e}"
+        )
+
+    dup_avoided = sum(
+        r.duplicate_lease_grants_avoided for r in cluster.raylets.values()
+    )
+    return SeedResult(
+        scenario=scenario.name,
+        seed=seed,
+        ok=not violations,
+        schedule_digest=schedule.digest(),
+        # No wire interceptor here — the fault log is the nemesis record.
+        fault_log_digest=hashlib.sha256(
+            "\n".join(fired_all).encode()
+        ).hexdigest(),
+        faults_fired=len(fired_all),
+        violations=violations,
+        duplicate_grants_avoided=dup_avoided,
+        deadline_shed=rpc.deadline_stats.shed,
+        deadline_enforced=rpc.deadline_stats.enforced,
+    )
+
+
+def _run_sched_scenario(scenario: Scenario, seeds: List[int],
+                        corpus: Optional[str],
+                        verbose: bool = False) -> List[SeedResult]:
+    """Seed loop for ``sched`` scenarios: a SimCluster instead of a driver
+    session, reused across seeds, rebuilt after any failing seed."""
+    from ray_tpu._private.sim_cluster import SimCluster, SimLeaseClient
+
+    def _boot():
+        cluster = SimCluster(
+            scenario.sim_nodes, env=dict(scenario.env)
+        ).start()
+        return cluster, SimLeaseClient(cluster)
+
+    def _teardown(cluster, client):
+        try:
+            cluster.run(client.close(), timeout=30)
+        except Exception:
+            pass
+        cluster.shutdown()
+
+    results: List[SeedResult] = []
+    cluster, client = _boot()
+    try:
+        for seed in seeds:
+            result = run_sched_seed(cluster, client, scenario, seed,
+                                    verbose=verbose)
+            results.append(result)
+            status = "ok" if result.ok else "FAIL"
+            print(
+                f"    seed {seed:>4} {status}  faults={result.faults_fired}"
+                f"  schedule={result.schedule_digest[:12]}"
+            )
+            if not result.ok:
+                for v in result.violations:
+                    print(f"      {v}")
+                if corpus:
+                    _append_corpus(corpus, result)
+                # One bad seed must not poison the next: fresh sim cluster.
+                _teardown(cluster, client)
+                cluster, client = _boot()
+    finally:
+        _teardown(cluster, client)
+    return results
+
+
 def run_scenario(scenario: Scenario, seeds: List[int], corpus: Optional[str],
                  verbose: bool = False) -> List[SeedResult]:
+    if scenario.workload == "sched":
+        return _run_sched_scenario(scenario, seeds, corpus, verbose=verbose)
     results: List[SeedResult] = []
     session = _Session(scenario)
     try:

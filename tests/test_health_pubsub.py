@@ -60,34 +60,38 @@ def test_wedged_node_detected_by_health_checks(monkeypatch):
 
 
 def test_versioned_view_sync(monkeypatch):
-    """Raylets converge on the cluster view via versioned deltas (no
-    polling): joins, resource updates, and deaths all bump the version and
-    land in every raylet's local map (reference: ray_syncer.h streams)."""
+    """Raylets converge on the scheduling head via versioned broadcasts (no
+    polling): joins, resource updates, and deaths all bump the version, and
+    membership changes bump the shape epoch (reference: ray_syncer.h
+    streams, inverted — the GCS sorts, subscribers receive the head)."""
     cluster = Cluster(head_node_args={"num_cpus": 1, "num_tpus": 0})
     head_raylet = cluster.head_node.raylet
+
+    def head_ids():
+        return {n["node_id"] for n in head_raylet._head}
+
     cluster.connect()
     try:
         n2 = cluster.add_node(num_cpus=2)
         deadline = time.monotonic() + 15
         while time.monotonic() < deadline:
-            if (
-                head_raylet._view_version >= 0
-                and n2.node_id in head_raylet._view_map
-            ):
+            if head_raylet._head_version >= 0 and n2.node_id in head_ids():
                 break
             time.sleep(0.1)
-        assert n2.node_id in head_raylet._view_map, "join delta never arrived"
-        v_after_join = head_raylet._view_version
+        assert n2.node_id in head_ids(), "join broadcast never arrived"
+        v_after_join = head_raylet._head_version
+        epoch_after_join = head_raylet._head_epoch
         assert v_after_join >= 0
 
         cluster.remove_node(n2)
         deadline = time.monotonic() + 15
         while time.monotonic() < deadline:
-            if n2.node_id not in head_raylet._view_map:
+            if n2.node_id not in head_ids():
                 break
             time.sleep(0.1)
-        assert n2.node_id not in head_raylet._view_map, "death delta never arrived"
-        assert head_raylet._view_version > v_after_join
+        assert n2.node_id not in head_ids(), "death broadcast never arrived"
+        assert head_raylet._head_version > v_after_join
+        assert head_raylet._head_epoch > epoch_after_join
     finally:
         cluster.shutdown()
 

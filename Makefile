@@ -66,6 +66,7 @@ perf:
 SEEDS ?= 20
 LATENCY_SEEDS ?= 10
 SCHED_SEEDS ?= 10
+RECOVERY_SEEDS ?= 10
 chaos:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m ray_tpu.chaos --check-determinism \
 		--suite full --seeds $(SEEDS)
@@ -75,3 +76,5 @@ chaos:
 		--seeds $(LATENCY_SEEDS)
 	env JAX_PLATFORMS=cpu $(PYTHON) -m ray_tpu.chaos --suite sched \
 		--seeds $(SCHED_SEEDS)
+	env JAX_PLATFORMS=cpu $(PYTHON) -m ray_tpu.chaos \
+		--suite recovery_durable --seeds $(RECOVERY_SEEDS)

@@ -184,6 +184,22 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     # (WAL write-through of few-hundred-byte records); disable for pure
     # in-memory control planes.
     "gcs_persistence": True,
+    # Which durable store backs the GCS when persistence is on
+    # (gcs_store.py): "wal" — append-only CRC-framed log with group commit
+    # (one fsync per loop tick of mutations) and snapshot compaction;
+    # "sqlite" — write-through WAL-mode sqlite rows; "memory" — no
+    # durability even with a persist path (testing).
+    "gcs_persist_backend": "wal",
+    # Durability/sync policy for the durable backends
+    # (docs/fault_tolerance.md): "batch" — group-commit fsync per loop tick
+    # (wal) / sqlite synchronous=NORMAL (an OS crash can lose the last
+    # tick / the commits since the last WAL checkpoint; a process crash
+    # loses nothing); "always" — fsync per record (wal) / synchronous=FULL;
+    # "off" — never fsync (page cache only).
+    "gcs_store_sync": "batch",
+    # WAL log-size threshold that triggers snapshot compaction (the full
+    # table state is rewritten as one frame and the log truncated).
+    "gcs_wal_compact_bytes": 4 * 1024 * 1024,
     # Echo captured worker stdout/stderr to the driver (reference:
     # ray.init(log_to_driver=True) + log_monitor.py streaming).
     "log_to_driver": True,

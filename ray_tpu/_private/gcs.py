@@ -33,6 +33,14 @@ from ray_tpu._private.common import PlacementGroupSpec, ResourceSet, config
 
 logger = logging.getLogger(__name__)
 
+# Subscriber-side gap detection (GcsClient): counted in the raylet/driver
+# process that noticed the gap and flushed with its telemetry.
+_TEL_SUB_GAP = telemetry.counter(
+    "gcs_client",
+    "pubsub_gap_snapshots",
+    "pubsub seq gaps detected by a subscriber (each pulls a snapshot)",
+)
+
 # Actor FSM states (reference: gcs_actor_manager.cc). The legal transitions
 # are declared machine-readably in ray_tpu/devtools/protocols.py and every
 # assignment is checked against them at lint time.
@@ -233,6 +241,10 @@ class GcsServer:
         # them would persist bogus node-death state (actors marked
         # RESTARTING/DEAD) that a restarted GCS then faithfully reloads.
         self._stopping = False
+        # Actors reloaded as ALIVE whose hosting raylet has not yet
+        # re-registered and confirmed them (RegisterNode "actors" report).
+        # Whatever remains when the reconcile sweep runs gets probed.
+        self._restored_unconfirmed: Set[str] = set()
         # Persistence (reference: StoreClient, store_client.h:33). The live
         # state above stays the source of truth; mutations write through to
         # the store, and a restarted GCS reloads it (GCS fault tolerance).
@@ -304,7 +316,17 @@ class GcsServer:
             actor.death_cause = rec.get("death_cause")
             self.actors[actor_id] = actor
             if actor.state in (PENDING_CREATION, RESTARTING):
+                # Reconciliation: the creation was in flight when the GCS
+                # died. Any lease it held lives (or died) with its raylet,
+                # which will cancel/re-grant on re-registration — re-drive
+                # the placement from a clean slate rather than trusting a
+                # half-recorded grant.
+                actor.addr = None
+                actor.worker_id = None
+                actor.node_id = None
                 self._pending_actor_queue.append(actor_id)
+            elif actor.state == ALIVE:
+                self._restored_unconfirmed.add(actor_id)
         for pg_id, blob in self.store.get_all("pgs").items():
             rec = msgpack.unpackb(blob, raw=False)
             pg = PlacementGroupInfo(PlacementGroupSpec.from_wire(rec["spec"]))
@@ -331,6 +353,8 @@ class GcsServer:
                 self._spawn(self._schedule_pg(pg))
         if any(a.state == ALIVE for a in self.actors.values()):
             self._spawn(self._reconcile_restored_actors())
+        if any(g.state == PG_CREATED for g in self.placement_groups.values()):
+            self._spawn(self._reconcile_restored_pgs())
         logger.info("gcs listening on %s:%s", *addr)
         return addr
 
@@ -389,10 +413,19 @@ class GcsServer:
     async def _reconcile_restored_actors(self) -> None:
         """Post-restart sweep: an actor restored as ALIVE whose node never
         re-registered (or whose worker died during the outage) is treated as
-        a worker death, driving the normal restart/fail FSM."""
+        a worker death, driving the normal restart/fail FSM. Actors already
+        confirmed by their raylet's re-registration report (the "actors"
+        field on RegisterNode) are skipped — at hundreds of nodes the
+        confirmations shrink the probe storm to just the genuinely
+        uncertain residue."""
         await asyncio.sleep(config.health_check_initial_delay_s)
-        for actor in list(self.actors.values()):
-            if actor.state != ALIVE:
+        unconfirmed, self._restored_unconfirmed = (
+            self._restored_unconfirmed,
+            set(),
+        )
+        for actor_id in unconfirmed:
+            actor = self.actors.get(actor_id)
+            if actor is None or actor.state != ALIVE:
                 continue
             node = self.nodes.get(actor.node_id) if actor.node_id else None
             dead = node is None or node.state != NODE_ALIVE
@@ -412,6 +445,28 @@ class GcsServer:
                     actor, "node or worker lost while GCS was down"
                 )
 
+    async def _reconcile_restored_pgs(self) -> None:
+        """The PG analog: a group restored as CREATED whose bundle nodes
+        never re-registered lost those reservations with the raylet — the
+        same CREATED -> RESCHEDULING transition a node death drives, then
+        the normal 2PC re-placement."""
+        await asyncio.sleep(config.health_check_initial_delay_s)
+        for pg in list(self.placement_groups.values()):
+            if pg.state != PG_CREATED:
+                continue
+            lost = any(
+                nid
+                and (
+                    nid not in self.nodes
+                    or self.nodes[nid].state != NODE_ALIVE
+                )
+                for nid in pg.bundle_nodes
+            )
+            if lost:
+                pg.state = PG_RESCHEDULING
+                self._persist_pg(pg)
+                self._spawn(self._schedule_pg(pg))
+
     async def stop(self) -> None:
         self._stopping = True
         if self._view_flush_handle is not None:
@@ -422,7 +477,27 @@ class GcsServer:
         for t in self._bg_tasks:
             t.cancel()
         await self.server.stop()
+        # Graceful shutdown owns the store handle: close() checkpoints the
+        # sqlite WAL / flushes+fsyncs the group-commit tail.
         self.store.close()
+
+    async def crash(self) -> None:
+        """Abrupt death (kill -9 analog, driven by the chaos ``crash_gcs``
+        nemesis): transports drop and the store sees ``crash()`` instead of
+        ``close()`` — no WAL checkpoint, no compaction, no final fsync —
+        so the on-disk state is exactly what a killed process leaves, and
+        recovery (torn-tail truncation + the reconcile sweeps) has to earn
+        the restart."""
+        self._stopping = True
+        if self._view_flush_handle is not None:
+            self._view_flush_handle.cancel()
+            self._view_flush_handle = None
+        if self._scheduler_task:
+            self._scheduler_task.cancel()
+        for t in self._bg_tasks:
+            t.cancel()
+        await self.server.stop()
+        self.store.crash()
 
     def _register_handlers(self) -> None:
         s = self.server
@@ -450,6 +525,7 @@ class GcsServer:
         s.register("Subscribe", self._subscribe)
         s.register("Unsubscribe", self._unsubscribe)
         s.register("Publish", self._publish)
+        s.register("Snapshot", self._snapshot)
         s.register("RegisterJob", self._register_job)
         s.register("JobFinished", self._job_finished)
         s.register("ListJobs", self._list_jobs)
@@ -521,6 +597,9 @@ class GcsServer:
         everything the hybrid top-k pick and spillback targeting consume,
         sized O(head cap) regardless of cluster size."""
         self.view_version += 1
+        self._publish_msg("syncer:nodes", self._view_head_msg())
+
+    def _view_head_msg(self) -> dict:
         head = []
         for util, nid in self._util_sorted:
             node = self.nodes.get(nid)
@@ -537,15 +616,12 @@ class GcsServer:
             )
             if len(head) >= self._VIEW_HEAD_CAP:
                 break
-        self._publish_msg(
-            "syncer:nodes",
-            {
-                "v": self.view_version,
-                "epoch": self.view_epoch,
-                "n": len(self._util_sorted),
-                "head": head,
-            },
-        )
+        return {
+            "v": self.view_version,
+            "epoch": self.view_epoch,
+            "n": len(self._util_sorted),
+            "head": head,
+        }
 
     async def _register_node(self, conn, p):
         info = NodeInfo(p["node_id"], p["addr"], p["resources"], p.get("labels"), conn)
@@ -557,6 +633,19 @@ class GcsServer:
             node_id=p["node_id"],
             resources=p["resources"],
         )
+        # Lease-picture rebuild after a GCS restart: the raylet reports the
+        # actor workers it is hosting, confirming restored-ALIVE actors
+        # without the reconcile sweep having to probe each one (reference:
+        # NotifyGCSRestart — raylets own the ground truth about workers).
+        for rec in p.get("actors") or []:
+            actor = self.actors.get(rec.get("actor_id") or "")
+            if (
+                actor is not None
+                and actor.state == ALIVE
+                and actor.node_id == p["node_id"]
+                and actor.worker_id == rec.get("worker_id")
+            ):
+                self._restored_unconfirmed.discard(actor.actor_id)
         self._publish_msg("nodes", {"event": "added", "node": info.to_wire()})
         self._bump_view(info, membership=True)
         self._wake_scheduler.set()
@@ -1061,8 +1150,13 @@ class GcsServer:
     # -- pubsub -------------------------------------------------------------
 
     async def _subscribe(self, conn, p):
-        self.publisher.subscribe(p["channel"], conn)
-        return {"ok": True}
+        seq = self.publisher.subscribe(p["channel"], conn)
+        # The current channel seqno is the subscriber's gap-detection
+        # baseline: a resubscribing client compares it with the last seq it
+        # saw and pulls a snapshot if publishes happened in between. The
+        # epoch distinguishes "same publisher, you missed n messages" from
+        # "new publisher (GCS restart), seqs restarted — resync".
+        return {"ok": True, "seq": seq, "pub_epoch": self.publisher.epoch}
 
     async def _unsubscribe(self, conn, p):
         self.publisher.unsubscribe(p["channel"], conn)
@@ -1071,6 +1165,30 @@ class GcsServer:
     async def _publish(self, conn, p):
         self._publish_msg(p["channel"], p["msg"])
         return {"ok": True}
+
+    async def _snapshot(self, conn, p):
+        """Current state behind a pubsub channel, in the same shape a
+        publish on that channel carries — what a subscriber that detected
+        a seq gap (dropped backlog here, or a missed window across a
+        reconnect) pulls to resynchronize instead of trusting a stale
+        picture. Channels that carry events rather than state (e.g.
+        "nodes", "logs") have no snapshot and return None; their consumers
+        resync via their own full reads (GetAllNodes)."""
+        channel = p["channel"]
+        snap = None
+        if channel.startswith("actor:"):
+            actor = self.actors.get(channel[len("actor:"):])
+            snap = None if actor is None else actor.to_wire()
+        elif channel.startswith("pg:"):
+            pg = self.placement_groups.get(channel[len("pg:"):])
+            snap = None if pg is None else {"state": pg.state}
+        elif channel == "syncer:nodes":
+            snap = self._view_head_msg()
+        return {
+            "snapshot": snap,
+            "seq": self.publisher.seqnos.get(channel, 0),
+            "pub_epoch": self.publisher.epoch,
+        }
 
     def _publish_msg(self, channel: str, msg: Any) -> None:
         """Non-blocking fan-out: per-subscriber bounded queues + dedicated
@@ -1392,6 +1510,11 @@ class GcsClient:
         self._sub_handlers: Dict[str, List] = {}
         self._handlers = conn._handlers
         self._handlers.setdefault("Pub", self._on_pub)
+        self._handlers.setdefault("PubBatch", self._on_pub_batch)
+        # Per-channel last-seen publish seqno + publisher epoch (gap
+        # detection; see Publisher docstring and docs/fault_tolerance.md).
+        self._sub_seq: Dict[str, int] = {}
+        self._sub_epoch: Dict[str, str] = {}
         self._on_reconnect: List = []
         self._rc = rpc.RetryableConnection(
             self._redial,
@@ -1433,8 +1556,9 @@ class GcsClient:
         # self.conn must point at the fresh link before the callbacks run:
         # they issue calls through this client (raylet re-registration).
         self.conn = conn
-        for channel in self._sub_handlers:
-            await conn.call("Subscribe", {"channel": channel})
+        for channel in list(self._sub_handlers):
+            reply = await conn.call("Subscribe", {"channel": channel})
+            self._check_resubscribe(channel, reply)
         for fn in self._on_reconnect:
             try:
                 await fn(self)
@@ -1444,22 +1568,109 @@ class GcsClient:
         if addr is not None:
             logger.info("reconnected to gcs at %s:%s", *addr)
 
+    def _check_resubscribe(self, channel: str, reply: dict) -> None:
+        """Compare the resubscribe baseline with the last seq we saw: an
+        advanced seq (missed publishes while disconnected) or a changed
+        publisher epoch (GCS restart — seqs restarted from zero) both mean
+        our picture may be stale, so pull a snapshot."""
+        seq, epoch = reply.get("seq"), reply.get("pub_epoch")
+        if seq is None:
+            return
+        last = self._sub_seq.get(channel)
+        stale = last is not None and (
+            self._sub_epoch.get(channel) != epoch or seq > last
+        )
+        self._sub_seq[channel] = seq
+        if epoch is not None:
+            self._sub_epoch[channel] = epoch
+        if stale:
+            self._note_gap(channel, "resubscribe")
+
     async def _ensure_connected(self) -> rpc.Connection:
         return await self._rc._ensure_connected()
 
     async def _on_pub(self, conn, p):
-        for fn in self._sub_handlers.get(p["channel"], []):
+        await self._dispatch_pub(p["channel"], p["msg"], p.get("seq"))
+
+    async def _on_pub_batch(self, conn, p):
+        for channel, msg, seq in p["items"]:
+            await self._dispatch_pub(channel, msg, seq)
+
+    async def _dispatch_pub(self, channel: str, msg, seq) -> None:
+        if seq is not None:
+            last = self._sub_seq.get(channel)
+            if last is not None:
+                if seq <= last:
+                    return  # duplicate / already covered by a snapshot
+                if seq > last + 1:
+                    # The publisher shed part of OUR backlog (bounded-queue
+                    # overflow): the stream is no longer a complete history,
+                    # so resynchronize from a snapshot.
+                    self._note_gap(channel, "overflow")
+            self._sub_seq[channel] = seq
+        await self._deliver(channel, msg)
+
+    async def _deliver(self, channel: str, msg) -> None:
+        for fn in list(self._sub_handlers.get(channel, [])):
             try:
-                res = fn(p["msg"])
+                res = fn(msg)
                 if asyncio.iscoroutine(res):
                     await res
             except Exception:
-                logger.exception("pubsub handler failed for %s", p["channel"])
+                logger.exception("pubsub handler failed for %s", channel)
 
-    async def subscribe(self, channel: str, handler) -> None:
+    def _note_gap(self, channel: str, cause: str) -> None:
+        _TEL_SUB_GAP.cell(cause=cause).inc()
+        logger.info("pubsub gap on %r (%s): pulling snapshot", channel, cause)
+        rpc.spawn(self._pull_snapshot(channel))
+
+    async def _pull_snapshot(self, channel: str) -> None:
+        """Resync one channel: fetch the current state behind it and feed
+        it to the handlers as if published. Channels without snapshot
+        semantics return None (their consumers resync elsewhere)."""
+        try:
+            reply = await self.call("Snapshot", {"channel": channel})
+        except (rpc.RpcError, asyncio.TimeoutError, OSError):
+            logger.warning("snapshot pull for %r failed", channel)
+            return
+        seq, epoch = reply.get("seq"), reply.get("pub_epoch")
+        if seq is not None and seq > self._sub_seq.get(channel, -1):
+            self._sub_seq[channel] = seq
+        if epoch is not None:
+            self._sub_epoch[channel] = epoch
+        snap = reply.get("snapshot")
+        if snap is not None:
+            await self._deliver(channel, snap)
+
+    async def subscribe(self, channel: str, handler, snapshot: bool = False) -> None:
+        """Attach a handler. ``snapshot=True`` additionally delivers the
+        channel's current state to THIS handler right after subscribing,
+        closing the subscribe-after-publish race (the watcher that arrives
+        late still observes the state it missed) — the general form of the
+        one-shot GetActor the serve controller's death watch used to do."""
+        fresh = channel not in self._sub_handlers
         self._sub_handlers.setdefault(channel, []).append(handler)
         conn = await self._ensure_connected()
-        await conn.call("Subscribe", {"channel": channel})
+        reply = await conn.call("Subscribe", {"channel": channel})
+        seq, epoch = reply.get("seq"), reply.get("pub_epoch")
+        if fresh and seq is not None:
+            # Baseline only for a newly tracked channel: an existing
+            # tracking regime may have deliveries in flight whose seqs a
+            # forward jump here would wrongly mark as duplicates.
+            self._sub_seq[channel] = seq
+            if epoch is not None:
+                self._sub_epoch[channel] = epoch
+        if snapshot:
+            try:
+                snap = (await self.call("Snapshot", {"channel": channel}))[
+                    "snapshot"
+                ]
+            except (rpc.RpcError, asyncio.TimeoutError, OSError):
+                snap = None
+            if snap is not None:
+                res = handler(snap)
+                if asyncio.iscoroutine(res):
+                    await res
 
     async def unsubscribe(self, channel: str, handler) -> None:
         """Detach one handler; drops the server-side subscription (and the

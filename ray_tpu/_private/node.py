@@ -59,7 +59,8 @@ class Node:
         self.worker_env = worker_env
 
     def gcs_persist_path(self) -> str:
-        """Session-scoped sqlite file backing GCS fault tolerance."""
+        """Session-scoped store file backing GCS fault tolerance (WAL or
+        sqlite per the ``gcs_persist_backend`` knob; gcs_store.make_store)."""
         import tempfile
 
         return os.path.join(
@@ -104,6 +105,18 @@ class Node:
         """Fault-injection: stop the GCS process, keeping raylets/workers up."""
         assert self.gcs_server is not None
         await self.gcs_server.stop()
+
+    async def crash_gcs(self, torn_tail: bool = False) -> None:
+        """Fault-injection: hard-crash the GCS (kill -9 shaped) — no store
+        checkpoint, no final fsync, no graceful teardown of persistence.
+        ``torn_tail=True`` additionally appends a half-written record to the
+        WAL, simulating power loss mid-write; recovery must truncate it."""
+        assert self.gcs_server is not None
+        await self.gcs_server.crash()
+        if torn_tail and config.gcs_persistence:
+            from ray_tpu._private.gcs_store import inject_torn_tail
+
+            inject_torn_tail(self.gcs_persist_path())
 
     async def restart_gcs(self) -> None:
         """Restart the GCS on the same address from its persisted state.

@@ -7,13 +7,28 @@ blocks the publisher's event loop or other subscribers; once its queue fills,
 its OLDEST messages drop (counted) — matching the reference's
 ``publisher_entity_buffer`` overflow policy of shedding the backlog rather
 than the publisher.
+
+Two storm-hardening layers on top (docs/fault_tolerance.md "Resubscribe
+protocol"):
+
+- **Per-channel monotonic seqnos.** Every publish stamps the channel's next
+  seqno; ``subscribe`` reports the channel's current seqno. A client that
+  sees a seq jump (its queue overflowed here, or it missed publishes while
+  disconnected) KNOWS it lost messages and pulls a channel snapshot
+  (``Snapshot`` RPC) instead of acting on a stale picture — the general
+  form of the one-shot GetActor the serve controller used to do by hand.
+- **Per-tick batched fan-out.** Publishes from one event-loop tick coalesce
+  into one ``PubBatch`` frame per channel, packed once and enqueued to
+  every subscriber — a registration wave that publishes N membership events
+  to M subscribers costs O(M) frames per tick, not O(N*M).
 """
 
 from __future__ import annotations
 
+import asyncio
 import logging
 from collections import deque
-from typing import Any, Dict
+from typing import Any, Dict, List, Tuple
 
 from ray_tpu._private import rpc, telemetry
 from ray_tpu._private.common import config
@@ -32,25 +47,48 @@ _TEL_DROPPED = telemetry.counter(
 
 
 class _SubscriberState:
-    __slots__ = ("conn", "queue", "draining", "dropped")
+    __slots__ = ("conn", "queue", "queued_msgs", "max_msgs", "draining", "dropped")
 
-    def __init__(self, conn: rpc.Connection, maxlen: int):
+    def __init__(self, conn: rpc.Connection, max_msgs: int):
         self.conn = conn
-        self.queue: deque = deque(maxlen=maxlen)
+        # Entries are (frame, n_messages): the bound is on MESSAGES, not
+        # frames, so per-tick batching can't inflate a slow subscriber's
+        # backlog past the same budget the unbatched path had.
+        self.queue: deque = deque()
+        self.queued_msgs = 0
+        self.max_msgs = max_msgs
         self.draining = False
         self.dropped = 0
 
 
 class Publisher:
     def __init__(self) -> None:
+        # Instance identity: seqnos restart from 0 with a fresh Publisher
+        # (GCS restart), so subscribers must not compare seqs across
+        # publisher lifetimes. The epoch rides Subscribe/Snapshot replies;
+        # an epoch change tells the client "your last-seen seq means
+        # nothing — resync".
+        import uuid
+
+        self.epoch = uuid.uuid4().hex[:12]
         # channel -> {conn id -> state}
         self.channels: Dict[str, Dict[int, _SubscriberState]] = {}
+        # channel -> last published seqno (monotonic from 1; advances even
+        # with no subscribers so a later subscriber's baseline is honest).
+        self.seqnos: Dict[str, int] = {}
         self.total_dropped = 0
+        # Publishes buffered for the current loop tick (channel, msg, seq).
+        self._pending: List[Tuple[str, Any, int]] = []
+        self._flush_scheduled = False
 
-    def subscribe(self, channel: str, conn: rpc.Connection) -> None:
+    def subscribe(self, channel: str, conn: rpc.Connection) -> int:
+        """Attach; returns the channel's current seqno — the subscriber's
+        gap-detection baseline (everything at or before it predates the
+        subscription)."""
         self.channels.setdefault(channel, {})[id(conn)] = _SubscriberState(
             conn, max(1, config.pubsub_max_buffered_msgs)
         )
+        return self.seqnos.get(channel, 0)
 
     def remove_subscriber(self, conn: rpc.Connection) -> None:
         cid = id(conn)
@@ -66,47 +104,92 @@ class Publisher:
             del self.channels[channel]
 
     def publish(self, channel: str, msg: Any) -> None:
-        """Enqueue to every subscriber; returns immediately (never blocks the
-        caller on a slow subscriber's socket)."""
+        """Stamp the channel seqno and buffer for the per-tick flush;
+        returns immediately (never blocks the caller on a slow
+        subscriber's socket)."""
         _TEL_PUBLISHED.inc()
-        subs = self.channels.get(channel)
-        if not subs:
+        seq = self.seqnos.get(channel, 0) + 1
+        self.seqnos[channel] = seq
+        self._pending.append((channel, msg, seq))
+        if self._flush_scheduled:
             return
-        frame = {"channel": channel, "msg": msg}
-        # Pack once, write the same bytes to every subscriber (None while a
-        # chaos interceptor is installed -> per-subscriber packing below).
-        packed = rpc.pack_push("Pub", frame)
-        item = frame if packed is None else packed
-        for state in list(subs.values()):
-            if state.conn.closed:
-                subs.pop(id(state.conn), None)
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            self.flush()  # no loop (tests): deliver inline
+            return
+        self._flush_scheduled = True
+        loop.call_soon(self.flush)
+
+    def flush(self) -> None:
+        """Fan the tick's buffered publishes out: the tick's publishes on
+        one channel coalesce into PubBatch frames, each packed once and
+        enqueued to every subscriber. Frames are chunked below a
+        subscriber's whole message budget so the oldest-first eviction in
+        ``_enqueue`` stays meaningful."""
+        self._flush_scheduled = False
+        pending, self._pending = self._pending, []
+        if not pending:
+            return
+        by_channel: Dict[str, List[list]] = {}
+        for channel, msg, seq in pending:
+            by_channel.setdefault(channel, []).append([channel, msg, seq])
+        chunk = max(1, min(256, config.pubsub_max_buffered_msgs))
+        for channel, items in by_channel.items():
+            subs = self.channels.get(channel)
+            if not subs:
                 continue
-            _TEL_FANOUT.inc()
-            if len(state.queue) == state.queue.maxlen:
-                state.dropped += 1
-                self.total_dropped += 1
-                _TEL_DROPPED.inc()
-                if state.dropped in (1, 100, 10000):
-                    logger.warning(
-                        "pubsub subscriber %s slow on %r: %d messages dropped",
-                        state.conn.peername,
-                        channel,
-                        state.dropped,
-                    )
-            state.queue.append(item)
-            if not state.draining:
-                state.draining = True
-                rpc.spawn(self._drain(state))
+            for start in range(0, len(items), chunk):
+                part = items[start : start + chunk]
+                frame = {"items": part}
+                # Pack once, write the same bytes to every subscriber (None
+                # while a chaos interceptor is installed -> per-subscriber
+                # packing in _drain).
+                packed = rpc.pack_push("PubBatch", frame)
+                item = frame if packed is None else packed
+                for state in list(subs.values()):
+                    if state.conn.closed:
+                        subs.pop(id(state.conn), None)
+                        continue
+                    self._enqueue(state, channel, item, len(part))
+
+    def _enqueue(self, state: _SubscriberState, channel: str, item, n: int) -> None:
+        _TEL_FANOUT.inc(n)
+        evicted = 0
+        while state.queue and state.queued_msgs + n > state.max_msgs:
+            _, dn = state.queue.popleft()
+            state.queued_msgs -= dn
+            evicted += dn
+        if evicted:
+            state.dropped += evicted
+            self.total_dropped += evicted
+            _TEL_DROPPED.inc(evicted)
+            if state.dropped == evicted or (
+                state.dropped // 1000 != (state.dropped - evicted) // 1000
+            ):
+                logger.warning(
+                    "pubsub subscriber %s slow on %r: %d messages dropped"
+                    " (seq gap will trigger a snapshot pull)",
+                    state.conn.peername,
+                    channel,
+                    state.dropped,
+                )
+        state.queue.append((item, n))
+        state.queued_msgs += n
+        if not state.draining:
+            state.draining = True
+            rpc.spawn(self._drain(state))
 
     async def _drain(self, state: _SubscriberState) -> None:
         try:
             while state.queue:
-                item = state.queue.popleft()
+                item, n = state.queue.popleft()
+                state.queued_msgs -= n
                 try:
                     if isinstance(item, bytes):
                         state.conn.push_packed_nowait(item)
                     else:
-                        state.conn.push_nowait("Pub", item)
+                        state.conn.push_nowait("PubBatch", item)
                     # Backpressure on THIS subscriber's transport only.
                     await state.conn.drain()
                 except (rpc.ConnectionLost, rpc.RpcError):
@@ -124,7 +207,7 @@ class Publisher:
             "channels": {
                 ch: {
                     "subscribers": len(subs),
-                    "queued": sum(len(s.queue) for s in subs.values()),
+                    "queued": sum(s.queued_msgs for s in subs.values()),
                     "dropped": sum(s.dropped for s in subs.values()),
                 }
                 for ch, subs in self.channels.items()

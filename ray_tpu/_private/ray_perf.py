@@ -110,6 +110,96 @@ def _bench_sched() -> Dict[str, float]:
     }
 
 
+def _bench_gcs_persist() -> float:
+    """Write-through rate of the WAL store under group commit: each cycle
+    issues N keyed puts inside one event-loop context and then runs the
+    per-tick flush — one os.write + one fsync for the whole batch, the
+    shape every GCS control-plane mutation pays (docs/fault_tolerance.md
+    "Durability contract")."""
+    import os
+    import shutil
+    import tempfile
+
+    from ray_tpu._private.gcs_store import WalStoreClient
+
+    d = tempfile.mkdtemp(prefix="perf_wal_")
+    store = WalStoreClient(os.path.join(d, "gcs.wal"))
+    n = 2000
+    payload = b"v" * 256
+    seq = [0]
+
+    def cycle():
+        base = seq[0]
+        seq[0] += n
+
+        async def burst():
+            # Keyed overwrites: the table stays bounded, the log grows and
+            # periodically compacts — the steady-state GCS write pattern.
+            for i in range(n):
+                store.put("kv", f"k{(base + i) % 512}", payload)
+            store.flush()
+
+        asyncio.run(burst())
+
+    try:
+        rate = timeit("gcs persist puts (wal group commit)", cycle, n)
+    finally:
+        store.close()
+        shutil.rmtree(d, ignore_errors=True)
+    return rate
+
+
+def _bench_pubsub_fanout() -> float:
+    """Publisher fan-out with 1000 subscribers on one channel: each cycle
+    publishes a burst in one loop tick and waits until every subscriber's
+    drain task has pushed its PubBatch frames (packed once per chunk,
+    written to every transport). Measures deliveries (message x
+    subscriber) per second through the publisher machinery; transports are
+    no-op sinks so the number isolates the control-plane fan-out cost a
+    registration wave pays."""
+    from ray_tpu._private.pubsub import Publisher
+
+    n_subs = 1000
+    burst = 32
+
+    class _Sink:
+        closed = False
+        peername = "bench"
+
+        def push_packed_nowait(self, data):
+            pass
+
+        def push_nowait(self, kind, payload):
+            pass
+
+        async def drain(self):
+            pass
+
+    pub = Publisher()
+    for _ in range(n_subs):
+        pub.subscribe("bench", _Sink())
+
+    def cycle():
+        async def one_tick():
+            for i in range(burst):
+                pub.publish("bench", {"i": i})
+            await asyncio.sleep(0)  # run the scheduled flush
+            while any(
+                s.queued_msgs
+                for subs in pub.channels.values()
+                for s in subs.values()
+            ):
+                await asyncio.sleep(0)
+
+        asyncio.run(one_tick())
+
+    rate = timeit(
+        "pubsub fan-out (1000 subscribers)", cycle, burst * n_subs
+    )
+    assert pub.total_dropped == 0, pub.total_dropped
+    return rate
+
+
 def _bench_telemetry_overhead() -> float:
     """Nanoseconds per hot-path telemetry record (one bound counter inc +
     one histogram observe) — the price every instrumented site pays. Gated
@@ -325,6 +415,8 @@ def main(json_path: str = "") -> Dict[str, float]:
 
     results["transfer_16mb_per_s"] = _bench_transfer_16mb()
     results.update(_bench_sched())
+    results["gcs_persist_puts_per_s"] = _bench_gcs_persist()
+    results["pubsub_fanout_per_s"] = _bench_pubsub_fanout()
     results["telemetry_overhead_ns"] = _bench_telemetry_overhead()
     if json_path:
         with open(json_path, "w") as f:

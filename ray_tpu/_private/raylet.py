@@ -638,15 +638,23 @@ class Raylet:
             # (reference: raylet side of NotifyGCSRestart,
             # node_manager.proto:373): a restarted GCS has no node table
             # until every raylet re-announces itself.
-            await client.conn.call(
-                "RegisterNode",
-                {
-                    "node_id": self.node_id,
-                    "addr": list(self.addr),
-                    "resources": self.total.to_units(),
-                    "labels": self.labels,
-                },
-            )
+            payload = {
+                "node_id": self.node_id,
+                "addr": list(self.addr),
+                "resources": self.total.to_units(),
+                "labels": self.labels,
+            }
+            # Lease-picture rebuild: report the actor workers this node is
+            # hosting so a restarted GCS confirms its restored-ALIVE actors
+            # from re-registrations instead of probing each one.
+            actors = [
+                {"actor_id": h.actor_id, "worker_id": h.worker_id}
+                for h in self.workers.values()
+                if h.actor_id is not None
+            ]
+            if actors:
+                payload["actors"] = actors
+            await client.conn.call("RegisterNode", payload)
             # A restarted GCS numbers heads from zero: drop the stale head
             # and view so the next broadcast/pick resyncs from scratch.
             self._head_version = -1

@@ -95,10 +95,12 @@ class SimCluster:
         resources: Optional[Dict[str, float]] = None,
         object_store_memory: int = 1 << 20,
         env: Optional[Dict[str, str]] = None,
+        persist_path: Optional[str] = None,
     ):
         self.num_nodes = num_nodes
         self.resources = resources or {"CPU": 4.0}
         self.object_store_memory = object_store_memory
+        self.persist_path = persist_path
         self.session_name = f"sim-{fast_unique_hex()[:8]}"
         self.raylets: Dict[str, Raylet] = {}
         self.gcs_server: Optional[GcsServer] = None
@@ -139,10 +141,12 @@ class SimCluster:
         )
 
     async def _start_async(self) -> None:
-        # persist_path=None -> in-memory GCS store; sim sessions are
-        # throwaway and sqlite WAL churn at 1000 registrations is pure tax.
+        # persist_path=None (the default) -> in-memory GCS store; sim
+        # sessions are throwaway and store churn at 1000 registrations is
+        # pure tax. The chaos recovery scenarios pass a path so crash_gcs
+        # has durable state to recover from.
         self.gcs_server = GcsServer(
-            session_name=self.session_name, persist_path=None
+            session_name=self.session_name, persist_path=self.persist_path
         )
         self.gcs_addr = await self.gcs_server.start()
         sem = asyncio.Semaphore(_BOOT_CONCURRENCY)
@@ -177,6 +181,27 @@ class SimCluster:
         raylet = self.raylets.pop(node_id, None)
         if raylet is not None:
             self.run(raylet.stop(), timeout=60.0)
+
+    async def crash_gcs_async(self, torn_tail: bool = True) -> bool:
+        """Hard-crash the GCS (no store checkpoint/fsync, optionally a torn
+        WAL tail) and restart it on the same address from the persisted
+        state. Raylets re-register over their reconnect loops. Returns
+        False when the sim has no GCS (already shut down)."""
+        if self.gcs_server is None or self.gcs_addr is None:
+            return False
+        await self.gcs_server.crash()
+        if torn_tail and self.persist_path:
+            from ray_tpu._private.gcs_store import inject_torn_tail
+
+            inject_torn_tail(self.persist_path)
+        self.gcs_server = GcsServer(
+            host=self.gcs_addr[0],
+            port=self.gcs_addr[1],
+            session_name=self.session_name,
+            persist_path=self.persist_path,
+        )
+        await self.gcs_server.start()
+        return True
 
     def shutdown(self) -> None:
         if self._loop is None:

@@ -111,8 +111,11 @@ def _s(
 # upserts/reads by construction).
 SCHEMAS: Dict[str, WireSchema] = {
     # -- GCS control plane ---------------------------------------------------
+    # "actors" is the hosting report ([{actor_id, worker_id}]) a raylet
+    # attaches when re-registering with a restarted GCS: it confirms
+    # restored-ALIVE actors without a per-actor probe storm.
     "RegisterNode": _s(
-        ["node_id", "addr", "resources"], ["labels"], retry=RETRY_SAFE
+        ["node_id", "addr", "resources"], ["labels", "actors"], retry=RETRY_SAFE
     ),
     "UpdateResources": _s(
         ["node_id", "available"], ["total", "version"], retry=RETRY_SAFE
@@ -146,8 +149,15 @@ SCHEMAS: Dict[str, WireSchema] = {
     "Unsubscribe": _s(["channel"], retry=RETRY_SAFE),
     # Pubsub is at-least-once: a retried Publish may deliver twice.
     "Publish": _s(["channel", "msg"], retry=RETRY_SAFE),
-    # Server->client pubsub delivery push.
-    "Pub": _s(["channel", "msg"]),
+    # Server->client pubsub delivery push; "seq" is the channel's monotonic
+    # publish seqno (gap detection, pubsub.py).
+    "Pub": _s(["channel", "msg"], ["seq"]),
+    # Per-tick coalesced fan-out: one frame carries every publish on one
+    # channel from one flush tick as [channel, msg, seq] triples.
+    "PubBatch": _s(["items"]),
+    # Channel-state resync for a subscriber that detected a seq gap (its
+    # backlog was shed, or it missed a window across a reconnect).
+    "Snapshot": _s(["channel"], retry=RETRY_SAFE),
     # -- raylet scheduling ---------------------------------------------------
     # Deduped by the raylet's granted-lease ledger (PR 2): a retried frame
     # with the same lease_id mirrors the original grant outcome.

@@ -17,7 +17,9 @@ from typing import List, Optional
 
 logger = logging.getLogger(__name__)
 
-ACTIONS = ("kill_worker", "kill_replica", "kill_raylet", "restart_gcs")
+ACTIONS = (
+    "kill_worker", "kill_replica", "kill_raylet", "restart_gcs", "crash_gcs",
+)
 
 # Actor-name prefix of Serve replica workers (ReplicaID.to_actor_name).
 SERVE_REPLICA_PREFIX = "SERVE_REPLICA::"
@@ -34,6 +36,10 @@ class Nemesis:
         self.cluster = cluster
         self.protect_head = protect_head
         self.actions_fired: List[str] = []
+        # crash_gcs durability violations (acknowledged control-plane state
+        # missing after the crash-restart); the runner folds these into the
+        # seed's violation list.
+        self.state_loss: List[str] = []
 
     async def fire(self, action: str, pick: int) -> Optional[str]:
         """Run one action; returns a human-readable description (or None if
@@ -46,6 +52,8 @@ class Nemesis:
             return await self._kill_raylet(pick)
         if action == "restart_gcs":
             return await self._restart_gcs()
+        if action == "crash_gcs":
+            return await self._crash_gcs()
         raise ValueError(f"unknown nemesis action {action!r}")
 
     def _kill_worker(self, pick: int) -> Optional[str]:
@@ -138,3 +146,63 @@ class Nemesis:
         self.actions_fired.append("restart_gcs")
         logger.info("nemesis: restarted GCS")
         return "restart_gcs"
+
+    async def _crash_gcs(self) -> Optional[str]:
+        """Hard-crash the GCS — no store checkpoint, no final fsync, a torn
+        half-record on the WAL tail — then restart it and diff the restored
+        control-plane tables against the pre-crash picture. Every record
+        acknowledged before the crash must survive (group commit flushes to
+        the OS on crash; only an OS-level crash may lose the last tick)."""
+        gcs = self.cluster.gcs_server
+        if gcs is None:
+            return None
+        from ray_tpu._private.gcs_store import InMemoryStoreClient
+
+        durable = not isinstance(gcs.store, InMemoryStoreClient)
+        pre = {
+            "actors": set(gcs.actors),
+            "pgs": set(gcs.placement_groups),
+            "jobs": set(gcs.jobs),
+            "named": dict(gcs.named_actors),
+            "kv": dict(gcs.kv),
+        }
+        node = self.cluster.head_node
+        if node is not None and node.gcs_server is not None:
+            await node.crash_gcs(torn_tail=True)
+            await node.restart_gcs()
+            self.cluster.gcs_server = node.gcs_server
+        elif hasattr(self.cluster, "crash_gcs_async"):
+            # SimCluster shape: no Node wrapper, the sim owns its GCS.
+            if not await self.cluster.crash_gcs_async(torn_tail=True):
+                return None
+        else:
+            return None
+        if durable:
+            new = self.cluster.gcs_server
+            post = {
+                "actors": set(new.actors),
+                "pgs": set(new.placement_groups),
+                "jobs": set(new.jobs),
+            }
+            for table in ("actors", "pgs", "jobs"):
+                lost = pre[table] - post[table]
+                if lost:
+                    self.state_loss.append(
+                        f"state-loss: {len(lost)} {table} record(s) gone "
+                        f"after crash-restart (e.g. {sorted(lost)[:3]})"
+                    )
+            for (ns, name), aid in pre["named"].items():
+                if new.named_actors.get((ns, name)) != aid:
+                    self.state_loss.append(
+                        f"state-loss: named actor {ns}/{name} -> {aid[:8]} "
+                        "gone after crash-restart"
+                    )
+            for key, value in pre["kv"].items():
+                if new.kv.get(key) != value:
+                    self.state_loss.append(
+                        f"state-loss: kv {key} changed/gone after "
+                        "crash-restart"
+                    )
+        self.actions_fired.append("crash_gcs")
+        logger.info("nemesis: crashed GCS (torn WAL tail) and restarted")
+        return "crash_gcs"

@@ -74,6 +74,9 @@ class Scenario:
     repair: bool = False
     # sched workload: size of the SimCluster (in-process raylets, no driver).
     sim_nodes: int = 0
+    # sched workload: boot the SimCluster's GCS with a durable store (a
+    # session tempdir) so crash_gcs has acknowledged state to recover.
+    persist: bool = False
     # serve workload: per-request budget, and whether to tear down the
     # process-wide router between steps (it must rebuild from the controller).
     serve_timeout_s: float = 2.0
@@ -267,6 +270,31 @@ SCENARIOS: Dict[str, Scenario] = {
             env=dict(_TRANSFER_ENV),
         ),
         Scenario(
+            name="recovery_durable",
+            description="hard-crash the GCS (no checkpoint, torn WAL tail) "
+            "mid-workload; recovery truncates the torn frame, reloads every "
+            "acknowledged record losslessly, and reconciliation re-drives "
+            "in-flight creations",
+            specs=[],
+            workload="tasks",
+            steps=4,
+            nemesis=["crash_gcs"],
+            env=dict(_TASKS_ENV),
+        ),
+        Scenario(
+            name="recovery_durable_sim",
+            description="200-node simulated cluster: crash the persistent "
+            "GCS (torn WAL) under concurrent lease storms; restored state "
+            "must be lossless and the 200-raylet reconnect wave must "
+            "re-register without melting the control plane",
+            specs=[],
+            workload="sched",
+            steps=3,
+            nemesis=["crash_gcs"],
+            sim_nodes=200,
+            persist=True,
+        ),
+        Scenario(
             name="sched_storm",
             description="120-node simulated cluster saturated with "
             "concurrent lease bursts; raylets killed mid-spillback-chain, "
@@ -287,6 +315,9 @@ SUITES: Dict[str, List[str]] = {
     "smoke": ["rpc_delay", "dup_lease", "chunk_loss", "reorder_push"],
     # Process-level nemesis: heavier, run over fewer seeds.
     "recovery": ["kill_worker", "gcs_restart", "kill_raylet"],
+    # Crash-consistency: hard GCS crashes (torn WAL) with the no-state-loss
+    # invariant, on a driver cluster and a 200-node sim reconnect storm.
+    "recovery_durable": ["recovery_durable", "recovery_durable_sim"],
     # Delay/drop-heavy schedules exercising the RPC resilience layer
     # (retryable channels, deadline propagation, GCS failover queueing).
     "latency": ["latency_storm", "latency_gcs_drop", "latency_gcs_restart"],
@@ -303,6 +334,7 @@ SUITES: Dict[str, List[str]] = {
         "latency_storm", "latency_gcs_drop", "latency_gcs_restart",
         "serve_replica_kill", "serve_deadline_storm", "serve_router_restart",
         "kill_worker", "gcs_restart", "kill_raylet", "sched_storm",
+        "recovery_durable", "recovery_durable_sim",
     ],
 }
 
@@ -590,6 +622,10 @@ def run_seed(session: _Session, scenario: Scenario, seed: int,
     finally:
         session.run_async(_uninstall())
 
+    # crash_gcs durability diffs: acknowledged records missing after a
+    # crash-restart are violations, not workload noise.
+    violations.extend(nemesis.state_loss)
+
     # Belt and braces: if the in-step repair was skipped (nemesis found no
     # target), make sure the cluster shape is whole before quiescing.
     if scenario.repair:
@@ -765,6 +801,8 @@ def run_sched_seed(cluster, client, scenario: Scenario, seed: int,
             while len(cluster.raylets) < scenario.sim_nodes:
                 cluster.add_node()
 
+    violations.extend(nemesis.state_loss)
+
     async def _converge():
         await invariants.quiesce(cluster, timeout=30.0)
         return await invariants.check(cluster)
@@ -820,11 +858,20 @@ def _run_sched_scenario(scenario: Scenario, seeds: List[int],
                         verbose: bool = False) -> List[SeedResult]:
     """Seed loop for ``sched`` scenarios: a SimCluster instead of a driver
     session, reused across seeds, rebuilt after any failing seed."""
+    import shutil
+    import tempfile
+
     from ray_tpu._private.sim_cluster import SimCluster, SimLeaseClient
 
     def _boot():
+        persist_path = None
+        if scenario.persist:
+            persist_path = os.path.join(
+                tempfile.mkdtemp(prefix="chaos_gcs_"), "gcs.wal"
+            )
         cluster = SimCluster(
-            scenario.sim_nodes, env=dict(scenario.env)
+            scenario.sim_nodes, env=dict(scenario.env),
+            persist_path=persist_path,
         ).start()
         return cluster, SimLeaseClient(cluster)
 
@@ -834,6 +881,9 @@ def _run_sched_scenario(scenario: Scenario, seeds: List[int],
         except Exception:
             pass
         cluster.shutdown()
+        if cluster.persist_path:
+            shutil.rmtree(os.path.dirname(cluster.persist_path),
+                          ignore_errors=True)
 
     results: List[SeedResult] = []
     cluster, client = _boot()

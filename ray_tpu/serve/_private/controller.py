@@ -618,21 +618,16 @@ class ServeController:
 
         rec.death_watch = on_update
 
-        async def _arm() -> None:
-            await core.gcs.subscribe(f"actor:{rec.actor_id}", on_update)
-            # The actor may have died before the Subscribe landed and that
-            # publish is gone; read the state once to close the gap.
-            try:
-                reply = await core.gcs.call(
-                    "GetActor", {"actor_id": rec.actor_id}
-                )
-            except Exception:
-                return  # the health loop still covers this replica
-            info = reply.get("actor")
-            if info is not None:
-                on_update(info)
-
-        _spawn(_arm())
+        # snapshot=True closes the subscribe-after-publish race (the actor
+        # may have died before the Subscribe landed and that publish is
+        # gone): the GcsClient delivers the current actor state to this
+        # handler right after subscribing, and the same snapshot pull
+        # re-fires automatically whenever a pubsub seq gap is detected.
+        _spawn(
+            core.gcs.subscribe(
+                f"actor:{rec.actor_id}", on_update, snapshot=True
+            )
+        )
 
     def _start_stopping(self, state: _DeploymentState, rec: _ReplicaRecord) -> None:
         if rec.health_task is not None:

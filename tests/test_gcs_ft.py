@@ -28,6 +28,19 @@ def _restart_gcs():
     w.run_async(cycle(), timeout=30)
 
 
+def _crash_gcs(torn_tail=True):
+    """Hard-crash cycle: no store checkpoint/fsync on the way down, plus a
+    half-written record torn onto the WAL tail (power-loss shape)."""
+    w = worker_mod.global_worker
+    node = w.node
+
+    async def cycle():
+        await node.crash_gcs(torn_tail=torn_tail)
+        await node.restart_gcs()
+
+    w.run_async(cycle(), timeout=30)
+
+
 def test_gcs_restart_cluster_resumes(ray_small):
     @ray_tpu.remote
     def f(x):
@@ -86,6 +99,56 @@ def test_gcs_restart_kv_survives(ray_small):
     while True:
         try:
             assert w.run_async(core.gcs.kv_get("persist_me", ns="test"), timeout=30) == b"value"
+            break
+        except Exception:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.5)
+
+
+def test_gcs_crash_torn_wal_detached_actor_survives(ray_small):
+    """Crash (not stop) the GCS with a torn WAL tail mid-session: recovery
+    truncates the torn frame and every acknowledged record — the detached
+    actor's ALIVE entry, its name registration — survives."""
+
+    @ray_tpu.remote
+    class Keeper:
+        def __init__(self):
+            self.v = 0
+
+        def incr(self):
+            self.v += 1
+            return self.v
+
+    k = Keeper.options(name="crashproof", lifetime="detached").remote()
+    assert ray_tpu.get(k.incr.remote()) == 1
+
+    _crash_gcs(torn_tail=True)
+
+    deadline = time.monotonic() + 20
+    while True:
+        try:
+            k2 = ray_tpu.get_actor("crashproof")
+            assert ray_tpu.get(k2.incr.remote(), timeout=30) == 2
+            break
+        except Exception:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.5)
+
+
+def test_gcs_crash_torn_wal_kv_survives(ray_small):
+    w = worker_mod.global_worker
+    core = w.core
+    w.run_async(core.gcs.kv_put("crash_me", b"value", ns="test"))
+    _crash_gcs(torn_tail=True)
+    deadline = time.monotonic() + 20
+    while True:
+        try:
+            assert (
+                w.run_async(core.gcs.kv_get("crash_me", ns="test"), timeout=30)
+                == b"value"
+            )
             break
         except Exception:
             if time.monotonic() > deadline:

@@ -1,0 +1,234 @@
+"""GCS store backends (gcs_store.py): WAL framing/recovery semantics —
+torn-tail truncation, CRC rejection, group commit, snapshot compaction,
+crash-vs-close — and op-sequence parity across all three backends."""
+
+import asyncio
+import os
+import struct
+import zlib
+
+import pytest
+
+from ray_tpu._private import gcs_store
+from ray_tpu._private.gcs_store import (
+    InMemoryStoreClient,
+    SqliteStoreClient,
+    WalStoreClient,
+    inject_torn_tail,
+    make_store,
+)
+
+
+@pytest.fixture
+def wal_path(tmp_path):
+    return str(tmp_path / "gcs.wal")
+
+
+def test_wal_basic_roundtrip(wal_path):
+    s = WalStoreClient(wal_path)
+    s.put("kv", "a", b"1")
+    s.put("kv", "b", b"2")
+    s.put("kv", "a", b"3")  # overwrite
+    s.delete("kv", "b")
+    assert s.get("kv", "a") == b"3"
+    assert s.get("kv", "b") is None
+    s.close()
+    s2 = WalStoreClient(wal_path)
+    assert s2.get_all("kv") == {"a": b"3"}
+    s2.close()
+
+
+def test_wal_torn_tail_truncated(wal_path):
+    s = WalStoreClient(wal_path)
+    s.put("actors", "x", b"alive")
+    s.crash()
+    size_before = os.path.getsize(wal_path)
+    assert inject_torn_tail(wal_path)
+    assert os.path.getsize(wal_path) > size_before
+    s2 = WalStoreClient(wal_path)
+    # The torn frame is truncated away; every intact record survives.
+    assert s2.get("actors", "x") == b"alive"
+    s2.close()
+    assert os.path.getsize(wal_path) == size_before
+
+
+def test_wal_crc_rejection(wal_path):
+    s = WalStoreClient(wal_path)
+    s.put("kv", "good", b"v")
+    s.put("kv", "bad", b"w")
+    s.close()
+    # Flip a byte inside the LAST record's body: its CRC no longer matches,
+    # so recovery must stop before it (and keep everything earlier).
+    with open(wal_path, "r+b") as f:
+        data = f.read()
+        f.seek(len(data) - 2)
+        f.write(bytes([data[-2] ^ 0xFF]))
+    s2 = WalStoreClient(wal_path)
+    assert s2.get("kv", "good") == b"v"
+    assert s2.get("kv", "bad") is None
+    s2.close()
+
+
+def test_wal_group_commit_one_write_per_tick(wal_path):
+    s = WalStoreClient(wal_path)
+
+    async def burst():
+        for i in range(256):
+            s.put("kv", f"k{i}", b"v" * 64)
+        # Buffered until the scheduled call_soon flush runs.
+        assert s._pending
+        await asyncio.sleep(0)
+        assert not s._pending
+
+    asyncio.run(burst())
+    s.crash()
+    s2 = WalStoreClient(wal_path)
+    assert len(s2.get_all("kv")) == 256
+    s2.close()
+
+
+def test_wal_compaction_preserves_state(wal_path):
+    s = WalStoreClient(wal_path, compact_bytes=2048)
+    for i in range(100):
+        s.put("kv", f"k{i % 10}", (b"v%d" % i) * 30)
+    s.delete("kv", "k0")
+    s.close()
+    # Log stayed bounded (~one snapshot, not 100 records)...
+    assert os.path.getsize(wal_path) < 20 * 2048
+    # ...and replays to the same state.
+    s2 = WalStoreClient(wal_path)
+    kv = s2.get_all("kv")
+    assert set(kv) == {f"k{i}" for i in range(1, 10)}
+    assert kv["k9"] == b"v99" * 30
+    s2.close()
+
+
+def test_wal_crash_keeps_acknowledged_state(wal_path):
+    s = WalStoreClient(wal_path)
+    for i in range(32):
+        s.put("jobs", f"j{i}", b"running")
+    s.crash()  # no fsync, no checkpoint — but the tail reaches the OS
+    s2 = WalStoreClient(wal_path)
+    assert len(s2.get_all("jobs")) == 32
+    s2.close()
+
+
+def test_wal_sync_always_flushes_inline(wal_path):
+    s = WalStoreClient(wal_path, sync="always")
+
+    async def one():
+        s.put("kv", "k", b"v")
+        assert not s._pending  # no group-commit buffering
+
+    asyncio.run(one())
+    s.crash()
+    assert WalStoreClient(wal_path).get("kv", "k") == b"v"
+
+
+def test_wal_refuses_sqlite_file(tmp_path):
+    p = str(tmp_path / "gcs.db")
+    sq = SqliteStoreClient(p)
+    sq.put("kv", "k", b"v")
+    sq.close()
+    with pytest.raises(ValueError):
+        WalStoreClient(p)
+    assert not inject_torn_tail(p)
+    # The refused open must not have damaged the sqlite file.
+    sq2 = SqliteStoreClient(p)
+    assert sq2.get("kv", "k") == b"v"
+    sq2.close()
+
+
+def test_sqlite_close_checkpoints_wal(tmp_path):
+    p = str(tmp_path / "gcs.db")
+    s = SqliteStoreClient(p)
+    s.put("kv", "k", b"v")
+    assert os.path.getsize(p + "-wal") > 0
+    s.close()
+    # Graceful close folds the -wal file into the main db.
+    assert (
+        not os.path.exists(p + "-wal") or os.path.getsize(p + "-wal") == 0
+    )
+    s2 = SqliteStoreClient(p)
+    assert s2.get("kv", "k") == b"v"
+    s2.close()
+
+
+def test_sqlite_crash_leaves_wal_replayable(tmp_path):
+    p = str(tmp_path / "gcs.db")
+    s = SqliteStoreClient(p)
+    s.put("kv", "k", b"v")
+    s.crash()  # no checkpoint: -wal left behind
+    s2 = SqliteStoreClient(p)
+    assert s2.get("kv", "k") == b"v"  # sqlite replays its WAL on open
+    s2.close()
+
+
+_OPS = [
+    ("put", "kv", "a", b"1"),
+    ("put", "actors", "x", b"spec"),
+    ("put", "kv", "a", b"2"),
+    ("put", "kv", "b", b"3"),
+    ("del", "kv", "a", None),
+    ("put", "named", "all", b"{}"),
+    ("del", "kv", "missing", None),
+    ("put", "pgs", "pg1", b"pending"),
+    ("put", "pgs", "pg1", b"created"),
+]
+
+
+def _apply(store):
+    for op, table, key, value in _OPS:
+        if op == "put":
+            store.put(table, key, value)
+        else:
+            store.delete(table, key)
+
+
+def test_backend_parity(tmp_path):
+    """Same op sequence -> same get_all across all three backends, both
+    live and (for the durable two) after a reopen."""
+    stores = {
+        "memory": InMemoryStoreClient(),
+        "sqlite": SqliteStoreClient(str(tmp_path / "p.db")),
+        "wal": WalStoreClient(str(tmp_path / "p.wal")),
+    }
+    tables = ("kv", "actors", "named", "jobs", "pgs")
+    for s in stores.values():
+        _apply(s)
+    expect = {t: stores["memory"].get_all(t) for t in tables}
+    for name, s in stores.items():
+        assert {t: s.get_all(t) for t in tables} == expect, name
+        s.close()
+    for name, reopened in (
+        ("sqlite", SqliteStoreClient(str(tmp_path / "p.db"))),
+        ("wal", WalStoreClient(str(tmp_path / "p.wal"))),
+    ):
+        assert {t: reopened.get_all(t) for t in tables} == expect, name
+        reopened.close()
+
+
+def test_make_store_backend_selection(tmp_path, monkeypatch):
+    from ray_tpu._private.common import config
+
+    assert isinstance(make_store(None), InMemoryStoreClient)
+    assert isinstance(
+        make_store(str(tmp_path / "a.wal")), WalStoreClient
+    )  # default knob = wal
+    assert isinstance(
+        make_store(str(tmp_path / "b.db"), backend="sqlite"), SqliteStoreClient
+    )
+    assert isinstance(
+        make_store(str(tmp_path / "c"), backend="memory"), InMemoryStoreClient
+    )
+    monkeypatch.setenv("RAY_TPU_GCS_PERSIST_BACKEND", "sqlite")
+    config.refresh()
+    try:
+        assert isinstance(
+            make_store(str(tmp_path / "d.db")), SqliteStoreClient
+        )
+        with pytest.raises(ValueError):
+            make_store(str(tmp_path / "e"), backend="bogus")
+    finally:
+        monkeypatch.delenv("RAY_TPU_GCS_PERSIST_BACKEND")
+        config.refresh()

@@ -96,6 +96,51 @@ def test_versioned_view_sync(monkeypatch):
         cluster.shutdown()
 
 
+def test_subscriber_gap_pulls_snapshot():
+    """A subscriber that observes a seq jump (its backlog was shed, or it
+    missed a window) must resync from a channel Snapshot instead of acting
+    on a stale picture."""
+    cluster = Cluster(head_node_args={"num_cpus": 1, "num_tpus": 0})
+    cluster.connect()
+    w = worker_mod.global_worker
+    gcs = cluster.gcs_server
+    try:
+
+        @ray_tpu.remote
+        class A:
+            def ping(self):
+                return 1
+
+        a = A.remote()
+        assert ray_tpu.get(a.ping.remote()) == 1
+        channel = f"actor:{a._actor_id}"
+        got = []
+
+        async def provoke_gap():
+            await w.core.gcs.subscribe(channel, got.append)
+            # Simulate a shed backlog: jump the channel's seqno past what
+            # the subscriber has seen, then publish. The client must flag
+            # the gap and pull a Snapshot (the actor's current record).
+            gcs.publisher.seqnos[channel] = (
+                gcs.publisher.seqnos.get(channel, 0) + 5
+            )
+            gcs.publisher.publish(channel, {"state": "ALIVE", "probe": True})
+
+        w.run_async(provoke_gap(), timeout=30)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if any("probe" in m for m in got) and any(
+                m.get("actor_id") == a._actor_id for m in got
+            ):
+                break
+            time.sleep(0.1)
+        # Both the gap-straddling publish AND the snapshot resync arrive.
+        assert any("probe" in m for m in got), got
+        assert any(m.get("actor_id") == a._actor_id for m in got), got
+    finally:
+        cluster.shutdown()
+
+
 def test_slow_subscriber_backpressure(monkeypatch):
     """A subscriber that stops reading its socket must not stall the GCS:
     its queue bounds, oldest messages drop, and other RPCs stay fast."""
@@ -111,7 +156,14 @@ def test_slow_subscriber_backpressure(monkeypatch):
             async def on_pub(conn, p):
                 received.append(p["msg"])
 
-            conn = await rpc.connect(*cluster.gcs_addr, handlers={"Pub": on_pub})
+            async def on_pub_batch(conn, p):
+                for _ch, msg, _seq in p["items"]:
+                    received.append(msg)
+
+            conn = await rpc.connect(
+                *cluster.gcs_addr,
+                handlers={"Pub": on_pub, "PubBatch": on_pub_batch},
+            )
             await conn.call("Subscribe", {"channel": "bench"})
             return conn
 

@@ -67,6 +67,7 @@ SEEDS ?= 20
 LATENCY_SEEDS ?= 10
 SCHED_SEEDS ?= 10
 RECOVERY_SEEDS ?= 10
+COLLECTIVE_SEEDS ?= 5
 chaos:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m ray_tpu.chaos --check-determinism \
 		--suite full --seeds $(SEEDS)
@@ -78,3 +79,5 @@ chaos:
 		--seeds $(SCHED_SEEDS)
 	env JAX_PLATFORMS=cpu $(PYTHON) -m ray_tpu.chaos \
 		--suite recovery_durable --seeds $(RECOVERY_SEEDS)
+	env JAX_PLATFORMS=cpu $(PYTHON) -m ray_tpu.chaos \
+		--suite collective --seeds $(COLLECTIVE_SEEDS)

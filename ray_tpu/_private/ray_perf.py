@@ -310,6 +310,158 @@ def _bench_transfer_16mb() -> float:
         cluster.shutdown()
 
 
+def _collective_child_main() -> None:
+    """Child-process body for the collective allreduce bench.
+
+    Runs in a fresh interpreter because jax must see the forced 8-device
+    CPU mesh before its backend initializes, and the parent ray_perf
+    process has already touched jax-adjacent state. Prints one JSON dict
+    on the last stdout line (docs/collectives.md "Benchmarks & gating").
+    """
+    import numpy as np
+
+    from ray_tpu.testing import force_cpu_mesh
+
+    force_cpu_mesh(8)
+    import jax
+    from jax.sharding import Mesh
+
+    from ray_tpu.util.collective.collective import SUM, _store_actor_cls
+    from ray_tpu.util.collective.mesh_ops import MeshCollectives
+
+    world, mb = 8, 16
+    parts = [
+        np.full((mb * 1024 * 1024 // 4,), float(r + 1), dtype=np.float32)
+        for r in range(world)
+    ]
+
+    # Mesh path: cached staging + one compiled psum program, every call
+    # after the first is a single XLA dispatch.
+    eng = MeshCollectives(
+        Mesh(np.array(jax.devices()[:world]), ("world",)), "world", "perf"
+    )
+    staged = eng.stage_parts(parts, cache_token="bench")
+
+    def mesh_cycle():
+        eng.allreduce(staged, SUM).block_until_ready()
+
+    mesh_rate = timeit("collective allreduce 16MiB (mesh psum)", mesh_cycle)
+    mesh_mb_per_s = mb * mesh_rate
+
+    # Store path: the generic backend's data movement — every rank's
+    # 16 MiB contribution crosses the object store into the rendezvous
+    # actor and the reduced result crosses back out, once per rank.
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    try:
+        # max_concurrency: in production every rank is a distinct caller so
+        # contribute() coroutines interleave; here one driver plays all 8
+        # ranks, and per-caller ordering would serialize the rendezvous.
+        store = _store_actor_cls().options(max_concurrency=world).remote(world)
+        seq = [0]
+
+        def store_cycle():
+            s = seq[0]
+            seq[0] += 1
+            ray_tpu.get(
+                [
+                    store.contribute.remote(s, r, parts[r], SUM, "allreduce")
+                    for r in range(world)
+                ],
+                timeout=120,
+            )
+
+        store_rate = timeit(
+            "collective allreduce 16MiB (store actor)", store_cycle
+        )
+    finally:
+        ray_tpu.shutdown()
+    store_mb_per_s = mb * store_rate
+
+    print(
+        json.dumps(
+            {
+                "collective_allreduce_mb_per_s": mesh_mb_per_s,
+                "collective_allreduce_store_mb_per_s": store_mb_per_s,
+                "collective_allreduce_speedup_x": mesh_mb_per_s
+                / max(store_mb_per_s, 1e-9),
+            }
+        )
+    )
+
+
+def _bench_collective_allreduce() -> Dict[str, float]:
+    """ICI-native vs store-actor allreduce at 16 MiB per rank, world=8,
+    on the forced 8-device CPU mesh (the same topology the collective-xla
+    CI job tests). The acceptance bar — mesh >= 2x store — is gated as
+    `collective_allreduce_speedup_x` in benchmarks/perf_floors.json."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""  # skip TPU plugin registration
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu._private.ray_perf", "--collective-child"],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"collective bench child failed:\n{out.stdout}\n{out.stderr}"
+        )
+    line = out.stdout.strip().splitlines()[-1]
+    results: Dict[str, float] = json.loads(line)
+    for k, v in results.items():
+        print(f"{k}: {v:.1f}")
+    return results
+
+
+def _bench_dag_channel() -> float:
+    """Compiled-DAG executes/s through a ~1 MiB actor->actor tensor-channel
+    edge: producer writes the array into the shm tensor channel, consumer
+    reduces it — the steady-state cost of a compiled pipeline hop."""
+    import numpy as np
+
+    from ray_tpu import dag
+
+    @ray_tpu.remote
+    class Producer:
+        def make(self, seed):
+            return np.full((512, 512), float(seed), dtype=np.float32)
+
+    @ray_tpu.remote
+    class Consumer:
+        def total(self, x):
+            return float(np.asarray(x)[0, 0])
+
+    p, c = Producer.remote(), Consumer.remote()
+    with dag.InputNode() as inp:
+        graph = c.total.bind(p.make.bind(inp).with_tensor_transport("tensor"))
+    compiled = graph.experimental_compile()
+    try:
+        assert compiled.execute(3).get() == 3.0  # warm the channel
+
+        n = 50
+        seq = [10]
+
+        def cycle():
+            base = seq[0]
+            seq[0] += n
+            for i in range(n):
+                assert compiled.execute(base + i).get() == float(base + i)
+
+        return timeit("compiled DAG 1MiB tensor-channel hop", cycle, n)
+    finally:
+        compiled.teardown()
+        for a in (p, c):
+            ray_tpu.kill(a)
+
+
 def main(json_path: str = "") -> Dict[str, float]:
     results: Dict[str, float] = {}
     ray_tpu.init(num_cpus=8, num_tpus=0)
@@ -410,10 +562,12 @@ def main(json_path: str = "") -> Dict[str, float]:
 
     results["release_batched_per_s"] = _bench_release_batched()
     results["ingest_rows_per_s"] = _bench_ingest()
+    results["dag_channel_tensor_per_s"] = _bench_dag_channel()
 
     ray_tpu.shutdown()
 
     results["transfer_16mb_per_s"] = _bench_transfer_16mb()
+    results.update(_bench_collective_allreduce())
     results.update(_bench_sched())
     results["gcs_persist_puts_per_s"] = _bench_gcs_persist()
     results["pubsub_fanout_per_s"] = _bench_pubsub_fanout()
@@ -429,5 +583,14 @@ if __name__ == "__main__":
 
     parser = argparse.ArgumentParser()
     parser.add_argument("--json", default="")
+    parser.add_argument(
+        "--collective-child",
+        action="store_true",
+        help="internal: run the collective allreduce bench body "
+        "(fresh process so jax sees the forced CPU mesh)",
+    )
     args = parser.parse_args()
-    main(args.json)
+    if args.collective_child:
+        _collective_child_main()
+    else:
+        main(args.json)

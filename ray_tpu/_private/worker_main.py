@@ -696,6 +696,10 @@ class Executor:
     async def handle_create_actor(self, conn, p):
         wire = p["spec"]
         self.actor_spec = wire
+        # Stash the hosted actor's identity on the CoreWorker so library code
+        # running inside this process (collective group membership, death
+        # watches) can learn "which actor am I" without an RPC.
+        self.core.current_actor_id = wire.get("actor_id")
         max_c = wire.get("max_concurrency") or 1
         cgroups = wire.get("concurrency_groups")
         if cgroups:

@@ -19,10 +19,18 @@ logger = logging.getLogger(__name__)
 
 ACTIONS = (
     "kill_worker", "kill_replica", "kill_raylet", "restart_gcs", "crash_gcs",
+    "kill_collective_rank",
 )
 
 # Actor-name prefix of Serve replica workers (ReplicaID.to_actor_name).
 SERVE_REPLICA_PREFIX = "SERVE_REPLICA::"
+
+# Actor-name prefix of collective-group rank actors (the chaos collective
+# workload names its ranks this way). The group's rendezvous actor
+# (`__collective_*`) is deliberately NOT a target: killing it exercises the
+# same store-death path, but the invariant under test is peer death
+# detection mid-op (docs/collectives.md "Failure semantics").
+COLLECTIVE_RANK_PREFIX = "COLLECTIVE_RANK::"
 
 
 class Nemesis:
@@ -48,6 +56,8 @@ class Nemesis:
             return self._kill_worker(pick)
         if action == "kill_replica":
             return self._kill_replica(pick)
+        if action == "kill_collective_rank":
+            return self._kill_collective_rank(pick)
         if action == "kill_raylet":
             return await self._kill_raylet(pick)
         if action == "restart_gcs":
@@ -114,6 +124,45 @@ class Nemesis:
             node_id[:8],
         )
         return f"kill_replica {worker_id[:8]}@{node_id[:8]}"
+
+    def _kill_collective_rank(self, pick: int) -> Optional[str]:
+        """SIGKILL a worker hosting a collective-group rank actor while its
+        group op is in flight. The surviving ranks' blocked ops must fail
+        with a typed CollectiveGroupDiedError within the health deadline —
+        never hang (docs/collectives.md)."""
+        gcs = self.cluster.gcs_server
+        if gcs is None:
+            return None
+        rank_workers = {
+            a.worker_id
+            for a in gcs.actors.values()
+            if a.state == "ALIVE"
+            and (a.name or "").startswith(COLLECTIVE_RANK_PREFIX)
+            and a.worker_id
+        }
+        candidates = []
+        for node_id in sorted(self.cluster.raylets):
+            raylet = self.cluster.raylets[node_id]
+            for worker_id in sorted(raylet.workers):
+                if worker_id not in rank_workers:
+                    continue
+                handle = raylet.workers[worker_id]
+                if handle.proc is not None and handle.proc.returncode is None:
+                    candidates.append((node_id, worker_id, handle))
+        if not candidates:
+            return None
+        node_id, worker_id, handle = candidates[pick % len(candidates)]
+        try:
+            handle.proc.kill()
+        except ProcessLookupError:
+            return None
+        self.actions_fired.append("kill_collective_rank")
+        logger.info(
+            "nemesis: killed collective rank worker %s on %s",
+            worker_id[:8],
+            node_id[:8],
+        )
+        return f"kill_collective_rank {worker_id[:8]}@{node_id[:8]}"
 
     async def _kill_raylet(self, pick: int) -> Optional[str]:
         head_id = (

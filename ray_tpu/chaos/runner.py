@@ -57,6 +57,43 @@ class _ServeEcho:
         return x
 
 
+class _CollectiveRank:
+    """Collective chaos workload: one rank of a store-backend group. The
+    caller staggers contributions (delay_s) so peers are parked inside the
+    group op when the nemesis kills a rank — the survivors' blocked
+    allreduce must fail typed within the health deadline, never hang."""
+
+    def __init__(self, group: str, world: int, rank: int):
+        self.group, self.world, self.rank = group, world, rank
+
+    def join(self) -> int:
+        """Form the group (rendezvous actor + member registration). The
+        driver gates on every rank's join before arming the nemesis: the
+        scenario tests death mid-OP — a rank killed before it registers is
+        unwatchable by design (nothing to watch yet)."""
+        from ray_tpu.util import collective as col
+
+        col.init_collective_group(
+            self.world, self.rank, backend="store", group_name=self.group
+        )
+        return self.rank
+
+    def reduce(self, delay_s: float = 0.0) -> float:
+        import time as _time
+
+        import numpy as np
+
+        from ray_tpu.util import collective as col
+
+        if delay_s:
+            _time.sleep(delay_s)
+        out = col.allreduce(
+            np.full(1024, float(self.rank + 1), dtype=np.float64),
+            group_name=self.group,
+        )
+        return float(out[0])
+
+
 # -- scenario catalog --------------------------------------------------------
 
 
@@ -258,6 +295,23 @@ SCENARIOS: Dict[str, Scenario] = {
             env=dict(_TASKS_ENV),
         ),
         Scenario(
+            name="collective_rank_kill",
+            description="SIGKILL a collective-group rank while its peers "
+            "are parked inside a store-backend allreduce; survivors must "
+            "fail with a typed CollectiveGroupDiedError within the health "
+            "deadline — never hang — and the cluster keeps running fresh "
+            "work",
+            specs=[],
+            workload="collective",
+            steps=3,
+            nemesis=["kill_collective_rank"],
+            env=dict(
+                _TASKS_ENV,
+                RAY_TPU_COLLECTIVE_HEALTH_INTERVAL_S="0.25",
+                RAY_TPU_COLLECTIVE_TIMEOUT_S="20",
+            ),
+        ),
+        Scenario(
             name="kill_raylet",
             description="kill the node holding transferred objects; refs "
             "recover via lineage reconstruction",
@@ -329,12 +383,15 @@ SUITES: Dict[str, List[str]] = {
     # Simulated-cluster scheduler scenarios: no driver, hundreds of
     # in-process raylets (see _private/sim_cluster.py).
     "sched": ["sched_storm"],
+    # Collective groups under fire: rank death mid-allreduce must surface
+    # as a typed CollectiveGroupDiedError, never a hang (docs/collectives.md).
+    "collective": ["collective_rank_kill"],
     "full": [
         "rpc_delay", "dup_lease", "chunk_loss", "reorder_push",
         "latency_storm", "latency_gcs_drop", "latency_gcs_restart",
         "serve_replica_kill", "serve_deadline_storm", "serve_router_restart",
         "kill_worker", "gcs_restart", "kill_raylet", "sched_storm",
-        "recovery_durable", "recovery_durable_sim",
+        "recovery_durable", "recovery_durable_sim", "collective_rank_kill",
     ],
 }
 
@@ -551,11 +608,115 @@ def run_seed(session: _Session, scenario: Scenario, seed: int,
             )
         return outcomes, bad, fired, error_samples
 
+    def _collective_step(step, actions):
+        """One store-backend allreduce across fresh rank actors; nemesis
+        kills fire WHILE the ranks are parked inside the op (rank 1's
+        contribution is staggered, so every peer is blocked when the
+        SIGKILL lands). Runs sync on the driver thread: the blocking is in
+        the rank actors, not here. Returns (violations, fired)."""
+        import time as _time
+
+        import ray_tpu
+        from ray_tpu.util.collective import CollectiveGroupDiedError
+
+        group = f"chaos_{seed}_{step}"
+        world = 2
+        # Fractional CPUs: the chaos head node has 2; both ranks plus the
+        # 0.1-CPU rendezvous actor must fit or rank 1 never places and the
+        # group op times out without any fault having fired.
+        Rank = ray_tpu.remote(max_restarts=0, num_cpus=0.5)(_CollectiveRank)
+        ranks = [
+            Rank.options(
+                name=f"COLLECTIVE_RANK::{group}_{r}"
+            ).remote(group, world, r)
+            for r in range(world)
+        ]
+        bad: List[str] = []
+        try:
+            # Barrier: the group must be fully formed (store actor up, every
+            # member registered) before the nemesis arms — the invariant
+            # under test is death MID-OP, not death during bootstrap.
+            session.ray.get([a.join.remote() for a in ranks], timeout=60)
+        except Exception as e:
+            bad.append(
+                f"step {step}: group bootstrap failed before any fault: "
+                f"{type(e).__name__}: {e}"
+            )
+            for a in ranks:
+                try:
+                    session.ray.kill(a)
+                except Exception:
+                    pass
+            return bad, []
+        refs = [ranks[0].reduce.remote(0.0), ranks[1].reduce.remote(1.5)]
+        _time.sleep(0.5)  # rank 0 is parked inside the allreduce now
+        fired = []
+        for action, pick in actions:
+            async def _fire(action=action, pick=pick):
+                return await nemesis.fire(action, pick)
+
+            desc = session.run_async(_fire(), timeout=60)
+            if desc:
+                fired.append(desc)
+        outcomes = {"ok": 0, "typed_death": 0, "victim_died": 0}
+        deadline = 30.0
+        for r, ref in enumerate(refs):
+            t0 = _time.monotonic()
+            try:
+                got = session.ray.get(ref, timeout=deadline)
+            except CollectiveGroupDiedError:
+                # The survivor's op failed typed — the invariant under test.
+                outcomes["typed_death"] += 1
+            except (
+                ray_tpu.ActorDiedError,
+                ray_tpu.ActorUnavailableError,
+                ray_tpu.WorkerCrashedError,
+            ):
+                outcomes["victim_died"] += 1  # the killed rank's own call
+            except ray_tpu.GetTimeoutError:
+                bad.append(
+                    f"step {step} rank {r}: collective op hung past "
+                    f"{deadline:.0f}s (after {_time.monotonic() - t0:.1f}s) "
+                    "instead of failing typed"
+                )
+            except Exception as e:
+                bad.append(
+                    f"step {step} rank {r}: untyped collective failure "
+                    f"{type(e).__name__}: {e}"
+                )
+            else:
+                if got != 3.0:  # sum over ranks of full(1024, rank+1)[0]
+                    bad.append(
+                        f"step {step} rank {r}: allreduce returned {got}, "
+                        "want 3.0"
+                    )
+                else:
+                    outcomes["ok"] += 1
+        if fired and not (outcomes["typed_death"] or outcomes["victim_died"]):
+            bad.append(
+                f"step {step}: nemesis fired ({fired}) but no rank observed "
+                f"a death: {outcomes}"
+            )
+        # Reap this step's group: surviving ranks and the rendezvous actor
+        # (each step builds a fresh group, so corpses must not accumulate).
+        for a in ranks:
+            try:
+                session.ray.kill(a)
+            except Exception:
+                pass
+        try:
+            session.ray.kill(
+                session.ray.get_actor(f"__collective_{group}")
+            )
+        except Exception:
+            pass
+        return bad, fired
+
     interceptor = session.run_async(_install(), timeout=20)
     try:
         for step in range(scenario.steps):
             actions = plan.at_step(step)
-            if scenario.workload != "serve":
+            if scenario.workload not in ("serve", "collective"):
                 for action, pick in actions:
                     async def _fire(action=action, pick=pick):
                         return await nemesis.fire(action, pick)
@@ -584,6 +745,12 @@ def run_seed(session: _Session, scenario: Scenario, seed: int,
                             f"workload: step {step} no serve request "
                             f"succeeded: {outcomes} errors={err_samples}"
                         )
+                elif scenario.workload == "collective":
+                    bad, fired = _collective_step(step, actions)
+                    if verbose and fired:
+                        for desc in fired:
+                            print(f"      nemesis: {desc}")
+                    violations.extend(f"workload: {b}" for b in bad)
                 elif scenario.workload == "tasks":
                     refs = [
                         session.add.remote(seed * 1000 + step * 10 + i, i)

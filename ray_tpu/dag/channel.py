@@ -106,12 +106,19 @@ class Channel:
 
 
 def make_channel(spec, *, create: bool = False) -> Channel:
-    """Open a channel from its wire spec (name, size[, kind]): kind
-    "tensor" -> array-native TensorChannel, else the pickle Channel."""
+    """Open a channel from its wire spec (name, size[, kind[, meta]]): kind
+    "tensor" -> array-native TensorChannel, "device" -> compiled
+    device-to-device DeviceTensorChannel (meta holds the collective group +
+    src/dst ranks), else the pickle Channel."""
     name, size = spec[0], spec[1]
     kind = spec[2] if len(spec) > 2 else "chan"
     if kind == "tensor":
         from ray_tpu.dag.tensor_channel import TensorChannel
 
         return TensorChannel(name, size, create=create)
+    if kind == "device":
+        from ray_tpu.dag.tensor_channel import DeviceTensorChannel
+
+        meta = spec[3] if len(spec) > 3 else None
+        return DeviceTensorChannel(name, size, create=create, meta=meta)
     return Channel(name, size, create=create)

@@ -96,10 +96,12 @@ class CompiledDAG:
 
         self._all_chan_names: List[str] = []
 
-        def new_chan_spec(kind: str = "chan") -> Tuple[str, int, str]:
+        def new_chan_spec(kind: str = "chan", meta=None):
             self._counter += 1
             name = f"rtdag_{self._uid}_{self._counter}"
             self._all_chan_names.append(name)
+            if meta is not None:
+                return (name, self._max_buf, kind, meta)
             return (name, self._max_buf, kind)
 
         for node in order:
@@ -141,7 +143,12 @@ class CompiledDAG:
         if isinstance(a, ClassMethodNode):
             # Edge transport follows the PRODUCER's annotation
             # (reference: with_tensor_transport on the upstream node).
-            spec = new_chan_spec("tensor" if a._tensor_transport else "chan")
+            # actor->actor edges are the only ones eligible for the compiled
+            # device path; everything else degrades to the shm tensor wire.
+            if a._tensor_transport == "device":
+                spec = new_chan_spec("device", a._transport_meta)
+            else:
+                spec = new_chan_spec("tensor" if a._tensor_transport else "chan")
             # Create driver-side so the consumer can open it immediately.
             make_channel(spec, create=True).close()
             node_out_specs[id(a)].append(spec)

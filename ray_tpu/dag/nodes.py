@@ -12,21 +12,40 @@ from typing import Any, Dict, List, Optional, Tuple
 
 
 class DAGNode:
-    # Transport for this node's OUTPUT edges: None (pickle shm channel) or
-    # "tensor" (array-native shm channel; reference analog:
-    # TorchTensorType/with_tensor_transport on aDAG edges).
+    # Transport for this node's OUTPUT edges: None (pickle shm channel),
+    # "tensor" (array-native shm channel), or "device" (compiled ppermute
+    # device channel; reference analog: TorchTensorType/with_tensor_transport
+    # with transport="nccl" on aDAG edges).
     _tensor_transport: Optional[str] = None
+    _transport_meta: Optional[Dict[str, Any]] = None
 
     def experimental_compile(self, *, max_buf_size: int = 10 * 1024 * 1024):
         from ray_tpu.dag.compiled import CompiledDAG
 
         return CompiledDAG(self, max_buf_size=max_buf_size)
 
-    def with_tensor_transport(self, transport: str = "tensor") -> "DAGNode":
-        """Mark this node's outputs as array payloads: they move through
-        raw-buffer channels (dtype/shape header + memcpy — no pickle).
+    def with_tensor_transport(
+        self,
+        transport: str = "tensor",
+        *,
+        group_name: str = "default",
+        src: int = 0,
+        dst: int = 1,
+    ) -> "DAGNode":
+        """Mark this node's outputs as array payloads.
+
+        transport="tensor": raw-buffer shm channels (dtype/shape header +
+        memcpy — no pickle). transport="device": compiled device channels —
+        shm control frame + jitted ppermute payload hop between collective
+        ranks `src` (producer) and `dst` (consumer) of xla group
+        `group_name`; see docs/collectives.md. Only actor→actor edges ride
+        the device path — driver-facing edges degrade to "tensor".
         Reference: DAGNode.with_tensor_transport(...)."""
         self._tensor_transport = transport
+        if transport == "device":
+            self._transport_meta = {
+                "group": group_name, "src": int(src), "dst": int(dst)
+            }
         return self
 
     def _upstream(self) -> List["DAGNode"]:

@@ -25,6 +25,13 @@ import jax.numpy as jnp
 _BIG_NEG = -1e30
 
 
+def _compiler_params(pltpu, **kw):
+    """pltpu.TPUCompilerParams was renamed CompilerParams across jax minor
+    releases; build whichever this jax ships."""
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kw)
+
+
 def _attn_fwd_kernel(
     q_ref, k_ref, v_ref,  # inputs
     o_ref,  # output
@@ -176,8 +183,8 @@ def _flash_fwd(q, k, v, *, causal, scale, block_q, block_k, interpret,
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        compiler_params=_compiler_params(
+            pltpu, dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
     )(q, k, v)
@@ -394,8 +401,8 @@ def _flash_bwd_dq(q, k, v, do, lse, delta, *, causal, scale,
         out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, T, D), jnp.float32),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        compiler_params=_compiler_params(
+            pltpu, dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
     )(q, k, v, do, lse, delta)
@@ -440,8 +447,8 @@ def _flash_bwd_dkv(q, k, v, do, lse, delta, *, causal, scale,
             pltpu.VMEM((block_k, D), jnp.float32),
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        compiler_params=_compiler_params(
+            pltpu, dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
     )(q, k, v, do, lse, delta)

@@ -24,9 +24,14 @@ from ray_tpu.parallel.mesh import (
 from ray_tpu.parallel.ring_attention import (
     full_attention,
     ring_attention,
+    ring_attention_on_group,
     ring_attention_sharded,
 )
-from ray_tpu.parallel.ulysses import ulysses_attention, ulysses_attention_sharded
+from ray_tpu.parallel.ulysses import (
+    ulysses_attention,
+    ulysses_attention_on_group,
+    ulysses_attention_sharded,
+)
 
 __all__ = [
     "DATA",
@@ -44,8 +49,10 @@ __all__ = [
     "fsdp_sharding_for_leaf",
     "shard_pytree",
     "ring_attention",
+    "ring_attention_on_group",
     "ring_attention_sharded",
     "full_attention",
     "ulysses_attention",
+    "ulysses_attention_on_group",
     "ulysses_attention_sharded",
 ]

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
-from ray_tpu.parallel.ring_attention import full_attention
+from ray_tpu.parallel.ring_attention import _shard_map, full_attention
 
 
 def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
@@ -32,12 +32,25 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
     return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
 
+def ulysses_attention_on_group(q, k, v, causal: bool = False,
+                               group_name: str = "default"):
+    """Ulysses attention over an xla collective group's mesh with the
+    group's compiled-program cache (see ring_attention_on_group)."""
+    from ray_tpu.util.collective import get_group_collectives
+
+    eng = get_group_collectives(group_name)
+    if eng is None:
+        raise ValueError(
+            f"group {group_name!r} has no mesh engine (xla backend required)"
+        )
+    return eng.ulysses_attention(q, k, v, causal=causal)
+
+
 def ulysses_attention_sharded(q, k, v, mesh, causal: bool = False,
                               seq_axis: str = "sequence",
                               batch_axes=("data", "fsdp")):
     import functools
 
-    import jax
     from jax.sharding import PartitionSpec as P
 
     present = set(mesh.axis_names)
@@ -46,6 +59,12 @@ def ulysses_attention_sharded(q, k, v, mesh, causal: bool = False,
     b_ax = tuple(a for a in batch_axes if a in present) or None
     spec = P(b_ax, seq_axis, None, None)
     fn = functools.partial(ulysses_attention, axis_name=seq_axis, causal=causal)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(
-        q, k, v
-    )
+    sm = _shard_map()
+    try:
+        mapped = sm(
+            fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_rep=False,
+        )
+    except TypeError:  # newer jax: check_rep retired
+        mapped = sm(fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return mapped(q, k, v)

@@ -7,9 +7,10 @@ backends instead of NCCL/Gloo:
 
 - "xla": multi-controller JAX. Ranks rendezvous through the GCS KV for a
   coordinator address, call jax.distributed.initialize, and every collective
-  lowers to a jitted `jax.lax` op over the global device mesh — ICI when the
-  ranks are TPU hosts, the JAX coordination fabric otherwise. This is the
-  performance path; the group IS a mesh.
+  lowers to a `jax.lax` op under shard_map over the group's named mesh — ICI
+  when the ranks are TPU hosts, the JAX coordination fabric otherwise. This
+  is the performance path; the group IS a mesh (see
+  ray_tpu/util/collective/mesh_ops.py and docs/collectives.md).
 - "store": pure control-plane fallback (the pygloo-analog): a named async
   rendezvous actor reduces numpy payloads. Correct anywhere, including CPU
   actors; bandwidth-bound by the object path, so use it for small tensors and
@@ -18,25 +19,38 @@ backends instead of NCCL/Gloo:
 Like NCCL, all ranks must issue collectives in the same order; a per-group
 sequence number enforces matching.
 
-PERFORMANCE NOTE (read this before putting col.allreduce in a loop): on
-TPU, collectives only ride ICI when they execute INSIDE one compiled SPMD
-program. These module-level functions are host-mediated per call — each
-builds a global array and runs a freshly dispatched jitted reduce — which
-is exactly right for rendezvous, bootstrap, and occasional small tensors
-(it is how JaxTrainer seeds its mesh), and ~1000x too slow for per-step
-gradient traffic. The gradient path is: get the group's mesh
-(`get_group_mesh`) and write the training step as one jit/shard_map
-program whose `jax.lax.psum/all_gather/psum_scatter/ppermute` ops XLA
-schedules over ICI; see ray_tpu.parallel.mesh and models/transformer.py's
-make_train_step for the pattern.
+On the xla backend every module-level op runs zero `_CollectiveStore` actor
+round trips: inputs stage onto the group's ici mesh (one device per process,
+cached by buffer identity so repeated calls on the same array skip the
+host->device copy), and the op itself is one cached compiled program. That
+makes these functions fine for rendezvous, bootstrap and moderate tensors;
+per-step gradient traffic should still live INSIDE one jit/shard_map training
+program over `get_group_mesh` (see ray_tpu.parallel.mesh and
+models/transformer.py's make_train_step), where XLA overlaps collectives with
+compute instead of dispatching one program per op.
+
+A rank that dies mid-collective must not hang the survivors: store-backend
+ops poll peer actor liveness through the GCS while blocked and raise
+`CollectiveGroupDiedError` (typed, within ~one health-check interval of the
+GCS marking the actor dead) instead of waiting out the full timeout.
 """
 
 from __future__ import annotations
 
 import logging
+import os
+import time as _time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
+
+from ray_tpu._private.common import (
+    ActorDiedError,
+    ActorUnavailableError,
+    GetTimeoutError,
+    RayTpuError,
+    WorkerCrashedError,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -47,6 +61,32 @@ _OPS = {
     MIN: lambda arrs: np.min(arrs, axis=0),
     MAX: lambda arrs: np.max(arrs, axis=0),
 }
+
+# Blocked store-backend ops re-check peer liveness at this cadence; the
+# overall op deadline stays RAY_TPU_COLLECTIVE_TIMEOUT_S.
+_HEALTH_INTERVAL_S = float(
+    os.environ.get("RAY_TPU_COLLECTIVE_HEALTH_INTERVAL_S", "0.5")
+)
+_OP_TIMEOUT_S = float(os.environ.get("RAY_TPU_COLLECTIVE_TIMEOUT_S", "300"))
+
+
+class CollectiveGroupDiedError(RayTpuError):
+    """A participant (rank actor or the rendezvous store) died while a group
+    op was in flight. The whole group op fails — collectives are
+    all-or-nothing, exactly like a NCCL communicator abort."""
+
+    def __init__(self, group_name: str, detail: str = ""):
+        self.group_name = group_name
+        self.detail = detail
+        super().__init__(
+            f"collective group {group_name!r} died mid-op: {detail}"
+        )
+
+    def __reduce__(self):
+        # Default Exception.__reduce__ would replay the composed message as
+        # group_name; rebuild from the original parts so the error survives
+        # the worker->driver serialization boundary intact.
+        return (type(self), (self.group_name, self.detail))
 
 
 def _store_actor_cls():
@@ -144,8 +184,11 @@ class _Group:
         self.backend = backend
         self.seq = 0
         self.store = None  # store backend: actor handle
-        self.mesh = None  # xla backend: global mesh
-        self._jit_cache: Dict[tuple, Any] = {}
+        self.mesh = None  # xla backend: global ("world", "local") mesh
+        self.engine = None  # xla backend: MeshCollectives over the ici mesh
+        self._p2p_engines: Dict[tuple, Any] = {}
+        self._p2p_seq: Dict[tuple, int] = {}
+        self._members: Optional[Dict[int, str]] = None  # rank -> actor_id
 
     def next_seq(self) -> int:
         self.seq += 1
@@ -191,65 +234,101 @@ def init_collective_group(
         group.store = cls.options(
             name=f"__collective_{group_name}", get_if_exists=True, num_cpus=0.1
         ).remote(world_size)
+        _register_member(group)
     elif backend == "xla":
-        group.mesh = _init_xla_backend(world_size, rank, group_name)
+        group.mesh, group.engine = _init_xla_backend(
+            world_size, rank, group_name
+        )
     else:
         raise ValueError(f"unknown collective backend {backend!r}")
     _manager.groups[group_name] = group
 
 
+def _register_member(group: _Group) -> None:
+    """Publish this rank's actor id in the GCS KV so blocked peers can watch
+    for its death (ns=collective, key member_{group}_{rank}). Driver-side
+    ranks have no actor id and publish an empty value (unwatchable)."""
+    try:
+        from ray_tpu._private import worker as worker_mod
+
+        core = worker_mod._core()
+        aid = getattr(core, "current_actor_id", None) or ""
+        worker_mod.global_worker.run_async(
+            core.gcs.kv_put(
+                f"member_{group.name}_{group.rank}",
+                aid.encode(),
+                ns="collective",
+            )
+        )
+    except Exception:
+        logger.debug("collective member registration failed", exc_info=True)
+
+
 def _init_xla_backend(world_size: int, rank: int, group_name: str):
     """Multi-controller JAX bootstrap: coordinator address rendezvous via GCS
-    KV, jax.distributed.initialize, global 1-axis mesh over all devices."""
+    KV, jax.distributed.initialize, then the group's named meshes — the full
+    ("world", "local") mesh for user SPMD programs and a 1-device-per-process
+    "world" ici mesh carrying the compiled module-level collectives."""
     import socket
 
     import jax
 
-    import ray_tpu
-    from ray_tpu._private import worker as worker_mod
+    from ray_tpu.util.collective.mesh_ops import MeshCollectives
 
-    core = worker_mod._core()
-    key = f"xla_coord_{group_name}"
-    if rank == 0:
-        # Advertise this node's address (not loopback) so ranks on other
-        # hosts can reach the coordinator; raylet_addr holds the node IP.
-        host = core.raylet_addr[0] if core.raylet_addr else socket.gethostbyname(
-            socket.gethostname()
-        )
-        sock = socket.socket()
-        sock.bind((host if host != "127.0.0.1" else "0.0.0.0", 0))
-        port = sock.getsockname()[1]
-        sock.close()
-        coord = f"{host}:{port}"
-        worker_mod.global_worker.run_async(
-            core.gcs.kv_put(key, coord.encode(), ns="collective")
-        )
-    else:
-        import time
+    if world_size > 1:
+        from ray_tpu._private import worker as worker_mod
 
-        coord = None
-        for _ in range(300):
-            val = worker_mod.global_worker.run_async(
-                core.gcs.kv_get(key, ns="collective")
+        core = worker_mod._core()
+        key = f"xla_coord_{group_name}"
+        if rank == 0:
+            # Advertise this node's address (not loopback) so ranks on other
+            # hosts can reach the coordinator; raylet_addr holds the node IP.
+            host = core.raylet_addr[0] if core.raylet_addr else socket.gethostbyname(
+                socket.gethostname()
             )
-            if val:
-                coord = val.decode()
-                break
-            time.sleep(0.1)
-        if coord is None:
-            raise TimeoutError("xla collective coordinator rendezvous timed out")
-    jax.distributed.initialize(
-        coordinator_address=coord, num_processes=world_size, process_id=rank
-    )
+            sock = socket.socket()
+            sock.bind((host if host != "127.0.0.1" else "0.0.0.0", 0))
+            port = sock.getsockname()[1]
+            sock.close()
+            coord = f"{host}:{port}"
+            worker_mod.global_worker.run_async(
+                core.gcs.kv_put(key, coord.encode(), ns="collective")
+            )
+        else:
+            coord = None
+            for _ in range(300):
+                val = worker_mod.global_worker.run_async(
+                    core.gcs.kv_get(key, ns="collective")
+                )
+                if val:
+                    coord = val.decode()
+                    break
+                _time.sleep(0.1)
+            if coord is None:
+                raise TimeoutError(
+                    "xla collective coordinator rendezvous timed out"
+                )
+        jax.distributed.initialize(
+            coordinator_address=coord, num_processes=world_size, process_id=rank
+        )
+    # world_size == 1 needs no distributed runtime: the "group" is this
+    # process's devices (this also keeps single-process groups usable after
+    # the jax backend is already initialized, e.g. in tests and benchmarks).
     from jax.sharding import Mesh
 
     devices = np.asarray(jax.devices()).reshape(world_size, -1)
-    return Mesh(devices, ("world", "local"))
+    mesh = Mesh(devices, ("world", "local"))
+    # ici mesh: rank i <-> devices[i, 0]. One device per process keeps
+    # staging one device_put per call-site (the full mesh would replicate
+    # every module-level payload across all local devices).
+    ici_mesh = Mesh(devices[:, 0], ("world",))
+    engine = MeshCollectives(ici_mesh, axis="world", group_name=group_name)
+    return mesh, engine
 
 
 def destroy_collective_group(group_name: str = "default") -> None:
     group = _manager.groups.pop(group_name, None)
-    if group is not None and group.backend == "xla":
+    if group is not None and group.backend == "xla" and group.world_size > 1:
         # Tear down the jax.distributed runtime so a later xla group can
         # initialize again in this process.
         import jax
@@ -271,79 +350,161 @@ def destroy_collective_group(group_name: str = "default") -> None:
                 pass
         try:
             core = worker_mod._core()
-            worker_mod.global_worker.run_async(
-                core.gcs.kv_del(f"xla_coord_{group_name}", ns="collective")
-            )
+
+            async def _reap():
+                await core.gcs.kv_del(
+                    f"xla_coord_{group_name}", ns="collective"
+                )
+                for r in range(group.world_size):
+                    await core.gcs.kv_del(
+                        f"member_{group_name}_{r}", ns="collective"
+                    )
+
+            worker_mod.global_worker.run_async(_reap())
         except Exception:
             pass
 
 
-def _roundtrip(group: _Group, arr, op: str, mode: str):
+# -- store backend: liveness-watched round trips ------------------------------
+
+
+def _group_members(group: _Group) -> Dict[int, str]:
+    """rank -> actor_id map published at init; cached once complete."""
+    if group._members is not None and len(group._members) == group.world_size:
+        return group._members
+    from ray_tpu._private import worker as worker_mod
+
+    core = worker_mod._core()
+
+    async def _fetch():
+        out = {}
+        for r in range(group.world_size):
+            val = await core.gcs.kv_get(
+                f"member_{group.name}_{r}", ns="collective"
+            )
+            if val is not None:
+                out[r] = val.decode()
+        return out
+
+    try:
+        group._members = worker_mod.global_worker.run_async(_fetch(), timeout=10)
+    except Exception:
+        group._members = group._members or {}
+    return group._members
+
+
+def _dead_members(group: _Group) -> List[int]:
+    """Ranks whose registered actors the GCS has marked DEAD."""
+    from ray_tpu._private import worker as worker_mod
+
+    members = _group_members(group)
+    core = worker_mod._core()
+
+    async def _check():
+        dead = []
+        for rank, aid in members.items():
+            if not aid or rank == group.rank:
+                continue
+            try:
+                resp = await core.gcs.call("GetActor", {"actor_id": aid})
+            except Exception:
+                continue
+            actor = resp.get("actor")
+            if actor is not None and actor.get("state") == "DEAD":
+                dead.append(rank)
+        return dead
+
+    try:
+        return worker_mod.global_worker.run_async(_check(), timeout=10)
+    except Exception:
+        return []
+
+
+def _watched_get(group: _Group, ref, what: str):
+    """ray_tpu.get with a death watch: while the result is pending, poll the
+    GCS for dead group members and fail fast with CollectiveGroupDiedError
+    instead of hanging until the 300s op deadline."""
     import ray_tpu
 
+    deadline = _time.monotonic() + _OP_TIMEOUT_S
+    while True:
+        try:
+            return ray_tpu.get(ref, timeout=_HEALTH_INTERVAL_S)
+        except GetTimeoutError:
+            dead = _dead_members(group)
+            if dead:
+                raise CollectiveGroupDiedError(
+                    group.name,
+                    f"rank(s) {sorted(dead)} died while {what} was in flight",
+                ) from None
+            if _time.monotonic() > deadline:
+                raise GetTimeoutError(
+                    f"collective {what} on group {group.name!r} timed out "
+                    f"after {_OP_TIMEOUT_S:.0f}s"
+                ) from None
+        except (ActorDiedError, WorkerCrashedError, ActorUnavailableError) as e:
+            # The rendezvous store itself is gone: the group cannot complete
+            # any op again.
+            raise CollectiveGroupDiedError(
+                group.name, f"rendezvous store died: {e}"
+            ) from None
+
+
+def _roundtrip(group: _Group, arr, op: str, mode: str):
+    t0 = _time.perf_counter()
     np_arr = np.asarray(arr)
     seq = group.next_seq()
     ref = group.store.contribute.remote(seq, group.rank, np_arr, op, mode)
-    return ray_tpu.get(ref, timeout=300)
+    out = _watched_get(group, ref, mode)
+    from ray_tpu.util.collective.mesh_ops import _observe
+
+    _observe(mode, group.name, np_arr.nbytes, _time.perf_counter() - t0)
+    return out
 
 
-def _xla_allreduce(group: _Group, arr, op: str):
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
+# -- xla backend: compiled mesh ops ------------------------------------------
 
-    mesh = group.mesh
-    key = ("allreduce", op, tuple(np.shape(arr)), str(np.asarray(arr).dtype))
-    fn = group._jit_cache.get(key)
-    if fn is None:
-        reducer = {SUM: jnp.sum, PRODUCT: jnp.prod, MIN: jnp.min, MAX: jnp.max}[op]
 
-        @jax.jit
-        def _reduce(g):
-            return reducer(g, axis=0)
-
-        fn = _reduce
-        group._jit_cache[key] = fn
-    local = jnp.asarray(arr)
-    global_shape = (group.world_size,) + local.shape
-    sharding = NamedSharding(mesh, P("world"))
-    # P("world") replicates over the "local" axis, so every addressable
-    # device in this process's mesh row needs a copy of the shard.
-    garr = jax.make_array_from_single_device_arrays(
-        global_shape,
-        sharding,
-        [jax.device_put(local[None], d) for d in mesh.local_devices],
-    )
-    out = fn(garr)
-    return np.asarray(jax.device_get(out))
+def _staged_input(group: _Group, arr):
+    """Stage this rank's contribution onto the group's ici mesh. Repeat calls
+    with the same (identity) buffer hit the engine's device cache — no
+    np.asarray, no device_put."""
+    return group.engine.stage_local(arr, group.rank)
 
 
 def allreduce(tensor, group_name: str = "default", op: str = SUM):
     """Reduce across all ranks; returns the reduced array on every rank."""
     group = _manager.get(group_name)
     if group.backend == "xla":
-        return _xla_allreduce(group, tensor, op)
+        out = group.engine.allreduce(_staged_input(group, tensor), op)
+        return np.asarray(out)
     return _roundtrip(group, tensor, op, "allreduce")
 
 
 def allgather(tensor, group_name: str = "default") -> List[np.ndarray]:
     group = _manager.get(group_name)
     if group.backend == "xla":
-        # One-hot placement + sum-allreduce: correct on any mesh; XLA fuses
-        # this into an all-gather when profitable.
-        np_arr = np.asarray(tensor)
-        world = group.world_size
-        expanded = np.zeros((world,) + np_arr.shape, dtype=np_arr.dtype)
-        expanded[group.rank] = np_arr
-        out = _xla_allreduce(group, expanded, SUM)
-        return [out[i] for i in range(world)]
+        # lax.all_gather inside the compiled program: each rank stages only
+        # its own shard (the old one-hot path allocated and reduced a
+        # world x |tensor| host buffer per call).
+        out = group.engine.allgather(_staged_input(group, tensor))
+        host = np.asarray(out)
+        return [host[i] for i in range(group.world_size)]
     return _roundtrip(group, tensor, SUM, "allgather")
 
 
 def reducescatter(tensor, group_name: str = "default", op: str = SUM):
     group = _manager.get(group_name)
     if group.backend == "xla":
-        red = _xla_allreduce(group, tensor, op)
+        np_arr = np.asarray(tensor)
+        if np_arr.shape and np_arr.shape[0] % group.world_size == 0:
+            out = group.engine.reducescatter(_staged_input(group, tensor), op)
+            return group.engine.rank_shard(out, group.rank)
+        # Uneven split: reduce on-mesh, slice on host (store-backend parity
+        # via np.array_split; still zero store round trips).
+        red = np.asarray(
+            group.engine.allreduce(_staged_input(group, tensor), op)
+        )
         return np.array_split(red, group.world_size, axis=0)[group.rank]
     return _roundtrip(group, tensor, op, "reducescatter")
 
@@ -351,47 +512,114 @@ def reducescatter(tensor, group_name: str = "default", op: str = SUM):
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     group = _manager.get(group_name)
     if group.backend == "xla":
-        np_arr = np.asarray(tensor)
-        contrib = np_arr if group.rank == src_rank else np.zeros_like(np_arr)
-        return _xla_allreduce(group, contrib, SUM)
+        out = group.engine.broadcast(_staged_input(group, tensor), src_rank)
+        return group.engine.rank_shard(out, group.rank)[0]
     return _roundtrip(group, tensor, str(src_rank), "broadcast")
 
 
 def barrier(group_name: str = "default") -> None:
     group = _manager.get(group_name)
     if group.backend == "xla":
-        _xla_allreduce(group, np.zeros(1, dtype=np.float32), SUM)
+        group.engine.barrier()
         return
     _roundtrip(group, np.zeros(1), "barrier", "barrier")
 
 
-def send(tensor, dst_rank: int, group_name: str = "default", tag: int = 0) -> None:
-    import ray_tpu
+def _p2p_engine(group: _Group, src: int, dst: int):
+    """Compiled 2-rank submesh for a (src, dst) pair: only those two
+    processes participate in the permute program (a full-group ppermute
+    would require every rank to join each send/recv)."""
+    key = (src, dst)
+    eng = group._p2p_engines.get(key)
+    if eng is None:
+        from jax.sharding import Mesh
 
-    group = _manager.get(group_name)
-    if group.store is None:
-        raise NotImplementedError(
-            "point-to-point send/recv requires the store backend; on the xla "
-            "backend use in-program ppermute via ray_tpu.parallel"
+        from ray_tpu.util.collective.mesh_ops import MeshCollectives
+
+        ici = group.engine.mesh
+        devices = np.asarray(
+            [ici.devices.flat[src], ici.devices.flat[dst]]
         )
-    ray_tpu.get(
-        group.store.send.remote(group.rank, dst_rank, tag, np.asarray(tensor)),
-        timeout=300,
+        eng = MeshCollectives(
+            Mesh(devices, ("p2p",)),
+            axis="p2p",
+            group_name=f"{group.name}:p2p",
+        )
+        group._p2p_engines[key] = eng
+    return eng
+
+
+def _p2p_meta_key(group: _Group, src: int, dst: int, tag: int, seq: int) -> str:
+    return f"p2p_{group.name}_{src}_{dst}_{tag}_{seq}"
+
+
+def _xla_send(group: _Group, tensor, dst_rank: int, tag: int) -> None:
+    import json
+
+    from ray_tpu._private import worker as worker_mod
+
+    np_arr = np.asarray(tensor)
+    key = (group.rank, dst_rank, tag)
+    seq = group._p2p_seq[key] = group._p2p_seq.get(key, 0) + 1
+    # Publish shape/dtype so the receiver can stage its half of the program.
+    core = worker_mod._core()
+    meta = json.dumps({"shape": list(np_arr.shape), "dtype": np_arr.dtype.str})
+    worker_mod.global_worker.run_async(
+        core.gcs.kv_put(
+            _p2p_meta_key(group, group.rank, dst_rank, tag, seq),
+            meta.encode(),
+            ns="collective",
+        )
     )
+    eng = _p2p_engine(group, group.rank, dst_rank)
+    eng.permute(eng.stage_local(np_arr, 0), [(0, 1)])
+
+
+def _xla_recv(group: _Group, src_rank: int, tag: int):
+    import json
+
+    from ray_tpu._private import worker as worker_mod
+
+    key = (src_rank, group.rank, tag)
+    seq = group._p2p_seq[key] = group._p2p_seq.get(key, 0) + 1
+    core = worker_mod._core()
+    kv_key = _p2p_meta_key(group, src_rank, group.rank, tag, seq)
+    meta = None
+    deadline = _time.monotonic() + _OP_TIMEOUT_S
+    while meta is None:
+        val = worker_mod.global_worker.run_async(
+            core.gcs.kv_get(kv_key, ns="collective")
+        )
+        if val:
+            meta = json.loads(val.decode())
+            break
+        if _time.monotonic() > deadline:
+            raise GetTimeoutError(f"recv from rank {src_rank} timed out")
+        _time.sleep(0.05)
+    worker_mod.global_worker.run_async(
+        core.gcs.kv_del(kv_key, ns="collective")
+    )
+    eng = _p2p_engine(group, src_rank, group.rank)
+    zeros = np.zeros(tuple(meta["shape"]), dtype=np.dtype(meta["dtype"]))
+    out = eng.permute(eng.stage_local(zeros, 1, cache=False), [(0, 1)])
+    return eng.rank_shard(out, 1)[0]
+
+
+def send(tensor, dst_rank: int, group_name: str = "default", tag: int = 0) -> None:
+    group = _manager.get(group_name)
+    if group.backend == "xla":
+        _xla_send(group, tensor, dst_rank, tag)
+        return
+    ref = group.store.send.remote(group.rank, dst_rank, tag, np.asarray(tensor))
+    _watched_get(group, ref, "send")
 
 
 def recv(src_rank: int, group_name: str = "default", tag: int = 0):
-    import ray_tpu
-
     group = _manager.get(group_name)
-    if group.store is None:
-        raise NotImplementedError(
-            "point-to-point send/recv requires the store backend; on the xla "
-            "backend use in-program ppermute via ray_tpu.parallel"
-        )
-    return ray_tpu.get(
-        group.store.recv.remote(src_rank, group.rank, tag), timeout=300
-    )
+    if group.backend == "xla":
+        return _xla_recv(group, src_rank, tag)
+    ref = group.store.recv.remote(src_rank, group.rank, tag)
+    return _watched_get(group, ref, "recv")
 
 
 def get_rank(group_name: str = "default") -> int:
@@ -407,3 +635,9 @@ def get_group_mesh(group_name: str = "default"):
     None on the store backend — the group there is a rendezvous actor, not a
     device mesh."""
     return _manager.get(group_name).mesh
+
+
+def get_group_collectives(group_name: str = "default"):
+    """The xla group's MeshCollectives engine (compiled-program cache over
+    the ici mesh); None on the store backend."""
+    return _manager.get(group_name).engine

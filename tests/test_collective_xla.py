@@ -236,3 +236,305 @@ def test_jax_trainer_xla_backend_spmd_parity(ray_xla_cluster, tmp_path):
     y_all = rng.rand(rows, 1).astype(np.float32)
     expected = float(np.mean((x_all @ w - y_all) ** 2))
     assert result.metrics["loss"] == pytest.approx(expected, rel=1e-4)
+
+
+# -- single-process engine tests over the 8-device forced CPU mesh ------------
+#
+# The MeshCollectives engine (mesh_ops.py) is the compiled core of the xla
+# backend: every group op is one cached shard_map program. These tests drive
+# all `world` ranks from one process via stage_parts — the same programs the
+# multi-controller path runs, minus jax.distributed (which the CPU backend
+# does not implement across processes).
+
+ENGINE_WORLD = 8
+
+
+@pytest.fixture(scope="module")
+def engine():
+    from ray_tpu.testing import force_cpu_mesh
+
+    force_cpu_mesh(ENGINE_WORLD)
+    import jax
+    from jax.sharding import Mesh
+
+    from ray_tpu.util.collective.mesh_ops import MeshCollectives
+
+    mesh = Mesh(np.asarray(jax.devices()[:ENGINE_WORLD]), ("world",))
+    return MeshCollectives(mesh, axis="world", group_name="t_engine")
+
+
+def _rank_parts(shape=(4, 6), seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randn(*shape).astype(np.float32) for _ in range(ENGINE_WORLD)]
+
+
+def test_engine_allreduce_ops(engine):
+    from ray_tpu.util.collective import mesh_ops as mo
+
+    parts = _rank_parts()
+    g = engine.stage_parts(parts)
+    np.testing.assert_allclose(
+        np.asarray(engine.allreduce(g, mo.SUM)), np.sum(parts, axis=0),
+        rtol=1e-4, atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(engine.allreduce(g, mo.MAX)), np.max(parts, axis=0)
+    )
+    np.testing.assert_allclose(
+        np.asarray(engine.allreduce(g, mo.MIN)), np.min(parts, axis=0)
+    )
+    pos = [np.abs(p) + 0.1 for p in parts]
+    np.testing.assert_allclose(
+        np.asarray(engine.allreduce(engine.stage_parts(pos), mo.PRODUCT)),
+        np.prod(pos, axis=0),
+        rtol=1e-3,
+    )
+
+
+def test_engine_allgather(engine):
+    parts = _rank_parts(seed=1)
+    out = np.asarray(engine.allgather(engine.stage_parts(parts)))
+    assert out.shape == (ENGINE_WORLD, 4, 6)
+    np.testing.assert_allclose(out, np.stack(parts), rtol=1e-5)
+
+
+def test_engine_reducescatter(engine):
+    from ray_tpu.util.collective import mesh_ops as mo
+
+    parts = _rank_parts(shape=(16, 3), seed=2)
+    g = engine.stage_parts(parts)
+    block = 16 // ENGINE_WORLD
+    red = np.sum(parts, axis=0)
+    out = engine.reducescatter(g, mo.SUM)
+    for r in range(ENGINE_WORLD):
+        np.testing.assert_allclose(
+            engine.rank_shard(out, r), red[r * block : (r + 1) * block],
+            rtol=1e-4, atol=1e-4,
+        )
+    # Non-SUM ops lower to reduce + per-rank dynamic slice.
+    redm = np.max(parts, axis=0)
+    outm = engine.reducescatter(g, mo.MAX)
+    for r in range(ENGINE_WORLD):
+        np.testing.assert_allclose(
+            engine.rank_shard(outm, r), redm[r * block : (r + 1) * block]
+        )
+
+
+@pytest.mark.parametrize("src", [0, 3, 7])
+def test_engine_broadcast_ppermute_tree(engine, src):
+    parts = _rank_parts(seed=3 + src)
+    out = engine.broadcast(engine.stage_parts(parts), src)
+    for r in range(ENGINE_WORLD):
+        np.testing.assert_allclose(engine.rank_shard(out, r)[0], parts[src])
+
+
+def test_engine_permute_send_recv(engine):
+    """ppermute [(src, dst)] is the compiled send/recv hop: dst's row takes
+    src's shard, every non-destination row reads zeros."""
+    parts = _rank_parts(seed=11)
+    out = engine.permute(engine.stage_parts(parts), [(2, 5)])
+    np.testing.assert_allclose(engine.rank_shard(out, 5)[0], parts[2])
+    np.testing.assert_allclose(
+        engine.rank_shard(out, 0)[0], np.zeros_like(parts[0])
+    )
+    # ring shift: every rank passes to its right neighbor
+    ring = [(i, (i + 1) % ENGINE_WORLD) for i in range(ENGINE_WORLD)]
+    out = engine.permute(engine.stage_parts(parts), ring)
+    for r in range(ENGINE_WORLD):
+        np.testing.assert_allclose(
+            engine.rank_shard(out, r)[0], parts[(r - 1) % ENGINE_WORLD]
+        )
+
+
+def test_engine_barrier(engine):
+    engine.barrier()
+    engine.barrier()  # second call reuses the cached staged input + program
+
+
+def test_engine_program_cache_and_staging_cache(engine):
+    parts = _rank_parts(seed=4)
+    token = parts[0]
+    g1 = engine.stage_parts(parts, cache_token=token)
+    engine.allreduce(g1)
+    n_prog = len(engine._programs)
+    hits = engine.stats["stage_hits"]
+    g2 = engine.stage_parts(parts, cache_token=token)
+    assert g2 is g1, "identity-keyed staging cache must hit"
+    assert engine.stats["stage_hits"] == hits + 1
+    engine.allreduce(g2)
+    assert len(engine._programs) == n_prog, (
+        "repeat allreduce of the same (op, shape, dtype) must reuse the "
+        "compiled program"
+    )
+    # stage_local identity cache + invalidation
+    local = parts[1]
+    s1 = engine.stage_local(local, 0)
+    s2 = engine.stage_local(local, 0)
+    assert s2 is s1
+    engine.invalidate(local)
+    assert engine.stage_local(local, 0) is not s1
+
+
+def test_allgather_no_worldx_host_staging(engine):
+    """Regression for the retired one-hot allgather: staging a 1 MiB shard
+    must copy ~1 MiB to devices, not world x 1 MiB (the old path allocated
+    and all-reduced a world-sized zero-padded host buffer per call)."""
+    shard = np.ones((1 << 18,), dtype=np.float32)  # 1 MiB
+    before = engine.stats["staged_bytes"]
+    staged = engine.stage_local(shard, 0, cache=False)
+    copied = engine.stats["staged_bytes"] - before
+    assert copied == shard.nbytes, (
+        f"staging copied {copied} bytes for a {shard.nbytes}-byte shard "
+        f"(world x blowup would be {ENGINE_WORLD * shard.nbytes})"
+    )
+    out = np.asarray(engine.allgather(staged))
+    assert out.shape == (ENGINE_WORLD,) + shard.shape
+    np.testing.assert_allclose(out[0], shard)
+
+
+def test_xla_group_zero_store_roundtrips(engine, monkeypatch):
+    """Acceptance: on the xla backend, allreduce/allgather/reducescatter/
+    broadcast run zero _CollectiveStore actor round trips. The spy wraps
+    ActorMethod.remote (every actor task submission funnels through it) and
+    the store-actor factory; neither may fire."""
+    from ray_tpu import actor as actor_mod
+    from ray_tpu.util import collective as col
+    from ray_tpu.util.collective import collective as col_impl
+
+    submits = []
+    orig = actor_mod.ActorMethod.remote
+
+    def spy(self, *a, **kw):
+        submits.append(self._name)
+        return orig(self, *a, **kw)
+
+    monkeypatch.setattr(actor_mod.ActorMethod, "remote", spy)
+    monkeypatch.setattr(
+        col_impl,
+        "_store_actor_cls",
+        lambda: (_ for _ in ()).throw(
+            AssertionError("xla backend must not build a store actor")
+        ),
+    )
+
+    col.init_collective_group(1, 0, backend="xla", group_name="t_spy")
+    try:
+        group = col_impl._manager.get("t_spy")
+        assert group.store is None
+        assert group.engine is not None
+        x = np.arange(8, dtype=np.float32)
+        np.testing.assert_allclose(col.allreduce(x, "t_spy"), x)
+        got = col.allgather(x, "t_spy")
+        assert len(got) == 1
+        np.testing.assert_allclose(got[0], x)
+        np.testing.assert_allclose(col.reducescatter(x, "t_spy"), x)
+        np.testing.assert_allclose(col.broadcast(x, 0, "t_spy"), x)
+        col.barrier("t_spy")
+    finally:
+        col.destroy_collective_group("t_spy")
+    assert submits == [], f"xla collectives submitted actor tasks: {submits}"
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_on_mesh_parity(engine, causal):
+    """Engine ring attention vs the generic sharded path AND the dense
+    reference: same inputs, allclose."""
+    from ray_tpu.parallel import full_attention, ring_attention_sharded
+
+    B, T, H, D = 2, 32, 4, 16
+    rng = np.random.RandomState(7)
+    q = rng.randn(B, T, H, D).astype(np.float32)
+    k = rng.randn(B, T, H, D).astype(np.float32)
+    v = rng.randn(B, T, H, D).astype(np.float32)
+
+    mesh_out = np.asarray(engine.ring_attention(q, k, v, causal=causal))
+    generic = np.asarray(
+        ring_attention_sharded(
+            q, k, v, engine.mesh, causal=causal, seq_axis="world"
+        )
+    )
+    dense = np.asarray(full_attention(q, k, v, causal=causal))
+    np.testing.assert_allclose(mesh_out, generic, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(mesh_out, dense, rtol=2e-4, atol=2e-4)
+
+
+def test_ulysses_on_mesh_parity(engine):
+    from ray_tpu.parallel import full_attention
+
+    B, T, H, D = 2, 32, 8, 16
+    rng = np.random.RandomState(8)
+    q = rng.randn(B, T, H, D).astype(np.float32)
+    k = rng.randn(B, T, H, D).astype(np.float32)
+    v = rng.randn(B, T, H, D).astype(np.float32)
+    out = np.asarray(engine.ulysses_attention(q, k, v))
+    ref = np.asarray(full_attention(q, k, v))
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_collective_telemetry_families(engine):
+    """Group ops must feed the collective_op_latency_s histogram and the
+    collective_bytes counter (rendered with _total; docs/observability.md)."""
+    import json
+
+    from ray_tpu._private import telemetry
+
+    telemetry.flush_delta("t", "n")  # drain prior tests' observations
+    parts = _rank_parts(seed=9)
+    engine.allreduce(engine.stage_parts(parts))
+    payload = telemetry.flush_delta("t", "n")
+    series = {
+        (m["c"], m["n"]): m for m in (payload or {"metrics": []})["metrics"]
+    }
+    lat = series.get(("collective", "op_latency_s"))
+    assert lat is not None and lat["k"] == "histogram"
+    byt = series.get(("collective", "bytes"))
+    assert byt is not None and byt["k"] == "counter"
+    labels = [dict(json.loads(k)) for k, _ in byt["s"]]
+    assert {"op": "allreduce", "group": "t_engine"} in labels
+    contributed = sum(
+        v for k, v in byt["s"]
+        if dict(json.loads(k)) == {"op": "allreduce", "group": "t_engine"}
+    )
+    assert contributed == parts[0].nbytes
+
+
+def test_store_backend_participant_death_raises_typed_error(
+    ray_start_regular,
+):
+    """Satellite: a rank dying mid-collective fails the group op with
+    CollectiveGroupDiedError well inside the op deadline — never a hang."""
+    import time
+
+    from ray_tpu.util.collective import CollectiveGroupDiedError
+
+    @ray_tpu.remote(num_cpus=1)
+    class Rank:
+        def __init__(self, rank):
+            from ray_tpu.util import collective as col
+
+            col.init_collective_group(
+                2, rank, backend="store", group_name="t_death"
+            )
+
+        def ready(self):
+            return True
+
+        def reduce(self):
+            from ray_tpu.util import collective as col
+
+            col.allreduce(np.ones(4, dtype=np.float32), "t_death")
+            return "completed"
+
+    a, b = Rank.remote(0), Rank.remote(1)
+    assert ray_tpu.get([a.ready.remote(), b.ready.remote()], timeout=60)
+    # Rank 0 blocks in the rendezvous (rank 1 never contributes)...
+    ref = a.reduce.remote()
+    time.sleep(1.0)
+    # ...then rank 1 dies mid-collective.
+    ray_tpu.kill(b)
+    t0 = time.monotonic()
+    with pytest.raises(CollectiveGroupDiedError):
+        ray_tpu.get(ref, timeout=60)
+    assert time.monotonic() - t0 < 30, (
+        "death detection must beat the op deadline by a wide margin"
+    )

@@ -1,0 +1,180 @@
+"""Compiled device tensor channels (DeviceTensorChannel): the shm slot
+carries a control frame and the payload hops device-to-device through a
+cached compiled ppermute program (docs/collectives.md).
+
+On the CPU-forced 8-device mesh the channel runs in "loopback" mode — the
+hop executes for real (device 0 -> device N over the virtual mesh) and the
+dst-device array is handed to a same-process reader, while the frame also
+carries the raw bytes so a cross-process reader degrades to the
+TensorChannel wire instead of deadlocking. The true multi-controller "ici"
+mode shares all of this machinery minus the byte fallback; the CPU backend
+cannot form cross-process XLA computations, so that path is exercised on
+hardware via the MULTICHIP harness.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cpu():
+    from ray_tpu.testing import force_cpu_mesh
+
+    force_cpu_mesh(8)
+
+
+def _pair(name, meta=None, size=1 << 22):
+    from ray_tpu.dag.tensor_channel import DeviceTensorChannel
+
+    w = DeviceTensorChannel(name, size, create=True, meta=meta)
+    r = DeviceTensorChannel(name, size, meta=meta)
+    return w, r
+
+
+def test_device_channel_loopback_hop():
+    """Same-process read returns the hopped dst-device array — the payload
+    crossed the mesh, not the shm slot."""
+    import jax
+
+    w, r = _pair("rtdag_test_dev1", meta={"src": 0, "dst": 3})
+    try:
+        arr = np.arange(64, dtype=np.float32).reshape(8, 8)
+        w.write(arr)
+        assert w._mode == "loopback"
+        out = r.read(timeout=10)
+        assert isinstance(out, jax.Array)
+        assert list(out.devices())[0] == jax.devices()[3], (
+            "payload must land on the dst device"
+        )
+        np.testing.assert_array_equal(np.asarray(out), arr)
+    finally:
+        w.close(unlink=True)
+
+
+def test_device_channel_ok_wire_tuple():
+    """The exec-loop ("ok", value) wire survives the device path."""
+    w, r = _pair("rtdag_test_dev2", meta={"src": 0, "dst": 1})
+    try:
+        arr = np.full((16, 16), 2.5, dtype=np.float32)
+        w.write(("ok", arr))
+        kind, val = r.read(timeout=10)
+        assert kind == "ok"
+        np.testing.assert_array_equal(np.asarray(val), arr)
+    finally:
+        w.close(unlink=True)
+
+
+def test_device_channel_nonarray_falls_back_to_shm():
+    """STOP sentinels, dicts, and error wires ride the inherited pickle
+    path in every mode (teardown must work identically)."""
+    from ray_tpu.dag.exec_loop import STOP
+
+    w, r = _pair("rtdag_test_dev3", meta={"src": 0, "dst": 2})
+    try:
+        w.write({"cfg": [1, 2]})
+        assert r.read(timeout=10) == {"cfg": [1, 2]}
+        w.write(("err", "boom"))
+        assert r.read(timeout=10) == ("err", "boom")
+        w.write(STOP)
+        assert r.read(timeout=10) == STOP
+    finally:
+        w.close(unlink=True)
+
+
+def test_device_channel_cross_process_degrade():
+    """A reader that missed the device slot (different process in real
+    deployments) decodes the control frame's byte payload instead."""
+    from ray_tpu.dag import tensor_channel as tc
+
+    w, r = _pair("rtdag_test_dev4", meta={"src": 0, "dst": 5})
+    try:
+        arr = np.arange(100, dtype=np.int64).reshape(10, 10)
+        w.write(arr)
+        # Simulate the reader living in another process: no parked slot.
+        tc._DEVICE_SLOTS.pop("rtdag_test_dev4", None)
+        out = r.read(timeout=10)
+        assert isinstance(out, np.ndarray) and out.dtype == np.int64
+        np.testing.assert_array_equal(out, arr)
+    finally:
+        w.close(unlink=True)
+
+
+def test_make_channel_dispatches_device_kind():
+    from ray_tpu.dag.channel import make_channel
+    from ray_tpu.dag.tensor_channel import DeviceTensorChannel
+
+    spec = ("rtdag_test_dev5", 1 << 20, "device",
+            {"group": "g1", "src": 2, "dst": 6})
+    ch = make_channel(spec, create=True)
+    try:
+        assert isinstance(ch, DeviceTensorChannel)
+        assert ch.group_name == "g1" and ch.src == 2 and ch.dst == 6
+    finally:
+        ch.close(unlink=True)
+
+
+def test_device_channel_program_reuse():
+    """Repeat writes of the same (shape, dtype) reuse one compiled permute
+    program — the per-message cost is staging + dispatch, not retracing."""
+    w, r = _pair("rtdag_test_dev6", meta={"src": 0, "dst": 7})
+    try:
+        for i in range(3):
+            w.write(np.full((32, 32), float(i), dtype=np.float32))
+            out = r.read(timeout=10)
+            assert float(np.asarray(out)[0, 0]) == float(i)
+        assert len(w._engine._programs) == 1
+    finally:
+        w.close(unlink=True)
+
+
+def test_compiled_dag_device_transport_end_to_end(ray_start_regular):
+    """A compiled DAG edge annotated with_tensor_transport("device"): the
+    producer actor's writes take the device path (loopback hop on the CPU
+    mesh), the consumer decodes the frame, values stay exact, and teardown's
+    STOP sentinel crosses the same channel."""
+    from ray_tpu import dag
+
+    @ray_tpu.remote
+    class Producer:
+        def make(self, seed):
+            return np.full((128, 128), float(seed), dtype=np.float32)
+
+    @ray_tpu.remote
+    class Consumer:
+        def total(self, x):
+            return float(np.asarray(x).sum())
+
+    p, c = Producer.remote(), Consumer.remote()
+    with dag.InputNode() as inp:
+        graph = c.total.bind(
+            p.make.bind(inp).with_tensor_transport(
+                "device", group_name="dag_g", src=0, dst=1
+            )
+        )
+    compiled = graph.experimental_compile()
+    try:
+        for i in (1, 2, 5):
+            assert compiled.execute(i).get() == 128 * 128 * i
+    finally:
+        compiled.teardown()
+
+
+def test_device_edge_spec_kind():
+    """Graph compilation marks producer-annotated actor->actor edges as
+    "device" specs carrying the group/src/dst meta; driver-facing edges
+    degrade to "tensor"."""
+    from ray_tpu.dag.nodes import ClassMethodNode, InputNode
+
+    class _FakeHandle:
+        _actor_id = "a1"
+
+    node = ClassMethodNode(_FakeHandle(), "m", (), {})
+    node.with_tensor_transport("device", group_name="g", src=1, dst=2)
+    assert node._tensor_transport == "device"
+    assert node._transport_meta == {"group": "g", "src": 1, "dst": 2}
+    inp = InputNode()
+    inp.with_tensor_transport("device")
+    # InputNode edges are written by the driver: never "device".
+    from ray_tpu.dag.compiled import CompiledDAG  # noqa: F401 (import check)

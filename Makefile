@@ -68,6 +68,7 @@ LATENCY_SEEDS ?= 10
 SCHED_SEEDS ?= 10
 RECOVERY_SEEDS ?= 10
 COLLECTIVE_SEEDS ?= 5
+HA_SEEDS ?= 10
 chaos:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m ray_tpu.chaos --check-determinism \
 		--suite full --seeds $(SEEDS)
@@ -79,5 +80,7 @@ chaos:
 		--seeds $(SCHED_SEEDS)
 	env JAX_PLATFORMS=cpu $(PYTHON) -m ray_tpu.chaos \
 		--suite recovery_durable --seeds $(RECOVERY_SEEDS)
+	env JAX_PLATFORMS=cpu $(PYTHON) -m ray_tpu.chaos \
+		--suite ha --seeds $(HA_SEEDS)
 	env JAX_PLATFORMS=cpu $(PYTHON) -m ray_tpu.chaos \
 		--suite collective --seeds $(COLLECTIVE_SEEDS)

@@ -200,6 +200,24 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     # WAL log-size threshold that triggers snapshot compaction (the full
     # table state is rewritten as one frame and the log truncated).
     "gcs_wal_compact_bytes": 4 * 1024 * 1024,
+    # ---- HA control plane (gcs_ha.py, docs/fault_tolerance.md §HA). ----
+    # Follower count for gcs_persist_backend=replicated: every ack'd write
+    # is appended to the primary log AND this many follower logs before the
+    # caller's put() resolves (synchronous log shipping; machine loss of
+    # the primary leaves a complete copy on each follower).
+    "gcs_replication_followers": 1,
+    # Leadership lease duration. The leader re-asserts its leadership
+    # record every lease/3; a standby promotes when the record's deadline
+    # is this far in the past (plus one grace interval to absorb clock
+    # skew between renew and tail-observation).
+    "gcs_leader_lease_s": 2.0,
+    # How often the warm standby polls the replicated log tail for new
+    # frames and leadership-record changes.
+    "gcs_standby_poll_s": 0.1,
+    # Path of the leader pointer file ("host port\n", atomically replaced
+    # on promotion) that cross-process clients resolve before re-dialing.
+    # Empty → derived as <persist_path>.leader next to the store.
+    "gcs_leader_file": "",
     # Echo captured worker stdout/stderr to the driver (reference:
     # ray.init(log_to_driver=True) + log_monitor.py streaming).
     "log_to_driver": True,

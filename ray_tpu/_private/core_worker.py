@@ -1093,11 +1093,17 @@ class CoreWorker:
         is_driver: bool,
         worker_id: str,
         server: rpc.Server,
+        gcs_leader_file: Optional[str] = None,
     ):
         self.job_id = job_id
         self.session_name = session_name
         self.node_id = node_id
-        self.gcs = GcsClient(gcs_conn)
+        resolver = None
+        if gcs_leader_file:
+            from ray_tpu._private import gcs_ha
+
+            resolver = gcs_ha.file_resolver(gcs_leader_file)
+        self.gcs = GcsClient(gcs_conn, resolver=resolver)
         self.raylet_conn = raylet_conn
         self.is_driver = is_driver
         self.worker_id = worker_id

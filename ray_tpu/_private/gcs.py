@@ -144,8 +144,10 @@ class GcsServer:
         port: int = 0,
         session_name: str = "",
         persist_path: Optional[str] = None,
+        persist_backend: Optional[str] = None,
+        term: Optional[int] = None,
     ):
-        from ray_tpu._private.gcs_store import make_store
+        from ray_tpu._private.gcs_store import ReplicatedStoreClient, make_store
 
         self.server = rpc.Server(host, port)
         self.session_name = session_name
@@ -248,7 +250,26 @@ class GcsServer:
         # Persistence (reference: StoreClient, store_client.h:33). The live
         # state above stays the source of truth; mutations write through to
         # the store, and a restarted GCS reloads it (GCS fault tolerance).
-        self.store = make_store(persist_path)
+        #
+        # HA (gcs_persist_backend=replicated, docs/fault_tolerance.md §HA):
+        # the store ships every write to follower logs and carries a
+        # leadership term. ``term`` is set by a promoting standby; a fresh
+        # start (or restart-in-place) re-asserts leadership at
+        # recovered_term + 1 — every leadership is a new term, so a
+        # survivor of the old one is fenced the moment we open the store.
+        self.leader_term = 0
+        self.fenced = False
+        self._persist_path = persist_path
+        self.store = make_store(
+            persist_path,
+            backend=persist_backend,
+            term=term,
+            on_fenced=self._on_store_fenced,
+        )
+        if isinstance(self.store, ReplicatedStoreClient):
+            if term is None:
+                self.store.set_term(self.store.term + 1)
+            self.leader_term = self.store.term
         self._load_from_store()
         self._register_handlers()
 
@@ -355,8 +376,62 @@ class GcsServer:
             self._spawn(self._reconcile_restored_actors())
         if any(g.state == PG_CREATED for g in self.placement_groups.values()):
             self._spawn(self._reconcile_restored_pgs())
+        if self.leader_term:
+            # HA: assert leadership (record + pointer file) before serving
+            # traffic, then keep the lease renewed from a background loop.
+            from ray_tpu._private import gcs_ha
+
+            gcs_ha.write_leadership(self.store, self.leader_term, addr)
+            gcs_ha.write_leader_file(
+                gcs_ha.leader_file_path(self._persist_path), *addr
+            )
+            gcs_ha.note_role(leader=True)
+            self._spawn(self._leader_lease_loop(addr))
         logger.info("gcs listening on %s:%s", *addr)
         return addr
+
+    async def _leader_lease_loop(self, addr) -> None:
+        """Re-assert the leadership record (term + deadline) every third of
+        the lease. A write rejected by the store's fence means a standby
+        promoted past us — ``_on_store_fenced`` demotes; this loop just
+        stops renewing."""
+        from ray_tpu._private import gcs_ha
+        from ray_tpu._private.rpc import StaleLeaderError
+
+        while not self._stopping and not self.fenced:
+            await asyncio.sleep(config.gcs_leader_lease_s / 3.0)
+            if self._stopping or self.fenced:
+                return
+            try:
+                gcs_ha.write_leadership(self.store, self.leader_term, addr)
+            except StaleLeaderError:
+                return  # the store's on_fenced callback owns the demotion
+
+    def _on_store_fenced(self) -> None:
+        """Store callback: a write from our term bounced off a newer fence.
+        We are no longer the leader — stop serving cleanly (reads included:
+        a fenced GCS's view diverges from the real one immediately)."""
+        if self.fenced or self._stopping:
+            self.fenced = True
+            return
+        self.fenced = True
+        logger.warning(
+            "gcs leadership term %d fenced by a newer leader: demoting",
+            self.leader_term,
+        )
+        from ray_tpu._private import gcs_ha
+
+        gcs_ha.note_role(leader=False)
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return
+        # Short drain window before tearing the server down: the write that
+        # discovered the fence is mid-dispatch, and its typed
+        # StaleLeaderError reply must reach the caller before the transport
+        # closes. The store is already fenced, so nothing can be acked in
+        # the window — only rejections and stale reads escape.
+        loop.call_later(0.1, lambda: rpc.spawn(self.stop()))
 
     async def _health_check_loop(self) -> None:
         """Active node health probing (reference: gcs_health_check_manager.cc
@@ -1156,7 +1231,12 @@ class GcsServer:
         # saw and pulls a snapshot if publishes happened in between. The
         # epoch distinguishes "same publisher, you missed n messages" from
         # "new publisher (GCS restart), seqs restarted — resync".
-        return {"ok": True, "seq": seq, "pub_epoch": self.publisher.epoch}
+        return {
+            "ok": True,
+            "seq": seq,
+            "pub_epoch": self.publisher.epoch,
+            "leader_term": self.leader_term,
+        }
 
     async def _unsubscribe(self, conn, p):
         self.publisher.unsubscribe(p["channel"], conn)
@@ -1188,12 +1268,17 @@ class GcsServer:
             "snapshot": snap,
             "seq": self.publisher.seqnos.get(channel, 0),
             "pub_epoch": self.publisher.epoch,
+            "leader_term": self.leader_term,
         }
 
     def _publish_msg(self, channel: str, msg: Any) -> None:
         """Non-blocking fan-out: per-subscriber bounded queues + dedicated
         drain tasks (a slow subscriber drops ITS backlog, never stalls the
-        control plane)."""
+        control plane). Under HA every control-plane record carries the
+        leader term, so a subscriber can drop a stale pre-failover message
+        that arrives after it has seen the new leader."""
+        if self.leader_term and isinstance(msg, dict):
+            msg = {**msg, "leader_term": self.leader_term}
         self.publisher.publish(channel, msg)
 
     # -- jobs ---------------------------------------------------------------
@@ -1505,17 +1590,24 @@ class GcsClient:
     restarts (NotifyGCSRestart, node_manager.proto:373; retryable gRPC
     client + gcs_rpc_client.h failover call queue)."""
 
-    def __init__(self, conn: rpc.Connection):
+    def __init__(self, conn: rpc.Connection, resolver=None):
         self.conn = conn
+        self._resolver = resolver
         self._sub_handlers: Dict[str, List] = {}
         self._handlers = conn._handlers
         self._handlers.setdefault("Pub", self._on_pub)
         self._handlers.setdefault("PubBatch", self._on_pub_batch)
         # Per-channel last-seen publish seqno + publisher epoch (gap
-        # detection; see Publisher docstring and docs/fault_tolerance.md).
+        # detection; see Publisher docstring and docs/fault_tolerance.md)
+        # and leader term (HA: a term change is a new control plane — a
+        # snapshot pull is mandatory even when epoch/seq happen to align).
         self._sub_seq: Dict[str, int] = {}
         self._sub_epoch: Dict[str, str] = {}
+        self._sub_term: Dict[str, int] = {}
         self._on_reconnect: List = []
+        # ``resolver``: async () -> (host, port) | None, consulted before
+        # every redial so the client follows the current GCS leader across
+        # failover instead of re-dialing the dead primary (gcs_ha.py).
         self._rc = rpc.RetryableConnection(
             self._redial,
             conn=conn,
@@ -1523,6 +1615,7 @@ class GcsClient:
             default_retry=wire.RETRY_SAFE,
             on_reconnect=self._post_reconnect,
             name="gcs",
+            resolver=resolver,
         )
 
     def on_reconnect(self, fn) -> None:
@@ -1539,15 +1632,26 @@ class GcsClient:
         GCS by re-registering through the reconnect path."""
         await self._rc.close()
 
-    async def _redial(self) -> rpc.Connection:
-        addr = self.conn.remote_addr or self.conn.peername
+    async def _redial(self, addr=None) -> rpc.Connection:
+        addr = addr or self.conn.remote_addr or self.conn.peername
         if addr is None:
             raise rpc.ConnectionLost("gcs connection lost (no address to redial)")
+        # With a resolver, each dial must give up fast: the resolved address
+        # may be a dead primary whose leader file hasn't flipped yet, and
+        # the resolver is only re-consulted between dial attempts — a 30s
+        # dial budget would pin the dead address across the whole failover.
+        # Without one the address is fixed, so patience is the right move
+        # (a restarting GCS comes back on the same port).
+        policy = (
+            rpc.RetryPolicy.for_dial()
+            if self._resolver is not None
+            else rpc.RetryPolicy.for_calls()
+        )
         conn = await rpc.connect(
             addr[0],
             addr[1],
             handlers=self._handlers,
-            policy=rpc.RetryPolicy.for_calls(),
+            policy=policy,
         )
         conn.remote_addr = tuple(addr)
         return conn
@@ -1572,17 +1676,26 @@ class GcsClient:
         """Compare the resubscribe baseline with the last seq we saw: an
         advanced seq (missed publishes while disconnected) or a changed
         publisher epoch (GCS restart — seqs restarted from zero) both mean
-        our picture may be stale, so pull a snapshot."""
+        our picture may be stale, so pull a snapshot. A changed *leader
+        term* (HA failover) is unconditionally stale: the new leader
+        rebuilt its state from the replicated log, so even aligned seqnos
+        describe a different history — the snapshot pull is mandatory."""
         seq, epoch = reply.get("seq"), reply.get("pub_epoch")
+        term = reply.get("leader_term")
         if seq is None:
             return
         last = self._sub_seq.get(channel)
+        last_term = self._sub_term.get(channel)
         stale = last is not None and (
-            self._sub_epoch.get(channel) != epoch or seq > last
+            self._sub_epoch.get(channel) != epoch
+            or seq > last
+            or (term is not None and last_term is not None and term != last_term)
         )
         self._sub_seq[channel] = seq
         if epoch is not None:
             self._sub_epoch[channel] = epoch
+        if term is not None:
+            self._sub_term[channel] = term
         if stale:
             self._note_gap(channel, "resubscribe")
 
@@ -1597,6 +1710,17 @@ class GcsClient:
             await self._dispatch_pub(channel, msg, seq)
 
     async def _dispatch_pub(self, channel: str, msg, seq) -> None:
+        if isinstance(msg, dict) and "leader_term" in msg:
+            term = msg["leader_term"]
+            known = self._sub_term.get(channel)
+            if known is not None and term < known:
+                # Stale pre-failover message that outlived its leader
+                # (buffered on the old link, delivered after promotion):
+                # never deliver it — we already follow a newer term.
+                self._note_gap(channel, "stale-term")
+                return
+            if known is None or term > known:
+                self._sub_term[channel] = term
         if seq is not None:
             last = self._sub_seq.get(channel)
             if last is not None:
@@ -1638,6 +1762,9 @@ class GcsClient:
             self._sub_seq[channel] = seq
         if epoch is not None:
             self._sub_epoch[channel] = epoch
+        term = reply.get("leader_term")
+        if term is not None and term > self._sub_term.get(channel, -1):
+            self._sub_term[channel] = term
         snap = reply.get("snapshot")
         if snap is not None:
             await self._deliver(channel, snap)
@@ -1660,6 +1787,8 @@ class GcsClient:
             self._sub_seq[channel] = seq
             if epoch is not None:
                 self._sub_epoch[channel] = epoch
+            if reply.get("leader_term") is not None:
+                self._sub_term[channel] = reply["leader_term"]
         if snapshot:
             try:
                 snap = (await self.call("Snapshot", {"channel": channel}))[

@@ -1,0 +1,236 @@
+"""HA control plane: leadership records, leader resolution, warm standby.
+
+The replicated store (gcs_store.ReplicatedStoreClient) gives the GCS a
+log that survives machine loss; this module turns that into a highly
+available control plane (reference: the GCS-backed-by-Redis deployment
+plus its "who is leader" coordination, in miniature):
+
+- **Leadership record**: the serving GCS writes ``meta/leadership`` —
+  ``{term, deadline, host, port}`` — through the replicated store and
+  renews it every third of ``gcs_leader_lease_s``. The write itself is
+  the fencing primitive: it carries the writer's term, so a deposed
+  leader's renewal bounces off the store's fence with StaleLeaderError
+  and the GCS demotes (stops serving) instead of split-braining.
+- **Warm standby** (``GcsStandby``): tails a follower log from disk
+  (ReplicaTailer — the cross-process analog of a follower applying its
+  shipped stream), watches the leadership record, and when the lease
+  deadline expires unrenewed, promotes: builds a ``GcsServer`` over the
+  replicated store at ``term + 1``. Opening the store at the new term
+  raises the fence on every member before the first write, and the new
+  server's fresh publisher epoch + term-stamped records drive every
+  resubscribing client through a snapshot pull (docs/fault_tolerance.md).
+- **Leader pointer file**: ``<persist_path>.leader`` holds "host port",
+  atomically replaced on every (re)election. ``file_resolver`` adapts it
+  to RetryableConnection's pluggable resolver so raylets/workers re-dial
+  the *current* leader, not the dead primary's address.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+from typing import Optional, Tuple
+
+import msgpack
+
+from ray_tpu._private import telemetry
+from ray_tpu._private.common import config
+
+logger = logging.getLogger(__name__)
+
+LEADERSHIP_TABLE = "meta"
+LEADERSHIP_KEY = "leadership"
+
+_TEL_ROLE = telemetry.gauge(
+    "gcs", "role", "this process's GCS role: 1 leader, 0 standby/demoted"
+)
+_TEL_FAILOVERS = telemetry.counter(
+    "gcs", "failovers", "standby promotions to leader"
+)
+
+
+def note_role(leader: bool) -> None:
+    _TEL_ROLE.set(1.0 if leader else 0.0)
+
+
+def note_failover() -> None:
+    _TEL_FAILOVERS.inc()
+
+
+# -- leadership record -------------------------------------------------------
+
+
+def write_leadership(store, term: int, addr: Tuple[str, int]) -> None:
+    """One lease assertion/renewal: term + fresh deadline, written through
+    the (fencing) store. Raises StaleLeaderError if a newer leader exists."""
+    rec = {
+        "term": term,
+        "deadline": time.time() + config.gcs_leader_lease_s,
+        "host": addr[0],
+        "port": addr[1],
+    }
+    store.put(
+        LEADERSHIP_TABLE, LEADERSHIP_KEY, msgpack.packb(rec, use_bin_type=True)
+    )
+    # The record IS the lease: it must be on the followers before the
+    # deadline means anything, not parked in the group-commit buffer.
+    if hasattr(store, "flush"):
+        store.flush()
+
+
+def read_leadership(source) -> Optional[dict]:
+    """Decode the leadership record from anything with ``get(table, key)``
+    (a StoreClient or a ReplicaTailer)."""
+    blob = source.get(LEADERSHIP_TABLE, LEADERSHIP_KEY)
+    if not blob:
+        return None
+    return msgpack.unpackb(blob, raw=False)
+
+
+# -- leader pointer file -----------------------------------------------------
+
+
+def leader_file_path(persist_path: Optional[str]) -> Optional[str]:
+    if config.gcs_leader_file:
+        return config.gcs_leader_file
+    if not persist_path:
+        return None
+    return persist_path + ".leader"
+
+
+def write_leader_file(path: Optional[str], host: str, port: int) -> None:
+    """Atomically publish the serving address (tmp + rename, so a reader
+    never sees a half-written pointer)."""
+    if not path:
+        return
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(f"{host} {port}\n")
+    os.replace(tmp, path)
+
+
+def resolve_leader_file(path: Optional[str]) -> Optional[Tuple[str, int]]:
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            host, port = f.read().split()
+        return host, int(port)
+    except (OSError, ValueError):
+        return None
+
+
+def file_resolver(path: Optional[str]):
+    """RetryableConnection ``resolver`` over the leader pointer file; None
+    (no file yet / unreadable) keeps the last known address."""
+
+    async def _resolve() -> Optional[Tuple[str, int]]:
+        return resolve_leader_file(path)
+
+    return _resolve
+
+
+# -- warm standby ------------------------------------------------------------
+
+
+class GcsStandby:
+    """Warm-standby GCS: tails the replicated log and promotes itself when
+    the leader's lease expires unrenewed.
+
+    The standby holds the whole control-plane state as a live mirror (the
+    tailer applies every shipped frame as it lands), so promotion is
+    bounded by recovery *reconciliation* — requeueing in-flight actor/PG
+    placements — not by replaying history. ``on_promote(server)`` fires
+    after the new server is listening; ``promoted`` is set for waiters.
+    """
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        session_name: str = "",
+        persist_path: Optional[str] = None,
+        on_promote=None,
+    ):
+        from ray_tpu._private.gcs_store import ReplicaTailer, follower_paths
+
+        if not persist_path:
+            raise ValueError("a standby requires a replicated persist path")
+        self.host = host
+        self.port = port
+        self.session_name = session_name
+        self.persist_path = persist_path
+        self.tailer = ReplicaTailer(follower_paths(persist_path)[0])
+        self.server = None  # GcsServer once promoted
+        self.promoted = asyncio.Event()
+        self._on_promote = on_promote
+        self._task: Optional[asyncio.Task] = None
+        self._stopped = False
+
+    async def start(self) -> "GcsStandby":
+        from ray_tpu._private import rpc
+
+        note_role(leader=False)
+        self.tailer.poll()
+        self._task = rpc.spawn(self._watch_loop())
+        return self
+
+    async def _watch_loop(self) -> None:
+        grace = config.gcs_leader_lease_s / 3.0
+        while not self._stopped:
+            await asyncio.sleep(config.gcs_standby_poll_s)
+            self.tailer.poll()
+            rec = read_leadership(self.tailer)
+            if rec is None:
+                continue  # no leader has ever asserted: nothing to succeed
+            if time.time() <= rec["deadline"] + grace:
+                continue
+            try:
+                await self._promote(rec["term"] + 1)
+            except Exception:
+                # Lost the promotion race (another standby fenced past us)
+                # or the store is gone; either way this standby is done.
+                logger.exception("standby promotion at term %d failed",
+                                 rec["term"] + 1)
+            return
+
+    async def _promote(self, term: int) -> None:
+        from ray_tpu._private.gcs import GcsServer
+
+        logger.warning(
+            "gcs leader lease expired: standby promoting at term %d", term
+        )
+        t0 = time.perf_counter()
+        server = GcsServer(
+            self.host,
+            self.port,
+            session_name=self.session_name,
+            persist_path=self.persist_path,
+            persist_backend="replicated",
+            term=term,
+        )
+        await server.start()  # writes leadership record + leader file
+        self.server = server
+        note_failover()
+        telemetry.record_event(
+            "gcs", "failover", term=term, promote_s=time.perf_counter() - t0
+        )
+        self.promoted.set()
+        if self._on_promote is not None:
+            res = self._on_promote(server)
+            if asyncio.iscoroutine(res):
+                await res
+
+    async def stop(self) -> None:
+        """Stop watching; if promoted, the served GcsServer is stopped too."""
+        self._stopped = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+        if self.server is not None:
+            await self.server.stop()

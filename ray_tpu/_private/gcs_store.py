@@ -34,6 +34,7 @@ domain: kv, actors, named, jobs, pgs).
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
 import os
 import sqlite3
 import struct
@@ -407,6 +408,597 @@ class WalStoreClient(StoreClient):
             os.close(self._fd)
 
 
+# -- Replicated backend ------------------------------------------------------
+#
+# Same physical framing as the wal backend, but the body grows to
+# [op, table, key, value, term, seq]:
+#
+# - ``term`` is the writer's leadership term. Every member tracks the
+#   highest term it has ever accepted (its *fence*); an append from an
+#   older term raises StaleLeaderError instead of landing — the mechanism
+#   that stops a deposed, partitioned primary from split-braining the
+#   actor/PG tables after a standby promoted (reference: Redis
+#   replication + the GCS's "who is leader" record; Raft's term check in
+#   miniature).
+# - ``seq`` is the writer's monotonic log position, identical across
+#   members (every member receives the same stream), used to pick the
+#   freshest member on open and to bring stale members up via a snapshot
+#   frame ("snap" carries the full tables plus the term/seq watermark).
+#
+# A replication *group* is one primary log plus N follower logs (default
+# paths ``<path>.follower<i>``), each modeling an independent store
+# process on another host. ``put``/``delete`` ack only after the frame is
+# appended to every member under the ``gcs_store_sync`` contract —
+# synchronous log shipping, so machine loss of the primary leaves a
+# complete acknowledged copy on each follower.
+
+
+def _parse_replicated(data: bytes):
+    """Replay a replicated-format log: returns (tables, term, seq,
+    good_offset). Torn/corrupt tails stop the replay exactly like the wal
+    backend; legacy 4-field frames are accepted with term=0/seq untouched
+    so a plain wal file can be adopted into a group."""
+    tables: Dict[str, Dict[str, bytes]] = {}
+    term = 0
+    seq = 0
+    off = 0
+    good = 0
+    while off + _HDR.size <= len(data):
+        blen, crc = _HDR.unpack_from(data, off)
+        body = data[off + _HDR.size : off + _HDR.size + blen]
+        if len(body) < blen or zlib.crc32(body) != crc:
+            break
+        fields = msgpack.unpackb(body, raw=False)
+        op, table, key, value = fields[:4]
+        if len(fields) >= 6:
+            term = max(term, fields[4])
+            seq = max(seq, fields[5])
+        if op == "snap":
+            tables = {
+                t: dict(kv)
+                for t, kv in msgpack.unpackb(value, raw=False).items()
+            }
+        elif op == "put":
+            tables.setdefault(table, {})[key] = value
+        else:
+            tables.get(table, {}).pop(key, None)
+        off += _HDR.size + blen
+        good = off
+    return tables, term, seq, good
+
+
+def _rframe(op, table, key, value, term, seq) -> bytes:
+    body = msgpack.packb(
+        [op, table, key, value, term, seq], use_bin_type=True
+    )
+    return _HDR.pack(len(body), zlib.crc32(body)) + body
+
+
+class _ReplicaLog:
+    """One member of a replication group: an append-only log file plus the
+    fence state a real follower process would hold. Instances are shared
+    in-process through a registry keyed by path, so a deposed leader's
+    store client and the promoted leader's client hit the *same* fence —
+    the in-process model of a follower rejecting a stale leader's
+    shipped stream."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.path = path
+        self._lock = threading.Lock()
+        self._refs = 0
+        data = b""
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                data = f.read()
+        _, term, seq, good = _parse_replicated(data)
+        if good < len(data):
+            with open(path, "r+b") as f:
+                f.truncate(good)
+        self.fence_term = term
+        self.term = term
+        self.seq = seq
+        self._fd = os.open(path, os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
+        self.log_bytes = good
+
+    def raise_fence(self, term: int) -> None:
+        """Adopt ``term`` as the minimum acceptable leader term. Called on
+        open/promotion so a new leader fences the old one before its
+        first write, not after."""
+        with self._lock:
+            if term > self.fence_term:
+                self.fence_term = term
+                _FENCE_GEN[0] += 1
+
+    def append(self, buf: bytes, term: int, seq: int, sync: str) -> None:
+        """Accept one shipped group-commit from leader ``term`` ending at
+        ``seq``; reject stale terms with StaleLeaderError."""
+        from ray_tpu._private.rpc import StaleLeaderError  # lazy: no cycle at import
+
+        with self._lock:
+            if term < self.fence_term:
+                raise StaleLeaderError(
+                    f"append from term {term} rejected by "
+                    f"replica {os.path.basename(self.path)} "
+                    f"(fence at term {self.fence_term})"
+                )
+            if term > self.fence_term:
+                self.fence_term = term
+                _FENCE_GEN[0] += 1
+            os.write(self._fd, buf)
+            if sync != "off":
+                os.fsync(self._fd)
+            self.term = term
+            self.seq = seq
+            self.log_bytes += len(buf)
+
+    def reset_with(self, snap: bytes, term: int, seq: int, sync: str) -> None:
+        """Replace the whole log with one snapshot frame (compaction, and
+        catch-up of a stale member): temp file + atomic rename, same
+        crash-safety argument as WalStoreClient._compact."""
+        with self._lock:
+            tmp = self.path + ".compact"
+            fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+            try:
+                os.write(fd, snap)
+                if sync != "off":
+                    os.fsync(fd)
+            finally:
+                os.close(fd)
+            os.rename(tmp, self.path)
+            os.close(self._fd)
+            self._fd = os.open(self.path, os.O_WRONLY | os.O_APPEND)
+            self.term = term
+            self.seq = seq
+            if term > self.fence_term:
+                self.fence_term = term
+                _FENCE_GEN[0] += 1
+            self.log_bytes = len(snap)
+
+    def write_unsynced(self, buf: bytes) -> None:
+        """crash() path: the buffered tail reaches the OS, no fsync."""
+        with self._lock:
+            try:
+                os.write(self._fd, buf)
+                self.log_bytes += len(buf)
+            except OSError:
+                pass
+
+    # registry refcounting: the fd stays open while any client holds the
+    # replica; the last release closes it and drops the registry entry.
+
+    def _acquire(self) -> None:
+        self._refs += 1
+
+    def _release(self) -> None:
+        self._refs -= 1
+        if self._refs <= 0:
+            with self._lock:
+                try:
+                    os.close(self._fd)
+                except OSError:
+                    pass
+            with _REGISTRY_LOCK:
+                if _REPLICAS.get(self.path) is self:
+                    del _REPLICAS[self.path]
+
+
+_REPLICAS: Dict[str, "_ReplicaLog"] = {}
+_REGISTRY_LOCK = threading.Lock()
+# Global fence generation: bumped whenever ANY member's fence rises, so
+# client put/delete can skip the per-write max() over members (the hot
+# path of every GCS mutation) and only re-derive the fence after a bump.
+_FENCE_GEN = [0]
+
+
+def _open_replica(path: str) -> _ReplicaLog:
+    path = os.path.abspath(path)
+    with _REGISTRY_LOCK:
+        rep = _REPLICAS.get(path)
+        if rep is not None and not os.path.exists(path):
+            # The host died under the live handle (file destroyed): the
+            # registry entry models a process that no longer exists.
+            del _REPLICAS[path]
+            rep = None
+        if rep is None:
+            rep = _ReplicaLog(path)
+            _REPLICAS[path] = rep
+        rep._acquire()
+        return rep
+
+
+def follower_paths(path: str, n: Optional[int] = None) -> list:
+    """Default follower log paths for a replication group rooted at
+    ``path`` (one per simulated follower store host)."""
+    if n is None:
+        n = max(1, int(config.gcs_replication_followers))
+    return [f"{path}.follower{i}" for i in range(n)]
+
+
+def drop_host(path: str) -> list:
+    """Machine-loss analog for chaos: destroy the primary member's file
+    and its in-process replica object (process + disk gone). Follower
+    members are untouched. Returns the paths removed."""
+    path = os.path.abspath(path)
+    removed = []
+    with _REGISTRY_LOCK:
+        rep = _REPLICAS.pop(path, None)
+    if rep is not None:
+        try:
+            os.close(rep._fd)
+        except OSError:
+            pass
+    if os.path.exists(path):
+        os.unlink(path)
+        removed.append(path)
+    return removed
+
+
+_TEL_REPL_LAG_S = telemetry.histogram(
+    "gcs",
+    "replication_lag_s",
+    "follower ack latency per shipped group-commit",
+    buckets=telemetry.LATENCY_BUCKETS_S,
+)
+
+
+class ReplicatedStoreClient(StoreClient):
+    """WAL chained with synchronous log-shipping to follower members (see
+    the replicated-backend comment above). Keeps WalStoreClient's group
+    commit: mutations from one event-loop tick coalesce into one buffer
+    that is appended — and per ``gcs_store_sync`` fsynced — on *every*
+    member before the flush returns.
+
+    Leadership: the client carries the writer's ``term``. ``set_term``
+    raises the fence on every member (promotion); a put/delete under a
+    term older than any member's fence raises StaleLeaderError without
+    touching the mirror, and a fence raised mid-tick poisons the client
+    (``fenced``) so the deposed leader stops cleanly.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        followers: Optional[list] = None,
+        term: Optional[int] = None,
+        sync: Optional[str] = None,
+        compact_bytes: Optional[int] = None,
+        on_fenced=None,
+    ):
+        self._path = os.path.abspath(path)
+        self._sync = sync or config.gcs_store_sync
+        self._compact_bytes = (
+            config.gcs_wal_compact_bytes if compact_bytes is None else compact_bytes
+        )
+        self._lock = threading.Lock()
+        self._closed = False
+        self.fenced = False
+        self._fence_gen = -1  # forces a full fence check on first write
+        self._on_fenced = on_fenced
+        self._pending: list = []
+        self._flush_scheduled = False
+        member_paths = [self._path] + [
+            os.path.abspath(p)
+            for p in (followers if followers is not None else follower_paths(path))
+        ]
+        self._members = [_open_replica(p) for p in member_paths]
+        # Follower shipping pool: one thread per follower so the member
+        # fsyncs overlap (os.fsync drops the GIL) — the ack still waits for
+        # every member, the wall cost is max(fsync) instead of sum(fsync).
+        self._ship_pool = (
+            concurrent.futures.ThreadPoolExecutor(
+                max_workers=len(self._members) - 1,
+                thread_name_prefix="gcs-repl-ship",
+            )
+            if len(self._members) > 1
+            else None
+        )
+        # Adopt the freshest member: after machine loss of the primary the
+        # follower carries the acknowledged history, and a fresh primary
+        # file starts at (term 0, seq 0) and loses the election below.
+        states = []
+        for m in self._members:
+            data = b""
+            if os.path.exists(m.path):
+                with open(m.path, "rb") as f:
+                    data = f.read()
+            states.append(_parse_replicated(data))
+        best = max(range(len(states)), key=lambda i: (states[i][1], states[i][2]))
+        tables, bterm, bseq, _ = states[best]
+        self._tables = tables
+        self._seq = bseq
+        self._term = bterm if term is None else term
+        fence = max(m.fence_term for m in self._members)
+        if self._term < fence:
+            from ray_tpu._private.rpc import StaleLeaderError
+
+            self.close()
+            raise StaleLeaderError(
+                f"store opened at term {self._term} behind "
+                f"fence {fence}"
+            )
+        # Catch-up: stale members (lost host replaced, follower behind)
+        # receive the full state as one snapshot frame, then ride the tail.
+        snap = None
+        for i, m in enumerate(self._members):
+            if states[i][2] < bseq or states[i][1] < bterm:
+                if snap is None:
+                    snap = _rframe(
+                        "snap", "", "",
+                        msgpack.packb(self._tables, use_bin_type=True),
+                        self._term, self._seq,
+                    )
+                m.reset_with(snap, self._term, self._seq, self._sync)
+        for m in self._members:
+            m.raise_fence(self._term)
+
+    @property
+    def term(self) -> int:
+        return self._term
+
+    @property
+    def seq(self) -> int:
+        return self._seq
+
+    def set_term(self, term: int) -> None:
+        """Adopt a (higher) leadership term and fence every member at it:
+        the promoted standby's first store act, before any write."""
+        from ray_tpu._private.rpc import StaleLeaderError
+
+        with self._lock:
+            fence = max(m.fence_term for m in self._members)
+            if term < fence:
+                raise StaleLeaderError(
+                    f"cannot adopt term {term} behind "
+                    f"fence {fence}"
+                )
+            self._term = term
+        for m in self._members:
+            m.raise_fence(term)
+
+    def _check_fence(self) -> None:
+        from ray_tpu._private.rpc import StaleLeaderError
+
+        if self.fenced:
+            raise StaleLeaderError(
+                f"store client (term {self._term}) is fenced"
+            )
+        # Snapshot the generation BEFORE reading fences: a concurrent raise
+        # leaves the stored generation stale, forcing a re-check next write.
+        gen = _FENCE_GEN[0]
+        fence = max(m.fence_term for m in self._members)
+        if self._term < fence:
+            self._mark_fenced()
+            raise StaleLeaderError(
+                f"write from term {self._term} rejected "
+                f"(leadership fence at term {fence})"
+            )
+        self._fence_gen = gen
+
+    def _mark_fenced(self) -> None:
+        self.fenced = True
+        self._pending.clear()
+        if self._on_fenced is not None:
+            cb, self._on_fenced = self._on_fenced, None
+            try:
+                cb()
+            except Exception:
+                pass
+
+    # -- group commit (shipped) ---------------------------------------------
+
+    def _schedule_flush(self) -> None:
+        if self._sync == "always":
+            self._flush()
+            return
+        if self._flush_scheduled:
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            self._flush()
+            return
+        self._flush_scheduled = True
+        loop.call_soon(self.flush)
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_scheduled = False
+            self._flush()
+
+    def _flush(self) -> None:  # caller holds _lock
+        from ray_tpu._private.rpc import StaleLeaderError
+
+        if not self._pending or self._closed or self.fenced:
+            self._pending.clear()
+            return
+        buf = b"".join(self._pending)
+        self._pending.clear()
+        t0 = time.perf_counter()
+        try:
+            if self._ship_pool is not None:
+                futs = [
+                    self._ship_pool.submit(
+                        m.append, buf, self._term, self._seq, self._sync
+                    )
+                    for m in self._members[1:]
+                ]
+                self._members[0].append(buf, self._term, self._seq, self._sync)
+                for fut in futs:
+                    fut.result()
+            else:
+                for m in self._members:
+                    m.append(buf, self._term, self._seq, self._sync)
+        except StaleLeaderError:
+            # Fenced mid-tick: this tick's writes were never replicated and
+            # the leadership that acknowledged them is over — the deposed
+            # leader must stop serving, not limp on with a diverged mirror.
+            self._mark_fenced()
+            return
+        dt = time.perf_counter() - t0
+        _TEL_WRITE_S.default.observe(dt)
+        _TEL_REPL_LAG_S.default.observe(dt)
+        _TEL_WAL_BYTES.default.inc(len(buf))
+        if self._compact_bytes and self._members[0].log_bytes > self._compact_bytes:
+            snap = _rframe(
+                "snap", "", "",
+                msgpack.packb(self._tables, use_bin_type=True),
+                self._term, self._seq,
+            )
+            for m in self._members:
+                m.reset_with(snap, self._term, self._seq, self._sync)
+            _TEL_WAL_COMPACTIONS.default.inc()
+
+    # -- StoreClient API -----------------------------------------------------
+
+    def put(self, table: str, key: str, value: bytes) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            if self.fenced or self._fence_gen != _FENCE_GEN[0]:
+                self._check_fence()
+            self._seq += 1
+            self._tables.setdefault(table, {})[key] = value
+            body = msgpack.packb(
+                ["put", table, key, value, self._term, self._seq],
+                use_bin_type=True,
+            )
+            self._pending.append(
+                _HDR.pack(len(body), zlib.crc32(body)) + body
+            )
+            self._schedule_flush()
+
+    def get(self, table: str, key: str) -> Optional[bytes]:
+        with self._lock:
+            return self._tables.get(table, {}).get(key)
+
+    def delete(self, table: str, key: str) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            if self.fenced or self._fence_gen != _FENCE_GEN[0]:
+                self._check_fence()
+            self._seq += 1
+            self._tables.get(table, {}).pop(key, None)
+            body = msgpack.packb(
+                ["del", table, key, None, self._term, self._seq],
+                use_bin_type=True,
+            )
+            self._pending.append(
+                _HDR.pack(len(body), zlib.crc32(body)) + body
+            )
+            self._schedule_flush()
+
+    def get_all(self, table: str) -> Dict[str, bytes]:
+        with self._lock:
+            return dict(self._tables.get(table, {}))
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._flush()
+            self._closed = True
+        if self._ship_pool is not None:
+            self._ship_pool.shutdown(wait=True)
+        for m in self._members:
+            m._release()
+
+    def crash(self) -> None:
+        """Process-death analog: the buffered tick reaches every member's
+        file (no fsync) — what a real leader that writes-before-acking
+        would have already shipped."""
+        with self._lock:
+            if self._closed:
+                return
+            buf = b"" if self.fenced else b"".join(self._pending)
+            self._pending.clear()
+            self._closed = True
+        if buf:
+            for m in self._members:
+                m.write_unsynced(buf)
+        for m in self._members:
+            m._release()
+
+
+class ReplicaTailer:
+    """Warm-standby's view of a shipped log: re-reads new frames from a
+    member file on each poll and applies them to a local mirror — the
+    cross-process analog of a follower applying its received stream.
+    Detects compaction/catch-up rewrites (inode change, shrink, or changed
+    leading bytes — inode numbers alone are unreliable: many filesystems
+    hand a renamed-over file the number the original just freed) and
+    replays from offset zero."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+        self.tables: Dict[str, Dict[str, bytes]] = {}
+        self.term = 0
+        self.seq = 0
+        self._off = 0
+        self._ino = None
+        self._head = b""  # first bytes at last reset: rewrite fingerprint
+
+    def poll(self) -> int:
+        """Apply any new frames; returns how many bytes were consumed."""
+        try:
+            st = os.stat(self.path)
+        except OSError:
+            return 0
+        try:
+            with open(self.path, "rb") as f:
+                head = f.read(len(self._head)) if self._head else b""
+        except OSError:
+            return 0
+        if (
+            st.st_ino != self._ino
+            or st.st_size < self._off
+            or head != self._head
+        ):
+            self._ino = st.st_ino
+            self._off = 0
+            self.tables = {}
+        if st.st_size <= self._off:
+            return 0
+        with open(self.path, "rb") as f:
+            f.seek(self._off)
+            data = f.read()
+        if self._off == 0:
+            self._head = data[:32]
+        _, _, _, good = _parse_replicated(data)
+        if good == 0:
+            return 0
+        # _parse_replicated replays from scratch; splice its view over the
+        # running mirror frame by frame instead to keep deletes correct.
+        off = 0
+        while off + _HDR.size <= len(data) and off < good:
+            blen, _ = _HDR.unpack_from(data, off)
+            body = data[off + _HDR.size : off + _HDR.size + blen]
+            fields = msgpack.unpackb(body, raw=False)
+            op, table, key, value = fields[:4]
+            if len(fields) >= 6:
+                self.term = max(self.term, fields[4])
+                self.seq = max(self.seq, fields[5])
+            if op == "snap":
+                self.tables = {
+                    t: dict(kv)
+                    for t, kv in msgpack.unpackb(value, raw=False).items()
+                }
+            elif op == "put":
+                self.tables.setdefault(table, {})[key] = value
+            else:
+                self.tables.get(table, {}).pop(key, None)
+            off += _HDR.size + blen
+        self._off += good
+        return good
+
+    def get(self, table: str, key: str) -> Optional[bytes]:
+        return self.tables.get(table, {}).get(key)
+
+    def get_all(self, table: str) -> Dict[str, bytes]:
+        return dict(self.tables.get(table, {}))
+
+
 def inject_torn_tail(path: str) -> bool:
     """Append a partial frame to a WAL file — the on-disk shape of a crash
     that died mid-append of a NEW record (its header landed, its body did
@@ -424,11 +1016,16 @@ def inject_torn_tail(path: str) -> bool:
 
 
 def make_store(
-    persist_path: Optional[str], backend: Optional[str] = None
+    persist_path: Optional[str],
+    backend: Optional[str] = None,
+    term: Optional[int] = None,
+    on_fenced=None,
 ) -> StoreClient:
     """Build the configured store. No path -> in-memory regardless of
     backend; with a path, ``backend`` (default: the ``gcs_persist_backend``
-    knob) picks wal / sqlite / memory."""
+    knob) picks wal / sqlite / memory / replicated. ``term``/``on_fenced``
+    apply to the replicated backend only (leadership stamp + fencing
+    notification for the HA control plane)."""
     if not persist_path:
         return InMemoryStoreClient()
     backend = backend or config.gcs_persist_backend
@@ -436,6 +1033,8 @@ def make_store(
         return SqliteStoreClient(persist_path)
     if backend == "memory":
         return InMemoryStoreClient()
+    if backend == "replicated":
+        return ReplicatedStoreClient(persist_path, term=term, on_fenced=on_fenced)
     if backend != "wal":
         raise ValueError(f"unknown gcs_persist_backend {backend!r}")
     return WalStoreClient(persist_path)

@@ -57,6 +57,7 @@ class Node:
         self.object_store_memory = object_store_memory
         self.labels = labels
         self.worker_env = worker_env
+        self.gcs_standby = None  # GcsStandby when HA mode is on (head only)
 
     def gcs_persist_path(self) -> str:
         """Session-scoped store file backing GCS fault tolerance (WAL or
@@ -67,6 +68,29 @@ class Node:
             tempfile.gettempdir(), f"ray_tpu_{self.session_name}", "gcs.db"
         )
 
+    def ha_enabled(self) -> bool:
+        """HA control plane: replicated store + warm standby + leader file
+        (docs/fault_tolerance.md "HA deployment")."""
+        return bool(
+            config.gcs_persistence and config.gcs_persist_backend == "replicated"
+        )
+
+    def gcs_leader_file(self) -> Optional[str]:
+        if not self.ha_enabled():
+            return None
+        from ray_tpu._private import gcs_ha
+
+        return gcs_ha.leader_file_path(self.gcs_persist_path())
+
+    async def _arm_standby(self) -> None:
+        from ray_tpu._private.gcs_ha import GcsStandby
+
+        self.gcs_standby = GcsStandby(
+            session_name=self.session_name,
+            persist_path=self.gcs_persist_path(),
+        )
+        await self.gcs_standby.start()
+
     async def start(self) -> None:
         if self.head:
             self.gcs_server = GcsServer(
@@ -76,6 +100,8 @@ class Node:
                 ),
             )
             self.gcs_addr = await self.gcs_server.start()
+            if self.ha_enabled():
+                await self._arm_standby()
         assert self.gcs_addr is not None
         self.raylet = Raylet(
             self.gcs_addr,
@@ -84,12 +110,19 @@ class Node:
             object_store_memory=self.object_store_memory,
             labels=self.labels,
             worker_env=self.worker_env,
+            gcs_leader_file=self.gcs_leader_file(),
         )
         self.raylet_addr = await self.raylet.start()
 
     async def stop(self) -> None:
         if self.raylet is not None:
             await self.raylet.stop()
+        if self.gcs_standby is not None:
+            # The promoted standby's server may be the very server we adopted
+            # as gcs_server; detach it so it is stopped exactly once below.
+            if self.gcs_standby.server is self.gcs_server:
+                self.gcs_standby.server = None
+            await self.gcs_standby.stop()
         if self.gcs_server is not None:
             await self.gcs_server.stop()
             if self.head and config.gcs_persistence:
@@ -117,6 +150,25 @@ class Node:
             from ray_tpu._private.gcs_store import inject_torn_tail
 
             inject_torn_tail(self.gcs_persist_path())
+
+    async def kill_gcs_host(self, timeout: float = 30.0) -> Tuple[str, int]:
+        """Fault-injection: lose the whole GCS *machine* — the process dies
+        hard AND its local log member is gone (disk went with the host).
+        The warm standby notices the unrenewed lease, promotes over the
+        surviving follower log at term+1, and the leader pointer file
+        re-targets every client. Returns the new leader's address."""
+        assert self.gcs_server is not None and self.gcs_standby is not None
+        from ray_tpu._private.gcs_store import drop_host
+
+        await self.gcs_server.crash()
+        drop_host(self.gcs_persist_path())
+        await asyncio.wait_for(self.gcs_standby.promoted.wait(), timeout)
+        self.gcs_server = self.gcs_standby.server
+        self.gcs_addr = self.gcs_server.server.address
+        # Re-arm: a fresh standby guards the new leader so a second failover
+        # works the same way.
+        await self._arm_standby()
+        return self.gcs_addr
 
     async def restart_gcs(self) -> None:
         """Restart the GCS on the same address from its persisted state.

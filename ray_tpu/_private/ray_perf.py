@@ -110,20 +110,28 @@ def _bench_sched() -> Dict[str, float]:
     }
 
 
-def _bench_gcs_persist() -> float:
-    """Write-through rate of the WAL store under group commit: each cycle
-    issues N keyed puts inside one event-loop context and then runs the
-    per-tick flush — one os.write + one fsync for the whole batch, the
+def _bench_gcs_persist(replicated: bool = False) -> float:
+    """Write-through rate of the persistent store under group commit: each
+    cycle issues N keyed puts inside one event-loop context and then runs
+    the per-tick flush — one os.write + one fsync for the whole batch, the
     shape every GCS control-plane mutation pays (docs/fault_tolerance.md
-    "Durability contract")."""
+    "Durability contract"). With ``replicated=True`` the same workload runs
+    through ReplicatedStoreClient — every flush is fsynced on the primary
+    AND synchronously shipped + fsynced on the follower member before the
+    tick's writes are acknowledged (the HA deployment's write path)."""
     import os
     import shutil
     import tempfile
 
-    from ray_tpu._private.gcs_store import WalStoreClient
+    from ray_tpu._private.gcs_store import ReplicatedStoreClient, WalStoreClient
 
     d = tempfile.mkdtemp(prefix="perf_wal_")
-    store = WalStoreClient(os.path.join(d, "gcs.wal"))
+    if replicated:
+        store = ReplicatedStoreClient(os.path.join(d, "gcs.wal"), term=1)
+        label = "gcs persist puts (replicated, 1 follower)"
+    else:
+        store = WalStoreClient(os.path.join(d, "gcs.wal"))
+        label = "gcs persist puts (wal group commit)"
     n = 2000
     payload = b"v" * 256
     seq = [0]
@@ -142,11 +150,65 @@ def _bench_gcs_persist() -> float:
         asyncio.run(burst())
 
     try:
-        rate = timeit("gcs persist puts (wal group commit)", cycle, n)
+        rate = timeit(label, cycle, n)
     finally:
         store.close()
         shutil.rmtree(d, ignore_errors=True)
     return rate
+
+
+def _bench_gcs_failover() -> float:
+    """Time to a converged control-plane view after whole-machine GCS loss:
+    a SimCluster in HA mode (replicated store + warm standby) loses the
+    primary GCS process AND its log member; the clock runs from the kill
+    until the promoted leader's node view reports every raylet ALIVE again
+    (promotion + leader-file flip + the full reconnect/re-report wave)."""
+    import os
+    import shutil
+    import tempfile
+
+    from ray_tpu._private import rpc
+    from ray_tpu._private.common import config
+    from ray_tpu._private.sim_cluster import SimCluster
+
+    nodes = int(os.environ.get("RAY_TPU_FAILOVER_BENCH_NODES", "100"))
+    d = tempfile.mkdtemp(prefix="perf_failover_")
+    cluster = SimCluster(
+        nodes,
+        persist_path=os.path.join(d, "gcs.wal"),
+        ha=True,
+        env={
+            "RAY_TPU_GCS_LEADER_LEASE_S": "1.0",
+            "RAY_TPU_GCS_STANDBY_POLL_S": "0.05",
+        },
+    ).start()
+    try:
+        t0 = time.perf_counter()
+        assert cluster.run(cluster.kill_gcs_host_async(), timeout=120)
+
+        async def converged() -> None:
+            conn = await rpc.connect(*cluster.gcs_addr)
+            try:
+                while True:
+                    reply = await conn.call(
+                        "GetAllNodes", timeout=config.rpc_reconnect_timeout_s
+                    )
+                    alive = sum(
+                        1 for nd in reply["nodes"] if nd["state"] == "ALIVE"
+                    )
+                    if alive >= nodes:
+                        return
+                    await asyncio.sleep(0.1)
+            finally:
+                await conn.close()
+
+        cluster.run(converged(), timeout=300)
+        dt = time.perf_counter() - t0
+    finally:
+        cluster.shutdown()
+        shutil.rmtree(d, ignore_errors=True)
+    print(f"gcs failover -> converged view ({nodes} sim nodes): {dt:.2f} s")
+    return dt
 
 
 def _bench_pubsub_fanout() -> float:
@@ -570,6 +632,10 @@ def main(json_path: str = "") -> Dict[str, float]:
     results.update(_bench_collective_allreduce())
     results.update(_bench_sched())
     results["gcs_persist_puts_per_s"] = _bench_gcs_persist()
+    results["gcs_persist_puts_per_s_replicated"] = _bench_gcs_persist(
+        replicated=True
+    )
+    results["gcs_failover_converge_s"] = _bench_gcs_failover()
     results["pubsub_fanout_per_s"] = _bench_pubsub_fanout()
     results["telemetry_overhead_ns"] = _bench_telemetry_overhead()
     if json_path:

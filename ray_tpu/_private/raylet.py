@@ -409,6 +409,7 @@ class Raylet:
         labels: Optional[Dict[str, str]] = None,
         worker_env: Optional[Dict[str, str]] = None,
         sim_workers: bool = False,
+        gcs_leader_file: Optional[str] = None,
     ):
         from ray_tpu._private.ids import NodeID
 
@@ -417,6 +418,10 @@ class Raylet:
         # raylets fit in one process (tests/test_scale.py harness).
         self.sim_workers = sim_workers
         self._sim_worker_seq = 0
+        # HA control plane: the leader pointer file this raylet (and its
+        # workers, via env) re-resolves before every GCS redial, so a
+        # failover re-targets the promoted standby (gcs_ha.py).
+        self.gcs_leader_file = gcs_leader_file or config.gcs_leader_file or None
 
         self.node_id = node_id or NodeID.from_random().hex()
         self.session_name = session_name
@@ -630,7 +635,12 @@ class Raylet:
         # Duplex: the GCS calls back over this link (LeaseWorkerForActor,
         # KillWorker, PG prepare/commit), so expose our handlers on it.
         conn = await rpc.connect(*self.gcs_addr, handlers=self.server._handlers)
-        self.gcs = GcsClient(conn)
+        resolver = None
+        if self.gcs_leader_file:
+            from ray_tpu._private import gcs_ha
+
+            resolver = gcs_ha.file_resolver(self.gcs_leader_file)
+        self.gcs = GcsClient(conn, resolver=resolver)
         self.addr = addr
 
         async def _register(client) -> None:
@@ -919,6 +929,8 @@ class Raylet:
                 "RAY_TPU_SESSION": self.session_name,
             }
         )
+        if self.gcs_leader_file:
+            env["RAY_TPU_GCS_LEADER_FILE"] = self.gcs_leader_file
         proc = None
         if container:
             # Containerized worker (reference: runtime_env/container.py):

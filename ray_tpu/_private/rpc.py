@@ -294,6 +294,30 @@ class DeadlineExceeded(RpcError):
     far side can tell budget exhaustion from a handler bug."""
 
 
+class StaleLeaderError(RpcError):
+    """A write carried a leader term older than the store's fence: the
+    issuing GCS lost leadership (lease expired, standby promoted) and must
+    not mutate control-plane state. Raised server-side by the replicated
+    store and surfaced to clients as a typed error so callers can
+    re-resolve the leader instead of retrying a doomed write."""
+
+
+# Error-reply payloads are ``f"{type(e).__name__}: {e}"`` plus traceback;
+# these prefixes re-type the caller-side exception so control flow (leader
+# fencing, deadline budgeting) doesn't have to string-match at every site.
+_TYPED_ERRORS = {
+    "StaleLeaderError:": StaleLeaderError,
+}
+
+
+def _typed_error(payload) -> RpcError:
+    if isinstance(payload, str):
+        for prefix, cls in _TYPED_ERRORS.items():
+            if payload.startswith(prefix):
+                return cls(payload)
+    return RpcError(payload)
+
+
 _packb = msgpack.Packer(use_bin_type=True, autoreset=True).pack
 
 
@@ -958,7 +982,7 @@ class Connection:
                 if kind == _KIND_REP:
                     fut.set_result(payload)
                 else:
-                    fut.set_exception(RpcError(payload))
+                    fut.set_exception(_typed_error(payload))
 
     async def _dispatch(
         self, msgid, method: str, payload, deadline: Optional[float] = None
@@ -1307,6 +1331,12 @@ class RetryableConnection:
     inherits the caller's ``timeout`` folded with the ambient handler
     deadline, and the overall retry loop gives up when that budget — or the
     policy's — runs out.
+
+    ``resolver`` makes re-dial target-aware: an async callable returning
+    the *current* ``(host, port)`` of the service (or None to keep the last
+    known address). When set, every reconnect re-resolves before dialing
+    and the address is passed to ``dial(addr)`` — how clients follow a GCS
+    leader across failover instead of hammering the dead primary.
     """
 
     def __init__(
@@ -1319,8 +1349,12 @@ class RetryableConnection:
         on_reconnect: Optional[Callable[[Connection], Awaitable[None]]] = None,
         name: str = "rpc",
         rng: Optional[random.Random] = None,
+        resolver: Optional[
+            Callable[[], Awaitable[Optional[Tuple[str, int]]]]
+        ] = None,
     ):
         self._dial = dial
+        self._resolver = resolver
         self.conn = conn
         self._policy = policy or RetryPolicy.for_calls()
         self._default_retry = default_retry
@@ -1384,7 +1418,16 @@ class RetryableConnection:
                 return conn  # another waiter already reconnected
             if self._closed:
                 raise ConnectionLost(f"{self._name}: client closed")
-            conn = await self._dial()
+            if self._resolver is not None:
+                addr = None
+                try:
+                    addr = await self._resolver()
+                except Exception:
+                    logger.debug("%s: address resolver failed; using last "
+                                 "known address", self._name, exc_info=True)
+                conn = await self._dial(addr)
+            else:
+                conn = await self._dial()
             self.conn = conn
             self.stats["redials"] += 1
             self._tel_redials.inc()
@@ -1428,7 +1471,15 @@ class RetryableConnection:
                     if attempt_timeout is None or attempt_timeout > remaining:
                         attempt_timeout = remaining
                 return await conn.call(method, payload, timeout=attempt_timeout)
-            except (ConnectionLost, asyncio.TimeoutError) as e:
+            except (ConnectionLost, asyncio.TimeoutError, StaleLeaderError) as e:
+                if isinstance(e, StaleLeaderError):
+                    # The peer lost leadership: the write was rejected, not
+                    # applied. Drop the link so the next attempt re-resolves
+                    # (and re-dials) the current leader. Without a resolver
+                    # this still lands on the restarted/promoted address.
+                    if self.conn is conn and not conn.closed:
+                        self.conn = None
+                        spawn(conn.close())
                 if self._closed:
                     raise
                 if self._retry_mode(method, payload) != "safe":

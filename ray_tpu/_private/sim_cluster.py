@@ -96,11 +96,19 @@ class SimCluster:
         object_store_memory: int = 1 << 20,
         env: Optional[Dict[str, str]] = None,
         persist_path: Optional[str] = None,
+        ha: bool = False,
     ):
         self.num_nodes = num_nodes
         self.resources = resources or {"CPU": 4.0}
         self.object_store_memory = object_store_memory
         self.persist_path = persist_path
+        # HA mode: replicated store + warm standby + leader pointer file, so
+        # kill_gcs_host_async() can lose the "machine" holding the primary
+        # log and fail over (docs/fault_tolerance.md "HA deployment").
+        self.ha = ha
+        if ha and not persist_path:
+            raise ValueError("ha=True requires persist_path")
+        self.gcs_standby = None
         self.session_name = f"sim-{fast_unique_hex()[:8]}"
         self.raylets: Dict[str, Raylet] = {}
         self.gcs_server: Optional[GcsServer] = None
@@ -146,9 +154,13 @@ class SimCluster:
         # pure tax. The chaos recovery scenarios pass a path so crash_gcs
         # has durable state to recover from.
         self.gcs_server = GcsServer(
-            session_name=self.session_name, persist_path=self.persist_path
+            session_name=self.session_name,
+            persist_path=self.persist_path,
+            persist_backend="replicated" if self.ha else None,
         )
         self.gcs_addr = await self.gcs_server.start()
+        if self.ha:
+            await self._arm_standby()
         sem = asyncio.Semaphore(_BOOT_CONCURRENCY)
 
         async def boot(_i: int) -> None:
@@ -166,6 +178,7 @@ class SimCluster:
             resources=resources,
             object_store_memory=self.object_store_memory,
             sim_workers=True,
+            gcs_leader_file=self.gcs_leader_file(),
         )
         await raylet.start()
         self.raylets[raylet.node_id] = raylet
@@ -181,6 +194,39 @@ class SimCluster:
         raylet = self.raylets.pop(node_id, None)
         if raylet is not None:
             self.run(raylet.stop(), timeout=60.0)
+
+    def gcs_leader_file(self) -> Optional[str]:
+        if not self.ha:
+            return None
+        from ray_tpu._private import gcs_ha
+
+        return gcs_ha.leader_file_path(self.persist_path)
+
+    async def _arm_standby(self) -> None:
+        from ray_tpu._private.gcs_ha import GcsStandby
+
+        self.gcs_standby = GcsStandby(
+            session_name=self.session_name, persist_path=self.persist_path
+        )
+        await self.gcs_standby.start()
+
+    async def kill_gcs_host_async(self, timeout: float = 30.0) -> bool:
+        """Lose the GCS *machine*: hard-crash the process and drop its local
+        log member (the disk went with the host), then wait for the warm
+        standby to promote over the surviving follower log at term+1. The
+        leader pointer file re-targets raylets on their next redial.
+        Returns False when HA is off or the GCS is already gone."""
+        if not self.ha or self.gcs_server is None or self.gcs_standby is None:
+            return False
+        from ray_tpu._private.gcs_store import drop_host
+
+        await self.gcs_server.crash()
+        drop_host(self.persist_path)
+        await asyncio.wait_for(self.gcs_standby.promoted.wait(), timeout)
+        self.gcs_server = self.gcs_standby.server
+        self.gcs_addr = self.gcs_server.server.address
+        await self._arm_standby()
+        return True
 
     async def crash_gcs_async(self, torn_tail: bool = True) -> bool:
         """Hard-crash the GCS (no store checkpoint/fsync, optionally a torn
@@ -199,6 +245,7 @@ class SimCluster:
             port=self.gcs_addr[1],
             session_name=self.session_name,
             persist_path=self.persist_path,
+            persist_backend="replicated" if self.ha else None,
         )
         await self.gcs_server.start()
         return True
@@ -233,6 +280,11 @@ class SimCluster:
                     pass
 
         await asyncio.gather(*(stop_one(r) for r in raylets))
+        if self.gcs_standby is not None:
+            if self.gcs_standby.server is self.gcs_server:
+                self.gcs_standby.server = None
+            await self.gcs_standby.stop()
+            self.gcs_standby = None
         if self.gcs_server is not None:
             await self.gcs_server.stop()
             self.gcs_server = None

@@ -186,6 +186,7 @@ def init(
                 is_driver=True,
                 worker_id=WorkerID.from_random().hex(),
                 server=server,
+                gcs_leader_file=node.gcs_leader_file() if node else None,
             )
             core.addr = addr
             core.raylet_addr = tuple(raylet_addr)

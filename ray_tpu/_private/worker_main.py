@@ -982,6 +982,14 @@ async def amain() -> None:
         int(os.environ["RAY_TPU_RAYLET_PORT"]),
     )
     gcs_addr = (os.environ["RAY_TPU_GCS_HOST"], int(os.environ["RAY_TPU_GCS_PORT"]))
+    gcs_leader_file = os.environ.get("RAY_TPU_GCS_LEADER_FILE") or None
+    if gcs_leader_file:
+        # HA mode: the env address is whatever leader the raylet knew at
+        # spawn time — a worker booting mid/post-failover must dial the
+        # CURRENT leader from the pointer file instead.
+        from ray_tpu._private import gcs_ha
+
+        gcs_addr = gcs_ha.resolve_leader_file(gcs_leader_file) or gcs_addr
     worker_id = os.environ["RAY_TPU_WORKER_ID"]
     node_id = os.environ["RAY_TPU_NODE_ID"]
     session = os.environ["RAY_TPU_SESSION"]
@@ -1005,6 +1013,7 @@ async def amain() -> None:
         is_driver=False,
         worker_id=worker_id,
         server=server,
+        gcs_leader_file=gcs_leader_file,
     )
     core.addr = addr
     core.raylet_addr = raylet_addr

@@ -19,7 +19,7 @@ logger = logging.getLogger(__name__)
 
 ACTIONS = (
     "kill_worker", "kill_replica", "kill_raylet", "restart_gcs", "crash_gcs",
-    "kill_collective_rank",
+    "kill_collective_rank", "kill_gcs_host",
 )
 
 # Actor-name prefix of Serve replica workers (ReplicaID.to_actor_name).
@@ -64,6 +64,8 @@ class Nemesis:
             return await self._restart_gcs()
         if action == "crash_gcs":
             return await self._crash_gcs()
+        if action == "kill_gcs_host":
+            return await self._kill_gcs_host()
         raise ValueError(f"unknown nemesis action {action!r}")
 
     def _kill_worker(self, pick: int) -> Optional[str]:
@@ -255,3 +257,67 @@ class Nemesis:
         self.actions_fired.append("crash_gcs")
         logger.info("nemesis: crashed GCS (torn WAL tail) and restarted")
         return "crash_gcs"
+
+    async def _kill_gcs_host(self) -> Optional[str]:
+        """Lose the whole GCS *machine* (process killed hard AND its local
+        replicated-log member dropped), then wait for the warm standby to
+        promote over the surviving follower log. Every record acknowledged
+        before the kill must be present in the new leader's tables — the
+        zero-acknowledged-state-loss invariant for HA failover
+        (docs/fault_tolerance.md "HA deployment")."""
+        gcs = self.cluster.gcs_server
+        if gcs is None:
+            return None
+        pre = {
+            "actors": set(gcs.actors),
+            "pgs": set(gcs.placement_groups),
+            "jobs": set(gcs.jobs),
+            "named": dict(gcs.named_actors),
+            "kv": dict(gcs.kv),
+        }
+        pre_term = gcs.leader_term
+        node = self.cluster.head_node
+        if node is not None and getattr(node, "gcs_standby", None) is not None:
+            await node.kill_gcs_host()
+            self.cluster.gcs_server = node.gcs_server
+        elif hasattr(self.cluster, "kill_gcs_host_async"):
+            # SimCluster shape: no Node wrapper, the sim owns its GCS.
+            if not await self.cluster.kill_gcs_host_async():
+                return None
+        else:
+            return None
+        new = self.cluster.gcs_server
+        if new.leader_term <= pre_term:
+            self.state_loss.append(
+                f"split-brain: promoted leader term {new.leader_term} did "
+                f"not advance past {pre_term}"
+            )
+        post = {
+            "actors": set(new.actors),
+            "pgs": set(new.placement_groups),
+            "jobs": set(new.jobs),
+        }
+        for table in ("actors", "pgs", "jobs"):
+            lost = pre[table] - post[table]
+            if lost:
+                self.state_loss.append(
+                    f"state-loss: {len(lost)} {table} record(s) gone "
+                    f"after failover (e.g. {sorted(lost)[:3]})"
+                )
+        for (ns, name), aid in pre["named"].items():
+            if new.named_actors.get((ns, name)) != aid:
+                self.state_loss.append(
+                    f"state-loss: named actor {ns}/{name} -> {aid[:8]} "
+                    "gone after failover"
+                )
+        for key, value in pre["kv"].items():
+            if new.kv.get(key) != value:
+                self.state_loss.append(
+                    f"state-loss: kv {key} changed/gone after failover"
+                )
+        self.actions_fired.append("kill_gcs_host")
+        logger.info(
+            "nemesis: killed GCS host; standby promoted at term %d",
+            new.leader_term,
+        )
+        return f"kill_gcs_host term={new.leader_term}"

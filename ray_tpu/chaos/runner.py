@@ -114,6 +114,9 @@ class Scenario:
     # sched workload: boot the SimCluster's GCS with a durable store (a
     # session tempdir) so crash_gcs has acknowledged state to recover.
     persist: bool = False
+    # sched workload: replicated store + warm standby + leader file, so the
+    # kill_gcs_host nemesis has a follower log to fail over onto.
+    ha: bool = False
     # serve workload: per-request budget, and whether to tear down the
     # process-wide router between steps (it must rebuild from the controller).
     serve_timeout_s: float = 2.0
@@ -349,6 +352,44 @@ SCENARIOS: Dict[str, Scenario] = {
             persist=True,
         ),
         Scenario(
+            name="kill_gcs_host",
+            description="lose the whole GCS machine mid-workload (process "
+            "killed hard, its replicated-log member gone with the disk); "
+            "the warm standby promotes over the surviving follower log, "
+            "clients re-target via the leader file, and every acknowledged "
+            "record survives — zero state loss, no split-brain",
+            specs=[],
+            workload="tasks",
+            steps=4,
+            nemesis=["kill_gcs_host"],
+            env=dict(
+                _TASKS_ENV,
+                RAY_TPU_GCS_PERSIST_BACKEND="replicated",
+                # Fast lease turnover so promotion lands inside the seed,
+                # not after a 2s production lease + grace window.
+                RAY_TPU_GCS_LEADER_LEASE_S="1.0",
+                RAY_TPU_GCS_STANDBY_POLL_S="0.05",
+            ),
+        ),
+        Scenario(
+            name="kill_gcs_host_sim",
+            description="200-node simulated cluster: kill the GCS host "
+            "under concurrent lease storms; the standby promotes from the "
+            "follower log and the 200-raylet reconnect wave re-targets the "
+            "new leader through the leader file without melting it",
+            specs=[],
+            workload="sched",
+            steps=3,
+            nemesis=["kill_gcs_host"],
+            sim_nodes=200,
+            persist=True,
+            ha=True,
+            env={
+                "RAY_TPU_GCS_LEADER_LEASE_S": "1.0",
+                "RAY_TPU_GCS_STANDBY_POLL_S": "0.05",
+            },
+        ),
+        Scenario(
             name="sched_storm",
             description="120-node simulated cluster saturated with "
             "concurrent lease bursts; raylets killed mid-spillback-chain, "
@@ -370,8 +411,14 @@ SUITES: Dict[str, List[str]] = {
     # Process-level nemesis: heavier, run over fewer seeds.
     "recovery": ["kill_worker", "gcs_restart", "kill_raylet"],
     # Crash-consistency: hard GCS crashes (torn WAL) with the no-state-loss
-    # invariant, on a driver cluster and a 200-node sim reconnect storm.
-    "recovery_durable": ["recovery_durable", "recovery_durable_sim"],
+    # invariant, on a driver cluster and a 200-node sim reconnect storm —
+    # plus whole-host GCS loss with warm-standby failover (HA).
+    "recovery_durable": [
+        "recovery_durable", "recovery_durable_sim",
+        "kill_gcs_host", "kill_gcs_host_sim",
+    ],
+    # HA failover only: the chaos-ha CI job's 10+-seed gate.
+    "ha": ["kill_gcs_host", "kill_gcs_host_sim"],
     # Delay/drop-heavy schedules exercising the RPC resilience layer
     # (retryable channels, deadline propagation, GCS failover queueing).
     "latency": ["latency_storm", "latency_gcs_drop", "latency_gcs_restart"],
@@ -392,6 +439,7 @@ SUITES: Dict[str, List[str]] = {
         "serve_replica_kill", "serve_deadline_storm", "serve_router_restart",
         "kill_worker", "gcs_restart", "kill_raylet", "sched_storm",
         "recovery_durable", "recovery_durable_sim", "collective_rank_kill",
+        "kill_gcs_host", "kill_gcs_host_sim",
     ],
 }
 
@@ -1038,7 +1086,7 @@ def _run_sched_scenario(scenario: Scenario, seeds: List[int],
             )
         cluster = SimCluster(
             scenario.sim_nodes, env=dict(scenario.env),
-            persist_path=persist_path,
+            persist_path=persist_path, ha=scenario.ha,
         ).start()
         return cluster, SimLeaseClient(cluster)
 

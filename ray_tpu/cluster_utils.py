@@ -107,6 +107,17 @@ class Cluster:
                     await r.stop()
                 except Exception:
                     pass
+            # HA mode: disarm the warm standby BEFORE stopping the GCS, or
+            # the expired lease promotes a new leader into the dying cluster.
+            standby = getattr(self.head_node, "gcs_standby", None)
+            if standby is not None:
+                if standby.server is self.gcs_server:
+                    standby.server = None
+                try:
+                    await standby.stop()
+                except Exception:
+                    pass
+                self.head_node.gcs_standby = None
             if self.gcs_server is not None:
                 await self.gcs_server.stop()
 

@@ -232,3 +232,163 @@ def test_make_store_backend_selection(tmp_path, monkeypatch):
     finally:
         monkeypatch.delenv("RAY_TPU_GCS_PERSIST_BACKEND")
         config.refresh()
+
+
+# -- replicated store (HA): log shipping, fencing, machine loss --------------
+
+
+@pytest.fixture
+def repl_path(tmp_path):
+    return str(tmp_path / "gcs.wal")
+
+
+def test_replicated_ships_to_followers(repl_path):
+    from ray_tpu._private.gcs_store import (
+        ReplicatedStoreClient,
+        follower_paths,
+    )
+
+    s = ReplicatedStoreClient(repl_path)
+    s.put("kv", "a", b"1")
+    s.put("actors", "x", b"alive")
+    s.flush()
+    s.close()
+    # Every member of the replication group holds the full acknowledged
+    # state, independently replayable from its own file.
+    for member in [repl_path] + follower_paths(repl_path):
+        with open(member, "rb") as f:
+            tables, _, _, _ = gcs_store._parse_replicated(f.read())
+        assert tables["kv"]["a"] == b"1", member
+        assert tables["actors"]["x"] == b"alive", member
+
+
+def test_replicated_survives_primary_host_loss(repl_path):
+    from ray_tpu._private.gcs_store import ReplicatedStoreClient, drop_host
+
+    s = ReplicatedStoreClient(repl_path, term=1)
+    s.put("kv", "k", b"v")
+    s.flush()
+    s.crash()  # process death: no graceful close
+    drop_host(repl_path)  # the machine (and its log member) is gone
+    # A successor opens the group, adopts the surviving follower's state,
+    # and re-creates the lost member via snapshot catch-up.
+    s2 = ReplicatedStoreClient(repl_path, term=2)
+    assert s2.get("kv", "k") == b"v"
+    assert s2.term == 2
+    s2.put("kv", "k2", b"v2")
+    s2.flush()
+    s2.close()
+    assert os.path.exists(repl_path)  # re-created by catch-up
+
+
+def test_replicated_fences_stale_writer(repl_path):
+    from ray_tpu._private.gcs_store import ReplicatedStoreClient
+    from ray_tpu._private.rpc import StaleLeaderError
+
+    old = ReplicatedStoreClient(repl_path, term=1)
+    old.put("kv", "pre", b"1")
+    old.flush()
+    new = ReplicatedStoreClient(repl_path, term=2)
+    # The deposed leader's next acknowledged write must be rejected, not
+    # silently applied (split-brain prevention).
+    with pytest.raises(StaleLeaderError):
+        old.put("kv", "post", b"2")
+        old.flush()
+    new.flush()
+    assert new.get("kv", "pre") == b"1"
+    assert new.get("kv", "post") is None
+    old.close()
+    new.close()
+
+
+def test_replicated_open_below_fence_rejected(repl_path):
+    from ray_tpu._private.gcs_store import ReplicatedStoreClient
+    from ray_tpu._private.rpc import StaleLeaderError
+
+    s = ReplicatedStoreClient(repl_path, term=3)
+    s.put("kv", "a", b"1")
+    s.flush()
+    with pytest.raises(StaleLeaderError):
+        ReplicatedStoreClient(repl_path, term=2)
+    s.close()
+
+
+def test_replicated_fence_survives_restart(repl_path):
+    from ray_tpu._private.gcs_store import ReplicatedStoreClient
+    from ray_tpu._private.rpc import StaleLeaderError
+
+    s = ReplicatedStoreClient(repl_path, term=5)
+    s.put("kv", "a", b"1")
+    s.flush()
+    s.close()
+    # The fence is durable: after every in-process handle is gone, a
+    # reopened group still rejects terms below the highest ever accepted.
+    with pytest.raises(StaleLeaderError):
+        ReplicatedStoreClient(repl_path, term=4)
+    s2 = ReplicatedStoreClient(repl_path, term=5)
+    assert s2.get("kv", "a") == b"1"
+    s2.close()
+
+
+def test_replicated_crash_keeps_acknowledged_state(repl_path):
+    from ray_tpu._private.gcs_store import (
+        ReplicatedStoreClient,
+        follower_paths,
+    )
+
+    s = ReplicatedStoreClient(repl_path, term=1)
+    for i in range(10):
+        s.put("kv", f"k{i}", str(i).encode())
+    s.crash()  # pending group-commit buffer lands on every member
+    for member in [repl_path] + follower_paths(repl_path):
+        with open(member, "rb") as f:
+            tables, _, _, _ = gcs_store._parse_replicated(f.read())
+        for i in range(10):
+            assert tables["kv"][f"k{i}"] == str(i).encode(), member
+
+
+def test_replica_tailer_follows_and_survives_compaction(repl_path):
+    from ray_tpu._private.gcs_store import (
+        ReplicaTailer,
+        ReplicatedStoreClient,
+        follower_paths,
+    )
+
+    s = ReplicatedStoreClient(repl_path, term=1, compact_bytes=2048)
+    tailer = ReplicaTailer(follower_paths(repl_path)[0])
+    s.put("kv", "a", b"1")
+    s.flush()
+    tailer.poll()
+    assert tailer.get("kv", "a") == b"1"
+    assert tailer.term == 1
+    # Push the log past the compaction threshold: the member file is
+    # rewritten in place and the tailer must detect the new inode/shorter
+    # file and replay from scratch rather than tailing garbage.
+    for i in range(200):
+        s.put("kv", "big", b"x" * 64 + str(i).encode())
+    s.flush()
+    s.put("kv", "last", b"z")
+    s.flush()
+    tailer.poll()
+    assert tailer.get("kv", "last") == b"z"
+    assert tailer.get("kv", "a") == b"1"
+    s.close()
+
+
+def test_make_store_replicated_selection(tmp_path, monkeypatch):
+    from ray_tpu._private.common import config
+    from ray_tpu._private.gcs_store import ReplicatedStoreClient
+
+    s = make_store(str(tmp_path / "r.wal"), backend="replicated", term=1)
+    assert isinstance(s, ReplicatedStoreClient)
+    assert s.term == 1
+    s.close()
+    monkeypatch.setenv("RAY_TPU_GCS_PERSIST_BACKEND", "replicated")
+    config.refresh()
+    try:
+        s = make_store(str(tmp_path / "r2.wal"))
+        assert isinstance(s, ReplicatedStoreClient)
+        s.close()
+    finally:
+        monkeypatch.delenv("RAY_TPU_GCS_PERSIST_BACKEND")
+        config.refresh()

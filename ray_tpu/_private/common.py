@@ -162,6 +162,16 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     # OTel-style task tracing spans with context propagation (reference:
     # ray.init(_tracing_startup_hook) + tracing_helper.py). Off by default.
     "task_trace_spans": False,
+    # Sampled always-on tracing: fraction of new root traces recorded when
+    # task_trace_spans is off (0.0 disables). The sampling decision is
+    # deterministic on the root id, so every process on a request's path
+    # independently agrees whether the trace exists (docs/observability.md
+    # "Distributed tracing").
+    "trace_sample_rate": 0.0,
+    # Runtime-span ring: max spans buffered per process between flushes to
+    # the GCS spans ring; oldest drop first (same shape as
+    # task_events_max_buffer).
+    "trace_span_buffer": 8192,
     # Push manager: max chunks in flight across ALL destination pushes from
     # one node (reference: push_manager.h max_chunks_in_flight). With 8 MiB
     # chunks the default bounds broadcast buffering at ~64 MiB.

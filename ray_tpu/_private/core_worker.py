@@ -661,6 +661,15 @@ class LeasePool:
         raylet_conn = self.core.raylet_conn
         pool.inflight_ids.add(lease_id)
         locality = self._pool_locality_hints(pool)
+        # The lease pump runs outside any task context, so the lease RPC
+        # would leave the trace at the submit span. Borrow the trace context
+        # of the first traced pending task — the request exists to serve it —
+        # so the raylet's lease-lifecycle spans join that task's trace.
+        for _kind, _item, _h in pool.pending:
+            if _kind != "waiter" and isinstance(_item, dict) and _item.get("trace_ctx"):
+                _c = _item["trace_ctx"]
+                rpc._trace_ctx.set((_c["trace_id"], _c["span_id"]))
+                break
         try:
             hops = 0
             used_gcs_fallback = False
@@ -1170,6 +1179,10 @@ class CoreWorker:
         # per process: in an in-process cluster the driver's CoreWorker wins
         # and the shared registry flushes once.
         telemetry.start_flusher(self.gcs.call, self.worker_id, self.node_id)
+        # Same deal for the runtime-span buffer (no-op when tracing is off).
+        from ray_tpu.util import tracing
+
+        tracing.start_span_flusher(self.gcs.call, self.worker_id, self.node_id)
 
     async def _flush_loop(self) -> None:
         while not self.closed:
@@ -1960,7 +1973,7 @@ class CoreWorker:
             "owner_addr": list(self.addr),
             "caller_id": self.worker_id,
         }
-        if config.task_trace_spans:
+        if config.task_trace_spans or config.trace_sample_rate > 0:
             from ray_tpu.util import tracing
 
             ctx = tracing.make_submit_ctx(self, task_id, name)
@@ -2437,7 +2450,7 @@ class CoreWorker:
             "runtime_env": None,
             "concurrency_group": concurrency_group,
         }
-        if config.task_trace_spans:
+        if config.task_trace_spans or config.trace_sample_rate > 0:
             from ray_tpu.util import tracing
 
             ctx = tracing.make_submit_ctx(self, task_id, method_name)
@@ -2681,6 +2694,18 @@ class CoreWorker:
         for t in self._bg_tasks:
             t.cancel()
         await self._flush_task_events()
+        # Flush-on-exit for runtime spans, mirroring the task-event flush:
+        # a short-lived worker's spans must not die in its local buffer.
+        from ray_tpu.util import tracing
+
+        if tracing.enabled():
+            try:
+                await tracing.flush_spans_once(
+                    self.gcs.call, self.worker_id, self.node_id
+                )
+            except Exception:
+                pass
+        tracing.stop_flusher()  # flusher task dies with this loop
         if self.lease_pool._fp_drainer_installed:
             fp = _fp_mod()
             if fp:

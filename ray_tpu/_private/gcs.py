@@ -169,6 +169,10 @@ class GcsServer:
             "gcs.placement_groups"
         )
         self.task_events: List[dict] = []  # ring buffer of task state events
+        # Trace-span ring: submit/execute spans diverted from AddTaskEvents
+        # plus runtime-internal spans delivered via ReportSpans, one store
+        # for list_spans()/timeline()/critical_path().
+        self.spans: List[dict] = []
         # Cluster-wide deadline-enforcement aggregate, fed by worker
         # subprocess flushes (ReportDeadlineStats deltas + exit-time flush).
         # The chaos no-call-outlives-deadline invariant reads `overruns`
@@ -611,6 +615,8 @@ class GcsServer:
         s.register("ListPlacementGroups", self._list_pgs)
         s.register("AddTaskEvents", self._add_task_events)
         s.register("ListTaskEvents", self._list_task_events)
+        s.register("ReportSpans", self._report_spans)
+        s.register("ListSpans", self._list_spans)
         s.register("GetClusterStatus", self._cluster_status)
         s.register("Ping", self._ping)
 
@@ -1543,9 +1549,19 @@ class GcsServer:
     # -- task events / status ----------------------------------------------
 
     async def _add_task_events(self, conn, p):
-        self.task_events.extend(p["events"])
+        for e in p["events"]:
+            # Trace spans (state="SPAN" from make_submit_ctx/execute_scope)
+            # live in their own ring beside the task-state events, so the
+            # span store and task-event store trim independently and
+            # ListSpans never scans lifecycle events.
+            if e.get("state") == "SPAN":
+                self.spans.append(e)
+            else:
+                self.task_events.append(e)
         if len(self.task_events) > 100000:
             self.task_events = self.task_events[-50000:]
+        if len(self.spans) > 100000:
+            self.spans = self.spans[-50000:]
         return {"ok": True}
 
     async def _list_task_events(self, conn, p):
@@ -1553,6 +1569,45 @@ class GcsServer:
         if p.get("job_id"):
             events = [e for e in events if e.get("job_id") == p["job_id"]]
         return {"events": events[-(p.get("limit") or 1000):]}
+
+    async def _report_spans(self, conn, p):
+        """Fold one process's runtime-span flush into the span ring,
+        stamping source attribution the way _report_telemetry stamps
+        flight events. RETRY_NONE: an undelivered batch folds back into
+        the sender's buffer and rides the next flush."""
+        src, node = p["source"], p.get("node")
+        for span in p["spans"]:
+            span.setdefault("worker_id", src)
+            if node is not None:
+                span.setdefault("node_id", node)
+            self.spans.append(span)
+        if len(self.spans) > 100000:
+            self.spans = self.spans[-50000:]
+        return {"ok": True}
+
+    def _drain_local_spans(self) -> None:
+        """Fold this process's own span buffer into the ring at query time
+        (freshness for in-process clusters). Skipped when a flusher is
+        active here — it owns delivery; snapshot-and-reset makes either
+        owner exactly-once."""
+        from ray_tpu.util import tracing
+
+        if tracing.flusher_active():
+            return
+        for span in tracing.span_flush_delta():
+            span.setdefault("worker_id", "gcs")
+            self.spans.append(span)
+
+    async def _list_spans(self, conn, p):
+        """Server-side-filtered span read: the trace_id filter and limit
+        run here, against the ring, so the client never receives the
+        whole table (the satellite fix over the old ListTaskEvents
+        scan-and-filter-client-side path)."""
+        self._drain_local_spans()
+        spans = self.spans
+        if p.get("trace_id"):
+            spans = [s for s in spans if s.get("trace_id") == p["trace_id"]]
+        return {"spans": spans[-(p.get("limit") or 10000):]}
 
     async def _cluster_status(self, conn, p):
         return {

@@ -51,6 +51,15 @@ INLINE = "inline"  # payload bytes present locally
 IN_PLASMA = "plasma"  # payload in shm on some node (addr attached)
 
 
+def _span(name: str, start: float, duration: float, **attrs) -> None:
+    """Record an object-plane span into the active trace. Callers guard on
+    ``rpc._trace_ctx`` being set, so the lazy import (which cycles through
+    ray_tpu.util at module scope) only runs when a trace is live."""
+    from ray_tpu.util import tracing
+
+    tracing.record_span(name, "object", start, duration, **attrs)
+
+
 class MemoryStoreEntry:
     __slots__ = ("kind", "payload", "plasma_addr")
 
@@ -138,6 +147,7 @@ class PlasmaClient:
 
     async def put_serialized(self, oid: str, serialized) -> None:
         t0 = time.monotonic()
+        ws = time.time()
         size = max(1, serialized.total_size)
         reply = await self.conn.call("ObjCreate", {"oid": oid, "size": size, "pin": True})
         if reply.get("exists"):
@@ -145,6 +155,8 @@ class PlasmaClient:
         serialized.write_to(self._slice(reply))
         _TEL_PUT_BYTES.inc(size)
         _TEL_PUT_LAT.observe(time.monotonic() - t0)
+        if rpc._trace_ctx.get() is not None:
+            _span("object.put", ws, time.monotonic() - t0, oid=oid, size=size)
         # Seal as a one-way push: same-connection FIFO means our own later
         # ObjGet/ObjCreate calls observe the seal, and remote readers reach
         # the raylet after the owner advertises the object — both ordered
@@ -178,6 +190,13 @@ class PlasmaClient:
             found[oid] = self._slice(meta)
             _TEL_GET_BYTES.inc(meta["size"])
         _TEL_GET_LAT.observe(time.monotonic() - t0)
+        if rpc._trace_ctx.get() is not None:
+            _span(
+                "object.get",
+                time.time() - (time.monotonic() - t0),
+                time.monotonic() - t0,
+                count=len(oids),
+            )
         return found, reply["missing"]
 
     async def contains(self, oids: List[str]) -> Dict[str, bool]:
@@ -191,11 +210,17 @@ class PlasmaClient:
         purpose feeds the raylet's prioritized pull admission (reference:
         pull_manager.h): "get" > "wait" > "task_arg"."""
         _TEL_PULLS.inc()
+        t0 = time.monotonic()
+        ws = time.time()
         meta = await self.conn.call(
             "PullObject",
             {"oid": oid, "from_addr": list(from_addr), "purpose": purpose},
             timeout=config.rpc_pull_timeout_s,
         )
+        if rpc._trace_ctx.get() is not None:
+            _span(
+                "object.pull", ws, time.monotonic() - t0, oid=oid, purpose=purpose
+            )
         if meta.get("offset") is not None:
             self.held[oid] = self.held.get(oid, 0) + 1
             return self._slice(meta)

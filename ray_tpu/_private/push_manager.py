@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Dict, Tuple
 
 from ray_tpu._private import rpc, telemetry
@@ -64,10 +65,23 @@ class PushManager:
         fut = asyncio.get_running_loop().create_future()
         self.active[key] = fut
         self.stats["pushes_started"] += 1
+        t0 = time.monotonic()
+        ws = time.time()
         try:
             await self._do_push(oid, dest)
             self.stats["pushes_completed"] += 1
             _TEL_PUSHES.inc()
+            if rpc._trace_ctx.get() is not None:
+                from ray_tpu.util import tracing
+
+                tracing.record_span(
+                    "object.push",
+                    "object",
+                    ws,
+                    time.monotonic() - t0,
+                    oid=oid,
+                    dest=f"{dest[0]}:{dest[1]}",
+                )
             fut.set_result(True)
         except BaseException as e:
             if not fut.done():

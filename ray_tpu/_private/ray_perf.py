@@ -290,6 +290,39 @@ def _bench_telemetry_overhead() -> float:
     return best
 
 
+def _bench_trace_span_record() -> float:
+    """Nanoseconds per runtime span record with tracing enabled and an
+    active trace context — the price every instrumented hop (lease, arg
+    fetch, object get/put, serve admission) pays on a sampled request.
+    Gated with a ceiling: a regression here (id generation doing syscalls,
+    lock contention on the buffer) taxes every traced hop. The disabled
+    path is covered implicitly by the existing floors: with tracing off,
+    instrumented sites reduce to one ContextVar.get() returning None."""
+    from ray_tpu._private import rpc
+    from ray_tpu.util import tracing
+
+    prev = tracing.config.trace_sample_rate
+    tracing.config.trace_sample_rate = 1.0
+    tok = rpc._trace_ctx.set(("deadbeefdeadbeef", "cafebabecafebabe"))
+    try:
+        n = 200_000
+        for _ in range(10_000):  # warmup
+            tracing.record_span("perf.probe", "perf", 0.0, 0.001, oid="x")
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                tracing.record_span("perf.probe", "perf", 0.0, 0.001, oid="x")
+            dt = time.perf_counter() - t0
+            best = min(best, dt / n * 1e9)
+    finally:
+        rpc._trace_ctx.reset(tok)
+        tracing.config.trace_sample_rate = prev
+        tracing.reset()
+    print(f"trace span record overhead: {best:.0f} ns")
+    return best
+
+
 def _bench_ingest() -> float:
     """Rows/s through the streaming ingest fast path: a fused read->map
     stage per block (metadata rides the refs), pipelined block fetch, and
@@ -638,6 +671,7 @@ def main(json_path: str = "") -> Dict[str, float]:
     results["gcs_failover_converge_s"] = _bench_gcs_failover()
     results["pubsub_fanout_per_s"] = _bench_pubsub_fanout()
     results["telemetry_overhead_ns"] = _bench_telemetry_overhead()
+    results["trace_span_record_ns"] = _bench_trace_span_record()
     if json_path:
         with open(json_path, "w") as f:
             json.dump(results, f, indent=2)

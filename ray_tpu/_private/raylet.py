@@ -326,6 +326,12 @@ class LeaseRequest:
         self.demand = demand
         self.payload = payload
         self.queued_at = time.monotonic()  # grant-latency histogram origin
+        self.queued_wall = time.time()  # lease-lifecycle span origin
+        # Trace context of the requesting frame (set by rpc dispatch around
+        # the handler that constructs us). Grant-time spans are emitted long
+        # after that dispatch task is gone, so the ctx is pinned here.
+        self.trace_ctx = rpc._trace_ctx.get()
+        self.grant_started: Optional[float] = None
         self.fut: asyncio.Future = asyncio.get_running_loop().create_future()
 
 
@@ -1749,6 +1755,7 @@ class Raylet:
                 # same-id request queued behind us in this very loop pass is
                 # already visible as a duplicate.
                 self._record_granted(req.lease_id)
+                req.grant_started = time.monotonic()
                 self.grants_in_flight += 1
                 rpc.spawn(self._grant(req))
                 granted_any = True
@@ -1767,27 +1774,34 @@ class Raylet:
             ((req.payload.get("spec") or {}).get("runtime_env") or {})
             .get("container")
         )
+        from ray_tpu.util import tracing
+
         try:
-            if container:
-                # Containerized actors get a dedicated fresh worker booted
-                # inside the image — shared pool workers cannot switch
-                # containers mid-process.
-                handle = await self._start_worker(container=container)
-                await handle.registered
-            else:
-                # A worker dying between spawn and registration is a
-                # transient of process storms, not a property of the lease:
-                # retry with a fresh worker before failing the request.
-                attempt = 0
-                while True:
-                    try:
-                        handle = await self._get_or_start_idle_worker()
-                        break
-                    except rpc.RpcError:
-                        attempt += 1
-                        if attempt >= 3:
-                            raise
-                        await asyncio.sleep(0.1 * attempt)
+            with tracing.span_scope(
+                "lease.worker_start", "lease", ctx=req.trace_ctx,
+                lease_id=req.lease_id,
+            ):
+                if container:
+                    # Containerized actors get a dedicated fresh worker
+                    # booted inside the image — shared pool workers cannot
+                    # switch containers mid-process.
+                    handle = await self._start_worker(container=container)
+                    await handle.registered
+                else:
+                    # A worker dying between spawn and registration is a
+                    # transient of process storms, not a property of the
+                    # lease: retry with a fresh worker before failing the
+                    # request.
+                    attempt = 0
+                    while True:
+                        try:
+                            handle = await self._get_or_start_idle_worker()
+                            break
+                        except rpc.RpcError:
+                            attempt += 1
+                            if attempt >= 3:
+                                raise
+                            await asyncio.sleep(0.1 * attempt)
         except rpc.RpcError as e:
             self.available = self.available + req.demand
             self._mark_dirty()
@@ -1819,7 +1833,39 @@ class Raylet:
         self.leases[req.lease_id] = handle
         self._tel_refresh_gauges()
         if not req.fut.done():
-            self._tel_grant_latency.observe(time.monotonic() - req.queued_at)
+            now_m = time.monotonic()
+            self._tel_grant_latency.observe(now_m - req.queued_at)
+            if req.trace_ctx is not None:
+                # Lease-lifecycle spans, parented into the requesting task's
+                # trace: one umbrella span for request->grant, with the
+                # queue wait and the grant work as its children.
+                gs = req.grant_started if req.grant_started is not None else now_m
+                sid = tracing.record_span(
+                    "raylet.lease",
+                    "lease",
+                    req.queued_wall,
+                    now_m - req.queued_at,
+                    ctx=req.trace_ctx,
+                    lease_id=req.lease_id,
+                )
+                child = (req.trace_ctx[0], sid)
+                tracing.record_span(
+                    "lease.queue",
+                    "lease",
+                    req.queued_wall,
+                    gs - req.queued_at,
+                    ctx=child,
+                    lease_id=req.lease_id,
+                )
+                tracing.record_span(
+                    "lease.grant",
+                    "lease",
+                    req.queued_wall + (gs - req.queued_at),
+                    now_m - gs,
+                    ctx=child,
+                    lease_id=req.lease_id,
+                    worker_id=handle.worker_id,
+                )
             req.fut.set_result(self._grant_reply(handle, req.lease_id))
         else:  # caller gave up; return resources
             self._release_lease(req.lease_id, dirty=False)

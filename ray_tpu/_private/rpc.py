@@ -350,6 +350,29 @@ def remaining_budget() -> Optional[float]:
     return deadline - asyncio.get_running_loop().time()
 
 
+# ---------------------------------------------------------------------------
+# Trace-context propagation.
+#
+# The (trace_id, span_id) of the active tracing span, riding request frames
+# exactly like the deadline TTL: stamped by the sender when set, restored
+# around the handler on the receiving side (per dispatch task — same
+# context-copy isolation as ``_ambient_deadline``). The var lives HERE, not
+# in util/tracing.py, because this module is the bottom of the import graph
+# (tracing builds on it; importing util from rpc would cycle through the
+# worker stack). ``ray_tpu.util.tracing`` owns everything above the raw
+# contextvar: span recording, sampling, flushing, scopes.
+# ---------------------------------------------------------------------------
+
+_trace_ctx: contextvars.ContextVar[Optional[tuple]] = contextvars.ContextVar(
+    "ray_tpu_trace_ctx", default=None
+)
+
+
+def current_trace_ctx() -> Optional[tuple]:
+    """(trace_id, span_id) of the active span, or None."""
+    return _trace_ctx.get()
+
+
 class DeadlineStats:
     """Process-wide counters for deadline enforcement; the chaos runner
     resets them per seed and the no-call-outlives-deadline invariant reads
@@ -621,7 +644,9 @@ class Connection:
         time, so a frame a chaos schedule delays ships with its budget
         already shrunk and the receiver's reconstructed deadline stays
         honest. A blob frame packs as its control message (payload slot 4
-        rewritten to the byte length) followed by the raw buffers."""
+        rewritten to the byte length) followed by the raw buffers; blob
+        frames never carry trace context (slot 4 is the byte length and the
+        data plane is instrumented at its managers instead)."""
         kind = msg[1]
         if kind == _KIND_BLOB or kind == _KIND_BLOB_REP:
             buffers = _blob_buffers(msg[4])
@@ -632,7 +657,9 @@ class Connection:
             _TEL_BYTES_OUT[kind].inc(len(out[0]) + total)
             return out
         if len(msg) > 4 and msg[4] is not None:
-            msg = [msg[0], msg[1], msg[2], msg[3], msg[4] - self._loop.time()]
+            # Rebuild in place so a trailing trace-context slot survives.
+            msg = list(msg)
+            msg[4] = msg[4] - self._loop.time()
         packed = _packb(msg)
         _TEL_FRAMES_OUT[kind].inc()
         _TEL_BYTES_OUT[kind].inc(len(packed))
@@ -725,8 +752,11 @@ class Connection:
         fut.rpc_msgid = msgid
         self._pending[msgid] = fut
         frame = [msgid, _KIND_REQ, method, payload]
-        if deadline is not None:
+        tctx = _trace_ctx.get()
+        if deadline is not None or tctx is not None:
             frame.append(deadline)
+        if tctx is not None:
+            frame.append([tctx[0], tctx[1]])
         try:
             self._send_nowait(frame)
         except ConnectionLost:
@@ -743,8 +773,13 @@ class Connection:
         Loop thread only."""
         msgid = next(self._msgid)
         self._cb_pending[msgid] = cb
+        frame = [msgid, _KIND_REQ, method, payload]
+        tctx = _trace_ctx.get()
+        if tctx is not None:
+            frame.append(None)
+            frame.append([tctx[0], tctx[1]])
         try:
-            self._send_nowait([msgid, _KIND_REQ, method, payload])
+            self._send_nowait(frame)
         except ConnectionLost:
             self._cb_pending.pop(msgid, None)
             raise
@@ -949,11 +984,15 @@ class Connection:
                     )
                     return
                 deadline = self._loop.time() + ttl
+            tctx = None
+            if len(msg) > 5 and msg[5] is not None:
+                tctx = (msg[5][0], msg[5][1])
             sync_h = self._sync_handlers.get(method)
             if sync_h is not None:
-                # Set the ambient deadline around the inline handler so any
-                # coroutine it spawn()s inherits the remaining budget.
+                # Set the ambient deadline (and trace context) around the
+                # inline handler so any coroutine it spawn()s inherits both.
                 token = _ambient_deadline.set(deadline)
+                ttoken = _trace_ctx.set(tctx)
                 try:
                     sync_h(self, msgid, payload)
                 except Exception as e:
@@ -961,9 +1000,10 @@ class Connection:
                         msgid, method, f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
                     )
                 finally:
+                    _trace_ctx.reset(ttoken)
                     _ambient_deadline.reset(token)
                 return
-            spawn(self._dispatch(msgid, method, payload, deadline))
+            spawn(self._dispatch(msgid, method, payload, deadline, tctx))
         elif kind == _KIND_PUSH:
             spawn(self._dispatch(None, method, payload))
         else:
@@ -985,13 +1025,19 @@ class Connection:
                     fut.set_exception(_typed_error(payload))
 
     async def _dispatch(
-        self, msgid, method: str, payload, deadline: Optional[float] = None
+        self,
+        msgid,
+        method: str,
+        payload,
+        deadline: Optional[float] = None,
+        trace_ctx: Optional[tuple] = None,
     ) -> None:
         handler = self._handlers.get(method)
         # Each dispatch runs in its own task (own context copy), so setting
-        # the ambient deadline here scopes it to this handler and every call
-        # it makes downstream.
+        # the ambient deadline (and trace context) here scopes them to this
+        # handler and every call it makes downstream.
         _ambient_deadline.set(deadline)
+        _trace_ctx.set(trace_ctx)
         obs = self._dispatch_observer
         t0 = self._loop.time() if obs is not None else 0.0
         try:

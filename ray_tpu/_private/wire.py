@@ -2,8 +2,10 @@
 
 Frames on the wire are ``[msgid, kind, method, payload]`` — requests may
 carry a fifth element, the remaining deadline budget in seconds (rpc.py),
-and blob frames (kinds 4/5) carry the sidecar byte length there instead —
-and the payloads are plain msgpack dicts. This registry is the single
+and a sixth, the active trace context as ``[trace_id, span_id]``
+(tracing); blob frames (kinds 4/5) carry the sidecar byte length in the
+fifth slot instead and never carry trace context — and the payloads are
+plain msgpack dicts. This registry is the single
 versioned description of the payload shape for the high-traffic message
 types: each entry declares the keys a producer must send (``required``),
 the keys a consumer may additionally read (``optional``), and the method's
@@ -75,6 +77,15 @@ class WireSchema:
     ``p["data"]``), ``"reply"`` (the handler returns ``rpc.Blob`` and the
     bytes stream into the caller's registered sink). ``None`` = plain
     control frames only.
+
+    ``trace`` declares whether the method's request frames carry the
+    active trace context (frame slot 6, stamped by rpc.py whenever the
+    caller has a span active): ``True`` for methods on a request's
+    critical path whose handler work belongs inside the trace, ``False``
+    for control/background traffic (and for methods whose request travels
+    as a kind-4 blob frame, which has no trace slot). Every declared
+    schema must pick one — the lint rule ``wire-trace-undeclared`` fails
+    on ``None`` so new methods make the choice explicitly.
     """
 
     required: FrozenSet[str] = frozenset()
@@ -82,6 +93,7 @@ class WireSchema:
     retry: str = RETRY_NONE
     dedup_key: Optional[str] = None
     blob: Optional[str] = None
+    trace: Optional[bool] = None
 
     def __post_init__(self):
         if self.retry not in _RETRY_CLASSES:
@@ -98,9 +110,10 @@ def _s(
     retry: str = RETRY_NONE,
     dedup_key: Optional[str] = None,
     blob: Optional[str] = None,
+    trace: Optional[bool] = None,
 ) -> WireSchema:
     return WireSchema(
-        frozenset(required), frozenset(optional), retry, dedup_key, blob
+        frozenset(required), frozenset(optional), retry, dedup_key, blob, trace
     )
 
 
@@ -115,49 +128,55 @@ SCHEMAS: Dict[str, WireSchema] = {
     # attaches when re-registering with a restarted GCS: it confirms
     # restored-ALIVE actors without a per-actor probe storm.
     "RegisterNode": _s(
-        ["node_id", "addr", "resources"], ["labels", "actors"], retry=RETRY_SAFE
+        ["node_id", "addr", "resources"], ["labels", "actors"],
+        retry=RETRY_SAFE, trace=False,
     ),
     "UpdateResources": _s(
-        ["node_id", "available"], ["total", "version"], retry=RETRY_SAFE
+        ["node_id", "available"], ["total", "version"],
+        retry=RETRY_SAFE, trace=False,
     ),
     # Keyed upsert on actor_id: a retried CreateActor attaches to the
     # existing record instead of double-enqueueing (gcs.py _create_actor).
     "CreateActor": _s(
-        ["spec"], ["wait_alive", "get_if_exists"], retry=RETRY_SAFE
+        ["spec"], ["wait_alive", "get_if_exists"], retry=RETRY_SAFE,
+        trace=False,
     ),
-    "GetActor": _s(["actor_id"], retry=RETRY_SAFE),
+    "GetActor": _s(["actor_id"], retry=RETRY_SAFE, trace=False),
     "ReportActorReady": _s(
         ["actor_id"], ["addr", "worker_id", "node_id", "error"],
-        retry=RETRY_SAFE,
+        retry=RETRY_SAFE, trace=False,
     ),
     "ReportWorkerDied": _s(
-        ["actor_ids"], ["cause", "worker_id"], retry=RETRY_SAFE
+        ["actor_ids"], ["cause", "worker_id"], retry=RETRY_SAFE, trace=False
     ),
     # Worker-subprocess deadline-enforcement deltas (snapshot-and-reset on
     # the worker side). Deltas are additive, so a blind retry after a lost
     # reply would double-count: RETRY_NONE — a dropped report just folds
     # into the worker's next flush.
     "ReportDeadlineStats": _s(
-        ["worker_id", "met", "shed", "enforced", "overruns"], retry=RETRY_NONE
+        ["worker_id", "met", "shed", "enforced", "overruns"],
+        retry=RETRY_NONE, trace=False,
     ),
-    "KillActor": _s(["actor_id"], ["no_restart"], retry=RETRY_SAFE),
+    "KillActor": _s(["actor_id"], ["no_restart"], retry=RETRY_SAFE, trace=False),
     # NB: a KVPut retry after a lost reply reports added=False on the
     # re-issue when overwrite=False — the effect is still exactly-once.
-    "KVPut": _s(["key", "value"], ["ns", "overwrite"], retry=RETRY_SAFE),
-    "KVGet": _s(["key"], ["ns"], retry=RETRY_SAFE),
-    "Subscribe": _s(["channel"], retry=RETRY_SAFE),
-    "Unsubscribe": _s(["channel"], retry=RETRY_SAFE),
+    "KVPut": _s(
+        ["key", "value"], ["ns", "overwrite"], retry=RETRY_SAFE, trace=False
+    ),
+    "KVGet": _s(["key"], ["ns"], retry=RETRY_SAFE, trace=False),
+    "Subscribe": _s(["channel"], retry=RETRY_SAFE, trace=False),
+    "Unsubscribe": _s(["channel"], retry=RETRY_SAFE, trace=False),
     # Pubsub is at-least-once: a retried Publish may deliver twice.
-    "Publish": _s(["channel", "msg"], retry=RETRY_SAFE),
+    "Publish": _s(["channel", "msg"], retry=RETRY_SAFE, trace=False),
     # Server->client pubsub delivery push; "seq" is the channel's monotonic
     # publish seqno (gap detection, pubsub.py).
-    "Pub": _s(["channel", "msg"], ["seq"]),
+    "Pub": _s(["channel", "msg"], ["seq"], trace=False),
     # Per-tick coalesced fan-out: one frame carries every publish on one
     # channel from one flush tick as [channel, msg, seq] triples.
-    "PubBatch": _s(["items"]),
+    "PubBatch": _s(["items"], trace=False),
     # Channel-state resync for a subscriber that detected a seq gap (its
     # backlog was shed, or it missed a window across a reconnect).
-    "Snapshot": _s(["channel"], retry=RETRY_SAFE),
+    "Snapshot": _s(["channel"], retry=RETRY_SAFE, trace=False),
     # -- raylet scheduling ---------------------------------------------------
     # Deduped by the raylet's granted-lease ledger (PR 2): a retried frame
     # with the same lease_id mirrors the original grant outcome.
@@ -167,51 +186,68 @@ SCHEMAS: Dict[str, WireSchema] = {
          "locality"],
         retry=RETRY_DEDUP,
         dedup_key="lease_id",
+        trace=True,
     ),
-    "CancelWorkerLease": _s(["lease_id"], retry=RETRY_SAFE),
+    "CancelWorkerLease": _s(["lease_id"], retry=RETRY_SAFE, trace=False),
     "ReturnWorker": _s(
-        ["lease_id"], ["dirty"], retry=RETRY_DEDUP, dedup_key="lease_id"
+        ["lease_id"], ["dirty"], retry=RETRY_DEDUP, dedup_key="lease_id",
+        trace=False,
     ),
     # Deduped on spec.actor_id ("actor:<id>" lease ids) via the raylet's
     # actor_creations_in_flight set + grant ledger.
     "LeaseWorkerForActor": _s(
-        ["spec"], retry=RETRY_DEDUP, dedup_key="spec"
+        ["spec"], retry=RETRY_DEDUP, dedup_key="spec", trace=True
     ),
-    "KillWorker": _s(["worker_id"], ["probe", "force"], retry=RETRY_SAFE),
+    "KillWorker": _s(
+        ["worker_id"], ["probe", "force"], retry=RETRY_SAFE, trace=False
+    ),
     # -- task dispatch (ordered streams: retries owned by the task layer) ----
-    "PushTask": _s(["spec"]),
-    "PushActorTask": _s(["spec"]),
+    "PushTask": _s(["spec"], trace=True),
+    "PushActorTask": _s(["spec"], trace=True),
     # -- object plane --------------------------------------------------------
     "ObjCreate": _s(
-        ["oid", "size"], ["pin"], retry=RETRY_DEDUP, dedup_key="oid"
+        ["oid", "size"], ["pin"], retry=RETRY_DEDUP, dedup_key="oid",
+        trace=True,
     ),
-    "ObjSeal": _s(["oid"], retry=RETRY_SAFE),
-    "WaitObject": _s(["oid"], ["timeout"], retry=RETRY_SAFE),
+    "ObjSeal": _s(["oid"], retry=RETRY_SAFE, trace=True),
+    "WaitObject": _s(["oid"], ["timeout"], retry=RETRY_SAFE, trace=True),
     "PushStart": _s(
-        ["oid", "size"], retry=RETRY_DEDUP, dedup_key="oid"
+        ["oid", "size"], retry=RETRY_DEDUP, dedup_key="oid", trace=True
     ),
     # Blob-sidecar data plane: the chunk bytes are NOT a payload key — they
     # follow the control frame on the stream. Blob calls are never
-    # transparently retried (the sink may be a live arena span).
-    "PushChunk": _s(["oid", "offset"], blob="push"),
-    "FetchChunk": _s(["oid", "offset", "size"], blob="reply"),
+    # transparently retried (the sink may be a live arena span). PushChunk
+    # requests ARE kind-4 blob frames, so they cannot carry trace context;
+    # FetchChunk requests are plain control frames (only the reply blobs).
+    "PushChunk": _s(["oid", "offset"], blob="push", trace=False),
+    "FetchChunk": _s(["oid", "offset", "size"], blob="reply", trace=True),
     # -- ray-client plane ----------------------------------------------------
     # Small puts send "payload" inline; large puts ship the serialized
     # region as a kind-4 blob which the server reads back as "data".
-    "CPut": _s([], ["payload", "data"], blob="request"),
+    "CPut": _s([], ["payload", "data"], blob="request", trace=False),
     # -- logs / observability ------------------------------------------------
     # Runtime-telemetry flush (telemetry.py flush_delta): counter/histogram
     # deltas plus drained flight-recorder events. Additive like
     # ReportDeadlineStats, so the same RETRY_NONE reasoning applies — an
     # undelivered payload is folded back locally and rides the next flush.
     "ReportTelemetry": _s(
-        ["source", "node", "metrics"], ["events"], retry=RETRY_NONE
+        ["source", "node", "metrics"], ["events"], retry=RETRY_NONE,
+        trace=False,
     ),
     # Read of the GCS telemetry aggregate (dashboard /metrics).
-    "GetTelemetry": _s([], retry=RETRY_SAFE),
+    "GetTelemetry": _s([], retry=RETRY_SAFE, trace=False),
     "GetLog": _s(
-        [], ["filename", "worker_id", "stream", "tail"], retry=RETRY_SAFE
+        [], ["filename", "worker_id", "stream", "tail"], retry=RETRY_SAFE,
+        trace=False,
     ),
+    # Runtime-span flush (tracing.span_flush_delta): same snapshot-and-reset
+    # delta semantics as ReportTelemetry, same RETRY_NONE reasoning.
+    "ReportSpans": _s(
+        ["source", "node", "spans"], retry=RETRY_NONE, trace=False
+    ),
+    # Server-side-filtered span read: trace_id narrows to one trace, limit
+    # bounds the reply — the client never ships the whole span ring.
+    "ListSpans": _s([], ["trace_id", "limit"], retry=RETRY_SAFE, trace=False),
 }
 
 

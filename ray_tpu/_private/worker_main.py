@@ -324,7 +324,17 @@ class Executor:
             ):
                 (args, kwargs), _ = serialization.deserialize(wire["args_blob"])
         else:
+            t_fetch = time.time()
             args, kwargs = exec_t.run_on_loop(self.load_args(wire))
+            if "trace_ctx" in wire:
+                tracing.record_span(
+                    "task.arg_fetch",
+                    "arg_fetch",
+                    t_fetch,
+                    time.time() - t_fetch,
+                    ctx=tracing.ctx_from_wire(wire),
+                    task_id=wire["task_id"],
+                )
         t_args = time.time()
         # -- execute
         renv = wire.get("runtime_env") or {}
@@ -546,6 +556,15 @@ class Executor:
             fn = await self.get_function(wire["func_id"])
             args, kwargs = await self.load_args(wire)
             t_args = time.time()
+            if "trace_ctx" in wire:
+                tracing.record_span(
+                    "task.arg_fetch",
+                    "arg_fetch",
+                    t0,
+                    t_args - t0,
+                    ctx=tracing.ctx_from_wire(wire),
+                    task_id=task_id,
+                )
             from ray_tpu.runtime_env.context import scoped_env_vars
 
             with scoped_env_vars(renv.get("env_vars")), tracing.execute_scope(
@@ -745,9 +764,18 @@ class Executor:
             cls = await self.get_function(wire["func_id"])
             args, kwargs = await self.load_args(wire)
             loop = asyncio.get_running_loop()
-            self.actor_instance = await loop.run_in_executor(
-                self.pool, lambda: cls(*args, **kwargs)
-            )
+            tctx = tracing.ctx_from_wire(wire) or tracing.current_context()
+
+            def _construct():
+                # Trace context does not cross run_in_executor; re-set it so
+                # work submitted from __init__ joins the creation trace.
+                tok = tracing.set_context(tctx)
+                try:
+                    return cls(*args, **kwargs)
+                finally:
+                    tracing.reset_context(tok)
+
+            self.actor_instance = await loop.run_in_executor(self.pool, _construct)
             self.actor_all_sync = not any(
                 asyncio.iscoroutinefunction(m)
                 for _, m in inspect.getmembers(
@@ -850,13 +878,32 @@ class Executor:
 
                 args, kwargs = await self.load_args(wire)
                 loop = asyncio.get_running_loop()
-                result = await loop.run_in_executor(
-                    None, lambda: dag_exec_loop(self.actor_instance, *args)
-                )
+                dag_tctx = tracing.ctx_from_wire(wire) or tracing.current_context()
+
+                def _dag_run():
+                    # Trace context does not cross run_in_executor; re-set it
+                    # so submissions from inside the DAG loop stay traced.
+                    tok = tracing.set_context(dag_tctx)
+                    try:
+                        return dag_exec_loop(self.actor_instance, *args)
+                    finally:
+                        tracing.reset_context(tok)
+
+                result = await loop.run_in_executor(None, _dag_run)
                 returns = await self.store_returns(wire, result)
                 return {"returns": returns}
             method = getattr(self.actor_instance, wire["actor_method"])
+            t_fetch = time.time()
             args, kwargs = await self.load_args(wire)
+            if "trace_ctx" in wire:
+                tracing.record_span(
+                    "task.arg_fetch",
+                    "arg_fetch",
+                    t_fetch,
+                    time.time() - t_fetch,
+                    ctx=tracing.ctx_from_wire(wire),
+                    task_id=wire["task_id"],
+                )
             loop = asyncio.get_running_loop()
 
             with tracing.execute_scope(self.core, wire):
@@ -972,6 +1019,26 @@ class Executor:
                 )
             except Exception:
                 telemetry.restore_delta(tel)
+        # And the trace plane: buffered task-event spans plus runtime spans
+        # must outlive the worker (flush-on-exit span delivery) — a span
+        # recorded milliseconds before exit is exactly the one a trace of a
+        # short task needs.
+        if tracing.enabled():
+            try:
+                await asyncio.wait_for(self.core._flush_task_events(), timeout=1.0)
+            except Exception:
+                pass
+            try:
+                await asyncio.wait_for(
+                    tracing.flush_spans_once(
+                        self.core.gcs.call,
+                        self.core.worker_id,
+                        self.core.node_id,
+                    ),
+                    timeout=1.0,
+                )
+            except Exception:
+                pass
         asyncio.get_running_loop().call_later(0.05, os._exit, 0)
         return {"ok": True}
 

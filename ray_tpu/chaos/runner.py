@@ -588,9 +588,13 @@ def run_seed(session: _Session, scenario: Scenario, seed: int,
         # Per-seed telemetry: zero the registry and both flight-recorder
         # rings so a violation's dump holds THIS seed's timeline only.
         telemetry.reset_all()
+        from ray_tpu.util import tracing
+
+        tracing.reset()
         if gcs is not None:
             gcs.telemetry = telemetry.new_aggregate()
             gcs.flight_events.clear()
+            gcs.spans.clear()
         return interceptors.install(schedule)
 
     async def _uninstall():
@@ -970,6 +974,10 @@ def run_sched_seed(cluster, client, scenario: Scenario, seed: int,
             telemetry.reset_all()
             gcs.telemetry = telemetry.new_aggregate()
             gcs.flight_events.clear()
+            gcs.spans.clear()
+            from ray_tpu.util import tracing
+
+            tracing.reset()
 
     cluster.run(_reset(), timeout=30)
 
@@ -1146,6 +1154,7 @@ def run_scenario(scenario: Scenario, seeds: List[int], corpus: Optional[str],
                 if corpus:
                     _append_corpus(corpus, result)
                     _dump_flight(corpus, session, result)
+                    _dump_spans(corpus, session, result)
                 # One bad seed must not poison the next: fresh cluster.
                 session.close()
                 session = _Session(scenario)
@@ -1180,6 +1189,34 @@ def _dump_flight(corpus: str, session: _Session, result: SeedResult) -> Optional
         print(f"      flight dump failed: {type(e).__name__}: {e}")
         return None
     print(f"      flight recorder: {n} events -> {path}")
+    return path
+
+
+def _dump_spans(corpus: str, session: _Session, result: SeedResult) -> Optional[str]:
+    """Write the merged span timeline for a failing seed as chrome://tracing
+    JSON next to the flight-recorder dump: the GCS's span ring (flushed from
+    workers and diverted from task events) merged with this process's
+    unflushed local buffer. Loads directly into Perfetto for causal triage."""
+    from ray_tpu.util import tracing
+    from ray_tpu.util.state.api import _span_timeline_events
+
+    gcs = session.cluster.gcs_server
+    spans = list(gcs.spans) if gcs is not None else []
+    spans.extend(tracing.snapshot())
+    if not spans:
+        return None
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(corpus)),
+        f"spans_{result.scenario}_{result.seed}.json",
+    )
+    try:
+        events = _span_timeline_events(spans)
+        with open(path, "w") as f:
+            json.dump(events, f)
+    except Exception as e:  # triage artifact must never mask the violation
+        print(f"      span dump failed: {type(e).__name__}: {e}")
+        return None
+    print(f"      span timeline: {len(events)} spans -> {path}")
     return path
 
 

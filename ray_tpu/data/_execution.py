@@ -280,11 +280,18 @@ class StreamingExecutor:
 
     def execute(self, ops: List[LogicalOp]) -> Iterator[RefBundle]:
         """Yields RefBundles for the fully-applied plan."""
+        from ray_tpu.util import tracing
+
         try:
             it = self._build(ops)
             if not self.preserve_order:
                 it = self._completion_order(it)
-            yield from it
+            # One span over the whole streamed execution, active while the
+            # stage pumps run: every stage task submitted inside joins a
+            # single trace (rooted here when none is ambient).
+            yield from tracing.iter_scope(
+                it, "data.execute", "data", stages=len(ops)
+            )
         finally:
             self._teardown_pools()
 

@@ -32,11 +32,17 @@ This pass makes the wire protocol checkable at lint time:
    budgets — ``common.config``'s ``serve_*`` knobs own those). Tests,
    devtools, and examples may use literals.
 
+4. **Trace declaration** — every message type declared in
+   :mod:`ray_tpu._private.wire` must state whether its request frames
+   carry the sixth-slot trace context (``trace=True``/``trace=False``):
+   an undeclared schema (``trace=None``) means nobody decided whether
+   the hop joins the distributed trace, and the docs table can't say.
+
 Non-literal method names (e.g. the dashboard's generic proxy
 ``conn.call(method, ...)``) are outside the static horizon and skipped.
 Suppression: ``# aio-lint: disable=<rule>`` with rules
 ``unknown-rpc-method``, ``orphan-rpc-handler``, ``payload-key-drift``,
-``rpc-magic-timeout``.
+``rpc-magic-timeout``, ``wire-trace-undeclared``.
 
 Run: ``python -m ray_tpu.devtools.rpc_check [--markdown] [paths]``.
 """
@@ -61,6 +67,7 @@ RULE_UNKNOWN = "unknown-rpc-method"
 RULE_ORPHAN = "orphan-rpc-handler"
 RULE_DRIFT = "payload-key-drift"
 RULE_TIMEOUT = "rpc-magic-timeout"
+RULE_TRACE = "wire-trace-undeclared"
 
 _CALL_METHODS = {
     "call",
@@ -416,6 +423,7 @@ def check(paths: Optional[List[str]] = None) -> List[Finding]:
 
     findings.extend(_check_payload_drift(inv))
     findings.extend(_check_magic_timeouts(inv, rpc_path))
+    findings.extend(_check_trace_declared())
 
     # Apply inline suppressions from the source files involved.
     sup_cache: Dict[str, Dict[int, Set[str]]] = {}
@@ -561,6 +569,48 @@ def _check_magic_timeouts(inv: Inventory, rpc_path: str) -> List[Finding]:
     return findings
 
 
+def _check_trace_declared() -> List[Finding]:
+    """Every wire schema must declare trace propagation (trace=True/False).
+
+    ``trace=None`` means nobody decided whether this method's request
+    frames carry the sixth-slot trace context — new schemas must take a
+    position so the committed protocol table stays complete.
+    """
+    from ray_tpu._private import wire
+
+    findings: List[Finding] = []
+    wire_path = os.path.abspath(wire.__file__)
+    try:
+        with open(wire_path, "r", encoding="utf-8") as fh:
+            src_lines = fh.read().splitlines()
+    except OSError:
+        src_lines = []
+
+    def _line_of(method: str) -> int:
+        needle = f'"{method}":'
+        for i, line in enumerate(src_lines, 1):
+            if needle in line:
+                return i
+        return 1
+
+    for method in sorted(wire.SCHEMAS):
+        if wire.SCHEMAS[method].trace is None:
+            findings.append(
+                Finding(
+                    wire_path,
+                    _line_of(method),
+                    0,
+                    RULE_TRACE,
+                    f"wire schema {method!r} does not declare trace "
+                    "propagation — set trace=True (request frames carry "
+                    "the trace-context slot) or trace=False (control/"
+                    "background traffic, or a kind-4 blob request whose "
+                    "fifth slot is the byte length)",
+                )
+            )
+    return findings
+
+
 def markdown_table(paths: Optional[List[str]] = None) -> str:
     """The versioned wire-protocol inventory committed to docs/."""
     from ray_tpu._private import wire
@@ -591,7 +641,12 @@ def markdown_table(paths: Optional[List[str]] = None) -> str:
         "budget to pass downstream (see `ray_tpu/_private/rpc.py`). Blob",
         "frames (kinds 4 and 5) put the sidecar byte length in the fifth",
         "slot instead and stream that many raw bytes after the control",
-        "frame — the data plane's zero-copy path. Schemas",
+        "frame — the data plane's zero-copy path. Request frames may also",
+        "carry a sixth element, the active trace context as",
+        "`[trace_id, span_id]` — the receiver re-establishes it as the",
+        "ambient span parent for the handler so runtime spans recorded on",
+        "the far side join the caller's trace (see `docs/observability.md`,",
+        "\"Distributed tracing\"). Schemas",
         "for the starred methods live in `ray_tpu/_private/wire.py`; the",
         "lint gate fails on drift. Retry is the method's wire retry class",
         "consumed by `rpc.RetryableConnection`: `safe` = idempotent, retried",
@@ -600,9 +655,13 @@ def markdown_table(paths: Optional[List[str]] = None) -> str:
         "one-way kind-4 blob into a registered sink, `request` = kind-4",
         "blob the handler reads as `p[\"data\"]`, `reply` = the handler",
         "returns `rpc.Blob` and the caller's sink receives the bytes.",
+        "Trace is whether request frames for the method carry the",
+        "trace-context slot: ✓ = propagates (a traced caller's context",
+        "rides the frame), — = control/background traffic that never",
+        "joins a request trace (kind-4 blob requests cannot carry it).",
         "",
-        "| Method | Schema | Retry | Blob | Servers (handler) | Client call sites | Payload keys |",
-        "|---|---|---|---|---|---|---|",
+        "| Method | Schema | Retry | Blob | Trace | Servers (handler) | Client call sites | Payload keys |",
+        "|---|---|---|---|---|---|---|---|",
     ]
     for method in sorted(by_method):
         info = by_method[method]
@@ -629,11 +688,12 @@ def markdown_table(paths: Optional[List[str]] = None) -> str:
             else:
                 retry = schema.retry
             blob = schema.blob or "—"
+            trace = "✓" if schema.trace else "—"
         else:
-            keys, star, retry, blob = "", "", "", ""
+            keys, star, retry, blob, trace = "", "", "", "", ""
         lines.append(
-            f"| `{method}` | {star} | {retry} | {blob} | {servers} | "
-            f"{callers} | {keys} |"
+            f"| `{method}` | {star} | {retry} | {blob} | {trace} | "
+            f"{servers} | {callers} | {keys} |"
         )
     lines.append("")
     lines.append(

@@ -288,11 +288,26 @@ def cmd_list(args) -> None:
 
 def cmd_timeline(args) -> None:
     import ray_tpu
-    from ray_tpu.util.state import timeline
+    from ray_tpu.util.state import critical_path, timeline
 
     ray_tpu.init(address=_resolve_address(args))
-    events = timeline(args.output)
-    print(f"wrote {len(events)} events to {args.output}")
+    if args.critical_path:
+        report = critical_path(trace_id=args.trace_id)
+        if not report["path"]:
+            print("no trace spans recorded (enable RAY_TPU_TASK_TRACE_SPANS=1 "
+                  "or RAY_TPU_TRACE_SAMPLE_RATE)")
+        else:
+            print(f"trace {report['trace_id']}  total {report['total_s']*1e3:.2f} ms")
+            for seg in report["path"]:
+                print(
+                    f"  {seg['name']:<32} {seg['kind']:<12} "
+                    f"dur {seg['duration_s']*1e3:8.2f} ms  "
+                    f"self {seg['self_s']*1e3:8.2f} ms"
+                )
+            print(f"dominant segment: {report['dominant']}")
+    else:
+        events = timeline(args.output)
+        print(f"wrote {len(events)} events to {args.output}")
     ray_tpu.shutdown()
 
 
@@ -392,6 +407,16 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("timeline", help="dump chrome://tracing timeline")
     sp.add_argument("--output", default="timeline.json")
     sp.add_argument("--address", default=None)
+    sp.add_argument(
+        "--critical-path",
+        action="store_true",
+        help="print the dominant span chain of a trace instead of dumping",
+    )
+    sp.add_argument(
+        "--trace-id",
+        default=None,
+        help="trace to analyze with --critical-path (default: longest)",
+    )
     sp.set_defaults(fn=cmd_timeline)
 
     sp = sub.add_parser("dashboard", help="run the dashboard against a cluster")

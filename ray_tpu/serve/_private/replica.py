@@ -19,23 +19,31 @@ import asyncio
 import contextvars
 import inspect
 import logging
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
 
 from ray_tpu._private import telemetry
 from ray_tpu._private.rpc import spawn as _spawn
+from ray_tpu.util import tracing
 
 logger = logging.getLogger(__name__)
 
 
 class _BatchItem:
-    __slots__ = ("value", "future", "enqueued_at")
+    __slots__ = ("value", "future", "enqueued_at", "enqueued_wall", "trace_ctx")
 
     def __init__(self, value, future, enqueued_at):
         self.value = value
         self.future = future
         self.enqueued_at = enqueued_at
+        self.enqueued_wall = time.time()
+        # Captured at submit: the pump/batch tasks run in the PUMP's
+        # context, so the request's trace would be lost at the queue hop
+        # without pinning it here (the batch counterpart of the
+        # run_in_executor gap set_context documents).
+        self.trace_ctx = tracing.current_context()
 
 
 _TEL_BATCH_SIZE = telemetry.histogram(
@@ -161,11 +169,32 @@ class _BatchQueue:
 
     async def _run_batch(self, batch: List[_BatchItem]) -> None:
         inputs = [item.value for item in batch]
+        # Per-item queue-wait spans (enqueue -> batch launch), each parented
+        # into ITS OWN request's trace; the execute span below is parented
+        # to the first traced item (a span has one parent — the other
+        # members' waits still link their traces to this batch).
+        lead_ctx = None
+        now = time.time()
+        for item in batch:
+            if item.trace_ctx is not None:
+                if lead_ctx is None:
+                    lead_ctx = item.trace_ctx
+                tracing.record_span(
+                    "serve.batch_wait",
+                    "serve",
+                    item.enqueued_wall,
+                    now - item.enqueued_wall,
+                    ctx=item.trace_ctx,
+                )
+        token = tracing.set_context(lead_ctx)
+        t0 = time.time()
         try:
             if inspect.iscoroutinefunction(self._method):
                 results = await self._method(inputs)
             else:
                 loop = asyncio.get_running_loop()
+                # copy_context AFTER the trace set: the batch's trace context
+                # must follow the user method onto the executor thread.
                 ctx = contextvars.copy_context()
                 results = await loop.run_in_executor(
                     None, lambda: ctx.run(self._method, inputs)
@@ -184,6 +213,17 @@ class _BatchQueue:
                 if not item.future.done():
                     item.future.set_exception(e)
             return
+        finally:
+            tracing.reset_context(token)
+            if lead_ctx is not None:
+                tracing.record_span(
+                    "serve.batch_execute",
+                    "serve",
+                    t0,
+                    time.time() - t0,
+                    ctx=lead_ctx,
+                    size=len(batch),
+                )
         for item, result in zip(batch, results):
             if not item.future.done():
                 item.future.set_result(result)

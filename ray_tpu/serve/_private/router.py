@@ -45,6 +45,7 @@ from ray_tpu.serve._private.common import (
     RunningReplicaInfo,
 )
 from ray_tpu.serve._private.long_poll import LongPollClient
+from ray_tpu.util import tracing
 
 logger = logging.getLogger(__name__)
 
@@ -424,10 +425,36 @@ class Router:
         """Route one request and return its result value."""
         loop = asyncio.get_running_loop()
         deadline = self._request_deadline(loop, timeout_s)
-        while True:
-            rs, replica = await self._acquire_replica(
-                deployment_id_str, request_meta, deadline
+        # Root span for the whole routed request: a serve request has no
+        # task ancestry, so the router is where its trace begins (sampled
+        # on the request id). Every downstream hop — the actor submit, the
+        # lease RPCs, the replica's execute scope — parents under this.
+        with tracing.root_scope(
+            f"serve.request::{deployment_id_str}",
+            "serve",
+            key=request_meta.get("request_id") or deployment_id_str,
+            deployment=deployment_id_str,
+        ):
+            return await self._assign_request_traced(
+                deployment_id_str, request_meta, args, kwargs, loop, deadline
             )
+
+    async def _assign_request_traced(
+        self,
+        deployment_id_str: str,
+        request_meta: Dict[str, Any],
+        args: Tuple,
+        kwargs: Dict,
+        loop,
+        deadline: Optional[float],
+    ) -> Any:
+        while True:
+            with tracing.span_scope(
+                "serve.admission", "serve", deployment=deployment_id_str
+            ):
+                rs, replica = await self._acquire_replica(
+                    deployment_id_str, request_meta, deadline
+                )
             rid = replica.replica_id_str
             rs.ongoing[rid] = rs.ongoing.get(rid, 0) + 1
             t0 = loop.time()
@@ -512,10 +539,40 @@ class Router:
         deadline-cut (streams may legitimately outlive the initial budget)."""
         loop = asyncio.get_running_loop()
         deadline = self._request_deadline(loop, timeout_s)
+        # Root span covering the stream (see assign_request): entered
+        # manually because this is an async generator — the scope must stay
+        # open across yields and close on exhaustion/teardown.
+        scope = tracing.root_scope(
+            f"serve.request::{deployment_id_str}",
+            "serve",
+            key=request_meta.get("request_id") or deployment_id_str,
+            deployment=deployment_id_str,
+            streaming=True,
+        )
+        scope.__enter__()
+        try:
+            async for item in self._assign_streaming_traced(
+                deployment_id_str, request_meta, args, kwargs, deadline
+            ):
+                yield item
+        finally:
+            scope.__exit__(None, None, None)
+
+    async def _assign_streaming_traced(
+        self,
+        deployment_id_str: str,
+        request_meta: Dict[str, Any],
+        args: Tuple,
+        kwargs: Dict,
+        deadline: Optional[float],
+    ):
         while True:
-            rs, replica = await self._acquire_replica(
-                deployment_id_str, request_meta, deadline
-            )
+            with tracing.span_scope(
+                "serve.admission", "serve", deployment=deployment_id_str
+            ):
+                rs, replica = await self._acquire_replica(
+                    deployment_id_str, request_meta, deadline
+                )
             rid = replica.replica_id_str
             rs.ongoing[rid] = rs.ongoing.get(rid, 0) + 1
             yielded = False

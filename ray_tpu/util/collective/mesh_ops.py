@@ -68,6 +68,24 @@ def _observe(op: str, group: str, nbytes: int, dt: float) -> None:
         )
     _LAT.cell(op=op, group=group).observe(dt)
     _BYTES.cell(op=op, group=group).inc(nbytes)
+    # Every collective funnels through here (store + xla backends), so this
+    # is the one place a group op becomes a trace span when the caller is
+    # inside a traced task.
+    from ray_tpu._private import rpc
+
+    if rpc._trace_ctx.get() is not None:
+        import time as _time
+
+        from ray_tpu.util import tracing
+
+        tracing.record_span(
+            f"collective.{op}",
+            "collective",
+            _time.time() - dt,
+            dt,
+            group=group,
+            nbytes=nbytes,
+        )
 
 
 def _shard_map():

@@ -2,6 +2,7 @@
 python/ray/util/state)."""
 
 from ray_tpu.util.state.api import (
+    critical_path,
     get_log,
     list_actors,
     list_jobs,
@@ -9,6 +10,7 @@ from ray_tpu.util.state.api import (
     list_nodes,
     list_objects,
     list_placement_groups,
+    list_spans,
     list_tasks,
     list_workers,
     summarize_actors,
@@ -18,6 +20,7 @@ from ray_tpu.util.state.api import (
 )
 
 __all__ = [
+    "critical_path",
     "get_log",
     "list_actors",
     "list_jobs",
@@ -25,6 +28,7 @@ __all__ = [
     "list_nodes",
     "list_objects",
     "list_placement_groups",
+    "list_spans",
     "list_tasks",
     "list_workers",
     "summarize_actors",

@@ -230,61 +230,62 @@ def summarize_objects() -> Dict[str, Any]:
 # -- timeline (reference: ray.timeline, _private/state.py:922) ----------------
 
 
-def list_spans(trace_id: Optional[str] = None) -> List[dict]:
-    """Tracing spans (reference: the OTel spans tracing_helper.py emits).
-    Each: {span_id, parent_span_id, trace_id, kind: submit|execute, name,
-    task_id, start, duration}. Requires RAY_TPU_TASK_TRACE_SPANS=1."""
-    events = _call_gcs("ListTaskEvents", {"limit": 100000})["events"]
+def list_spans(trace_id: Optional[str] = None, limit: int = 10000) -> List[dict]:
+    """Tracing spans (reference: the OTel spans tracing_helper.py emits),
+    task-level (kind submit|execute) and runtime-internal (lease, object,
+    serve, data, collective kinds) alike. Each: {span_id, parent_span_id,
+    trace_id, kind, name, task_id?, start, duration, ...attrs}. The
+    trace_id filter and limit run GCS-side (ListSpans), so this never
+    ships the whole span ring. Requires tracing to be on
+    (RAY_TPU_TASK_TRACE_SPANS=1 or RAY_TPU_TRACE_SAMPLE_RATE>0)."""
+    events = _call_gcs("ListSpans", {"trace_id": trace_id, "limit": limit})[
+        "spans"
+    ]
     spans = []
     for e in events:
-        if e.get("state") != "SPAN":
-            continue
-        if trace_id is not None and e.get("trace_id") != trace_id:
-            continue
-        spans.append(
+        row = dict(e)
+        row.pop("state", None)
+        row.setdefault("task_id", None)
+        spans.append(row)
+    return sorted(spans, key=lambda s: s.get("start") or 0)
+
+
+def _span_timeline_events(spans: List[dict]) -> List[dict]:
+    """Chrome X events for trace spans, with the trace linkage in args so
+    chrome://tracing / Perfetto flows can be reconstructed."""
+    out = []
+    for e in spans:
+        out.append(
             {
-                "span_id": e.get("span_id"),
-                "parent_span_id": e.get("parent_span_id"),
-                "trace_id": e.get("trace_id"),
-                "kind": e.get("kind"),
-                "name": e.get("name"),
-                "task_id": e.get("task_id"),
-                "start": e.get("start"),
-                "duration": e.get("duration"),
+                "name": f"{e.get('name') or 'task'}::{e.get('kind')}",
+                "cat": "span",
+                "ph": "X",
+                "ts": (e.get("start") or e.get("time") or 0.0) * 1e6,
+                "dur": max(0.0, (e.get("duration") or 0.0) * 1e6),
+                "pid": e.get("node_id", "node"),
+                "tid": e.get("worker_id", "worker"),
+                "args": {
+                    "task_id": e.get("task_id"),
+                    "span_id": e.get("span_id"),
+                    "parent_span_id": e.get("parent_span_id"),
+                    "trace_id": e.get("trace_id"),
+                },
             }
         )
-    return sorted(spans, key=lambda s: s["start"] or 0)
+    return out
 
 
 def timeline(filename: Optional[str] = None) -> List[dict]:
-    """Chrome-tracing events derived from the task-event log: one complete
-    ("X") event per RUNNING->FINISHED/FAILED task span."""
+    """Chrome-tracing events derived from the task-event log (one complete
+    ("X") event per RUNNING->FINISHED/FAILED task) merged with the trace
+    spans from the GCS span ring."""
     events = _call_gcs("ListTaskEvents", {"limit": 100000})["events"]
     spans: Dict[str, dict] = {}
-    out: List[dict] = []
+    out: List[dict] = _span_timeline_events(
+        _call_gcs("ListSpans", {"limit": 100000})["spans"]
+    )
     for e in sorted(events, key=lambda x: x["time"]):
         tid = e["task_id"]
-        if e["state"] == "SPAN":
-            # Tracing spans: one X event each, with the trace linkage in
-            # args so chrome://tracing flows can be reconstructed.
-            out.append(
-                {
-                    "name": f"{e.get('name') or 'task'}::{e.get('kind')}",
-                    "cat": "span",
-                    "ph": "X",
-                    "ts": (e.get("start") or e["time"]) * 1e6,
-                    "dur": max(0.0, (e.get("duration") or 0.0) * 1e6),
-                    "pid": e.get("node_id", "node"),
-                    "tid": e.get("worker_id", "worker"),
-                    "args": {
-                        "task_id": tid,
-                        "span_id": e.get("span_id"),
-                        "parent_span_id": e.get("parent_span_id"),
-                        "trace_id": e.get("trace_id"),
-                    },
-                }
-            )
-            continue
         if e["state"] == "PROFILE":
             # Worker-side phase spans (deserialize/execute/store): one X
             # event per phase, laid back-to-back from the recorded start
@@ -327,3 +328,102 @@ def timeline(filename: Optional[str] = None) -> List[dict]:
         with open(filename, "w") as f:
             json.dump(out, f)
     return out
+
+
+def critical_path(trace_id: Optional[str] = None) -> Dict[str, Any]:
+    """Walk a trace's span DAG and report the chain of spans that bounds
+    its end-to-end latency, with per-segment *self time* (duration minus
+    the on-path child's overlap) so the dominant segment is named rather
+    than inferred from a timeline by eye.
+
+    With no trace_id, the longest recorded trace (largest start->finish
+    extent) is analyzed. Returns ``{trace_id, total_s, path, segments,
+    dominant}`` — ``path`` in causal order, ``segments`` sorted by self
+    time descending, ``dominant`` the name of the top segment."""
+    spans = list_spans(trace_id=trace_id, limit=100000)
+    if not spans:
+        return {
+            "trace_id": trace_id,
+            "total_s": 0.0,
+            "path": [],
+            "segments": [],
+            "dominant": None,
+        }
+
+    def _start(s: dict) -> float:
+        return s.get("start") or 0.0
+
+    def _end(s: dict) -> float:
+        return _start(s) + (s.get("duration") or 0.0)
+
+    by_trace: Dict[str, List[dict]] = collections.defaultdict(list)
+    for s in spans:
+        if s.get("trace_id"):
+            by_trace[s["trace_id"]].append(s)
+    if not by_trace:
+        return {
+            "trace_id": trace_id,
+            "total_s": 0.0,
+            "path": [],
+            "segments": [],
+            "dominant": None,
+        }
+    if trace_id is None:
+        trace_id = max(
+            by_trace,
+            key=lambda t: max(_end(s) for s in by_trace[t])
+            - min(_start(s) for s in by_trace[t]),
+        )
+    trace = by_trace[trace_id]
+    ids = {s["span_id"]: s for s in trace if s.get("span_id")}
+    children: Dict[str, List[dict]] = collections.defaultdict(list)
+    for s in trace:
+        parent = s.get("parent_span_id")
+        if parent in ids and parent != s.get("span_id"):
+            children[parent].append(s)
+    roots = [s for s in trace if s.get("parent_span_id") not in ids]
+    # The root whose subtree finishes last bounds the trace.
+    root = max(roots, key=_end)
+
+    path = [root]
+    seen = {root.get("span_id")}
+    cur = root
+    while True:
+        kids = [
+            k for k in children.get(cur.get("span_id"), []) if k["span_id"] not in seen
+        ]
+        if not kids:
+            break
+        cur = max(kids, key=_end)  # the last-finishing child gates the parent
+        seen.add(cur["span_id"])
+        path.append(cur)
+
+    total = max(_end(s) for s in path) - _start(root)
+    segments = []
+    for i, s in enumerate(path):
+        dur = s.get("duration") or 0.0
+        if i + 1 < len(path):
+            child = path[i + 1]
+            overlap = max(
+                0.0, min(_end(s), _end(child)) - max(_start(s), _start(child))
+            )
+            self_s = max(0.0, dur - overlap)
+        else:
+            self_s = dur
+        segments.append(
+            {
+                "name": s.get("name"),
+                "kind": s.get("kind"),
+                "span_id": s.get("span_id"),
+                "duration_s": dur,
+                "self_s": self_s,
+            }
+        )
+    ranked = sorted(segments, key=lambda seg: seg["self_s"], reverse=True)
+    return {
+        "trace_id": trace_id,
+        "total_s": total,
+        "path": segments,
+        "segments": ranked,
+        "dominant": ranked[0]["name"] if ranked else None,
+    }

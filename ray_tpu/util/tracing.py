@@ -1,42 +1,99 @@
-"""Distributed task tracing: OTel-style spans with context propagation.
+"""Distributed tracing: wire-propagated spans with runtime instrumentation.
 
-Analog of python/ray/util/tracing/tracing_helper.py (:36-57): when enabled
-(set the ``RAY_TPU_TASK_TRACE_SPANS=1`` environment variable before
-``ray_tpu.init``), every task/actor submission carries a trace context inside the task wire
+Analog of python/ray/util/tracing/tracing_helper.py (:36-57), grown into a
+full runtime tracing plane: when enabled (``RAY_TPU_TASK_TRACE_SPANS=1``
+for always-on, or ``RAY_TPU_TRACE_SAMPLE_RATE`` for sampled always-on),
+every task/actor submission carries a trace context inside the task wire
 dict, the submitting side emits a ``submit`` span parented to the caller's
 active span, and the executing worker emits an ``execute`` span parented to
 the submit span — with the active-span contextvar set for the duration of
 user code, so tasks submitted FROM a task chain into the same trace.
 
-Spans ride the existing task-event pipeline (record_task_event state="SPAN"
--> GcsTaskManager analog) and surface through the chrome timeline plus
-``ray_tpu.util.state.api.list_spans()``. No OpenTelemetry SDK dependency:
-the span model (trace_id / span_id / parent_span_id / kind / start /
-duration) is OTLP-shaped so an exporter can translate 1:1.
+Beyond task spans, the runtime emits internal spans on its hot paths
+(lease lifecycle, arg fetch, object get/put/pull/push, serve router and
+batch queue, data stages, collective ops) via :func:`record_span` /
+:func:`span_scope`. The active context additionally rides every RPC
+request frame (``rpc.py`` slot 5, beside the deadline TTL), so a handler
+on another process sees the caller's span as its ambient parent without
+any per-method plumbing.
+
+Two delivery pipelines, one store:
+
+- task submit/execute spans ride the existing task-event pipeline
+  (``record_task_event`` state="SPAN" -> AddTaskEvents), preserving the
+  core worker's flush-on-exit guarantee;
+- runtime spans buffer in a process-local ring (``trace_span_buffer``)
+  and flush to the GCS via ``ReportSpans`` on the telemetry cadence,
+  mirroring ``telemetry.start_flusher`` exactly (snapshot-and-reset
+  delta, fold back on failure).
+
+The GCS diverts both into one bounded ``spans`` ring surfaced through
+``list_spans()`` / ``timeline()`` / ``critical_path()``. No OpenTelemetry
+SDK dependency: the span model (trace_id / span_id / parent_span_id /
+kind / start / duration) is OTLP-shaped so an exporter can translate 1:1.
+
+The contextvar itself lives in ``ray_tpu._private.rpc`` (the bottom of
+the import graph — the frame codec must read it, and importing this
+module from rpc would cycle through ``ray_tpu.util``); this module owns
+everything above the raw variable.
 """
 
 from __future__ import annotations
 
 import contextlib
-import contextvars
 import os
+import random
+import threading
 import time
-from typing import Any, Dict, Optional
+import zlib
+from collections import deque
+from typing import Any, Dict, Iterable, Iterator, List, Optional
 
 from ray_tpu._private.common import config
+from ray_tpu._private import rpc as _rpc
 
-# (trace_id, active_span_id) for the current task of execution.
-_trace_ctx: "contextvars.ContextVar[Optional[tuple]]" = contextvars.ContextVar(
-    "ray_tpu_trace_ctx", default=None
-)
+# The (trace_id, active_span_id) of the current task of execution — shared
+# with the RPC layer, which stamps it onto outgoing request frames and
+# restores it around incoming handlers.
+_trace_ctx = _rpc._trace_ctx
+
+# Span-id generation: a module-level PRNG seeded from the OS once. The
+# record path is perf-gated (trace_span_record_ns); os.urandom per span is
+# a ~1us syscall, getrandbits is a single GIL-atomic C call. Uniqueness,
+# not unpredictability, is what span ids need. Forked workers inherit the
+# parent's PRNG state and would emit identical id sequences (colliding
+# span ids corrupt the trace DAG), so children reseed at fork time.
+_rand = random.Random(os.urandom(8))
+
+
+def _reseed() -> None:
+    _rand.seed(os.urandom(8))
+
+
+if hasattr(os, "register_at_fork"):  # not on Windows
+    os.register_at_fork(after_in_child=_reseed)
 
 
 def enabled() -> bool:
-    return bool(config.task_trace_spans)
+    return bool(config.task_trace_spans) or config.trace_sample_rate > 0
 
 
 def _new_id() -> str:
-    return os.urandom(8).hex()
+    return "%016x" % _rand.getrandbits(64)
+
+
+def _sample(key: str) -> bool:
+    """Deterministic root-sampling decision: every process hashing the same
+    root key independently agrees whether the trace exists, so a sampled
+    trace is always complete (no half-recorded requests)."""
+    if config.task_trace_spans:
+        return True
+    rate = config.trace_sample_rate
+    if rate <= 0.0:
+        return False
+    if rate >= 1.0:
+        return True
+    return (zlib.crc32(key.encode()) & 0xFFFFFFFF) / 2**32 < rate
 
 
 def current_context() -> Optional[tuple]:
@@ -59,13 +116,294 @@ def reset_context(token) -> None:
         _trace_ctx.reset(token)
 
 
+def ctx_from_wire(wire: Dict[str, Any]) -> Optional[tuple]:
+    """(trace_id, span_id) from a task wire dict's trace_ctx, or None."""
+    ctx = wire.get("trace_ctx")
+    if not ctx:
+        return None
+    return (ctx["trace_id"], ctx["span_id"])
+
+
+# ---------------------------------------------------------------------------
+# Runtime-span ring + flusher (the telemetry-plane pattern: process-local
+# bounded buffer, snapshot-and-reset delta flush, restore on failure).
+# ---------------------------------------------------------------------------
+
+_buf: "deque[dict]" = deque(maxlen=config.trace_span_buffer)
+_buf_lock = threading.Lock()
+_flusher_started = False
+
+
+def record_span(
+    name: str,
+    kind: str,
+    start: float,
+    duration: float,
+    ctx: Optional[tuple] = None,
+    **attrs: Any,
+) -> Optional[str]:
+    """Record one runtime span parented into the active trace.
+
+    ``ctx`` overrides the ambient context (for spans emitted after the
+    originating context is gone, e.g. raylet grant-time spans parented to
+    the lease request's captured context). Returns the new span_id, or
+    None when there is no trace to join — runtime spans never create
+    roots; that is :func:`root_scope`'s job."""
+    if ctx is None:
+        ctx = _trace_ctx.get()
+        if ctx is None:
+            return None
+    span_id = _new_id()
+    span = {
+        "state": "SPAN",
+        "name": name,
+        "kind": kind,
+        "span_id": span_id,
+        "parent_span_id": ctx[1],
+        "trace_id": ctx[0],
+        "start": start,
+        "duration": duration,
+        "time": start + duration,
+    }
+    if attrs:
+        span.update(attrs)
+    with _buf_lock:
+        _buf.append(span)
+    return span_id
+
+
+@contextlib.contextmanager
+def span_scope(name: str, kind: str, ctx: Optional[tuple] = None, **attrs: Any):
+    """Span around a runtime code region. Sets the active context to the
+    new span for the duration, so nested spans — and RPC calls made inside
+    — parent under it. No-op when tracing is off or no trace is active."""
+    if not enabled():
+        yield None
+        return
+    if ctx is None:
+        ctx = _trace_ctx.get()
+    if ctx is None:
+        yield None
+        return
+    span_id = _new_id()
+    token = _trace_ctx.set((ctx[0], span_id))
+    t0 = time.time()
+    try:
+        yield (ctx[0], span_id)
+    finally:
+        _trace_ctx.reset(token)
+        span = {
+            "state": "SPAN",
+            "name": name,
+            "kind": kind,
+            "span_id": span_id,
+            "parent_span_id": ctx[1],
+            "trace_id": ctx[0],
+            "start": t0,
+            "duration": time.time() - t0,
+            "time": time.time(),
+        }
+        if attrs:
+            span.update(attrs)
+        with _buf_lock:
+            _buf.append(span)
+
+
+@contextlib.contextmanager
+def root_scope(name: str, kind: str, key: Optional[str] = None, **attrs: Any):
+    """Span that CREATES a trace when none is active (subject to the
+    sampling decision on ``key``). The serve router wraps each request in
+    one of these, so a bare HTTP/handle call — no task ancestry — still
+    yields a connected trace. Inside an existing trace it behaves exactly
+    like :func:`span_scope`."""
+    if not enabled():
+        yield None
+        return
+    cur = _trace_ctx.get()
+    if cur is None:
+        root_key = key if key is not None else name
+        if not _sample(root_key):
+            yield None
+            return
+        trace_id = _new_id()
+        parent = None
+    else:
+        trace_id, parent = cur
+    span_id = _new_id()
+    token = _trace_ctx.set((trace_id, span_id))
+    t0 = time.time()
+    try:
+        yield (trace_id, span_id)
+    finally:
+        _trace_ctx.reset(token)
+        span = {
+            "state": "SPAN",
+            "name": name,
+            "kind": kind,
+            "span_id": span_id,
+            "parent_span_id": parent,
+            "trace_id": trace_id,
+            "start": t0,
+            "duration": time.time() - t0,
+            "time": time.time(),
+        }
+        if attrs:
+            span.update(attrs)
+        with _buf_lock:
+            _buf.append(span)
+
+
+def iter_scope(it: Iterable, name: str, kind: str = "data", **attrs: Any) -> Iterator:
+    """Wrap an iterator in one span covering the whole iteration, with the
+    span active while the iterator body runs — so every task a streaming
+    executor submits joins a single trace. Creates a root (sampled on
+    ``name``) when no trace is active."""
+    if not enabled():
+        yield from it
+        return
+    cur = _trace_ctx.get()
+    if cur is None:
+        if not _sample(name):
+            yield from it
+            return
+        trace_id, parent = _new_id(), None
+    else:
+        trace_id, parent = cur
+    span_id = _new_id()
+    token = _trace_ctx.set((trace_id, span_id))
+    t0 = time.time()
+    try:
+        yield from it
+    finally:
+        _trace_ctx.reset(token)
+        span = {
+            "state": "SPAN",
+            "name": name,
+            "kind": kind,
+            "span_id": span_id,
+            "parent_span_id": parent,
+            "trace_id": trace_id,
+            "start": t0,
+            "duration": time.time() - t0,
+            "time": time.time(),
+        }
+        if attrs:
+            span.update(attrs)
+        with _buf_lock:
+            _buf.append(span)
+
+
+def span_flush_delta() -> List[dict]:
+    """Snapshot-and-reset the runtime-span buffer. The caller owns the
+    returned spans; on delivery failure fold them back with
+    :func:`restore_spans` so a transient GCS outage loses nothing."""
+    with _buf_lock:
+        if not _buf:
+            return []
+        spans = list(_buf)
+        _buf.clear()
+    return spans
+
+
+def restore_spans(spans: List[dict]) -> None:
+    """Fold an undelivered flush delta back into the buffer (oldest first,
+    so ring eviction still drops the oldest)."""
+    if not spans:
+        return
+    with _buf_lock:
+        _buf.extendleft(reversed(spans))
+
+
+async def flush_spans_once(call, source: str, node: Optional[str] = None) -> None:
+    """One flush cycle: ship the span delta via ``call`` (an async
+    ``(method, payload) ->`` RPC callable, e.g. ``gcs.call``)."""
+    spans = span_flush_delta()
+    if not spans:
+        return
+    try:
+        await call("ReportSpans", {"source": source, "node": node, "spans": spans})
+    except Exception:
+        restore_spans(spans)
+        raise
+
+
+def start_span_flusher(call, source: str, node: Optional[str] = None) -> None:
+    """Start the periodic span flusher on the running loop (idempotent per
+    process, like ``telemetry.start_flusher``). Rides the telemetry flush
+    cadence; gated on tracing being enabled at all."""
+    global _flusher_started
+    interval = config.telemetry_flush_interval_s
+    if _flusher_started or not enabled() or interval <= 0:
+        return
+    _flusher_started = True
+
+    async def _loop() -> None:
+        import asyncio
+
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                await flush_spans_once(call, source, node)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass  # delta restored; retried next tick
+
+    _rpc.spawn(_loop())
+
+
+def flusher_active() -> bool:
+    """True when this process runs a periodic span flusher (the GCS skips
+    its query-time local drain in that case — the flusher owns delivery
+    and carries the right source attribution)."""
+    return _flusher_started
+
+
+def stop_flusher() -> None:
+    """Mark the flusher stopped. Called when the core worker closes: the
+    flusher task dies with the event loop, and leaving the flag set would
+    make a later init in the same process (tests, repeated drivers) skip
+    both the restart and the GCS's query-time local drain."""
+    global _flusher_started
+    _flusher_started = False
+
+
+def reset_flusher_for_test() -> None:
+    stop_flusher()
+
+
+def snapshot() -> List[dict]:
+    """Non-destructive copy of the local buffer (chaos dumps)."""
+    with _buf_lock:
+        return list(_buf)
+
+
+def reset() -> None:
+    """Drop all buffered spans (chaos per-seed isolation)."""
+    with _buf_lock:
+        _buf.clear()
+
+
+# ---------------------------------------------------------------------------
+# Task-level spans (submit/execute) — these ride the task-event pipeline so
+# they inherit its flush-on-exit and existing GCS plumbing.
+# ---------------------------------------------------------------------------
+
+
 def make_submit_ctx(core, task_id: str, name: str) -> Optional[Dict[str, str]]:
     """Record the submit-side span and return the wire trace context
-    ({trace_id, span_id}) the executing worker will parent to."""
+    ({trace_id, span_id}) the executing worker will parent to. A submission
+    with no active trace is a new root, created only when the sampling
+    decision on ``task_id`` says so."""
     if not enabled():
         return None
     cur = _trace_ctx.get()
-    trace_id = cur[0] if cur else _new_id()
+    if cur is None:
+        if not _sample(task_id):
+            return None
+        trace_id = _new_id()
+    else:
+        trace_id = cur[0]
     span_id = _new_id()
     core.record_task_event(
         task_id,

@@ -4,7 +4,8 @@
 PYTHON ?= python
 SANITIZER ?= address
 
-.PHONY: lint test sanitize wire-docs protocols build chaos loadgen perf
+.PHONY: lint test sanitize wire-docs protocols build chaos loadgen perf \
+	explore
 
 lint:
 	$(PYTHON) -m ray_tpu.devtools.lint
@@ -62,6 +63,29 @@ perf:
 	timeout -k 10 300 env JAX_PLATFORMS=cpu \
 		$(PYTHON) -m ray_tpu.loadgen --smoke --json /tmp/serve_load.json
 	$(PYTHON) benchmarks/perf_gate.py /tmp/perf.json /tmp/serve_load.json
+
+# Exhaustive interleaving explorer (docs/static_analysis.md): enumerate
+# the control-plane scenarios' schedule spaces under the virtual loop
+# (lease + ha exhaust; resubscribe runs bounded-clean), prove the
+# double-grant mutation is still caught and its committed trace still
+# replays to the violation, then scan the WAL/replicated-store
+# group-commit crash points. CI's explore-smoke job runs the same
+# commands.
+HA_EXPLORE_BUDGET ?= 40000
+explore:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m ray_tpu.devtools.explore \
+		--scenario lease_exactly_once --budget 5000 --check-determinism
+	env JAX_PLATFORMS=cpu $(PYTHON) -m ray_tpu.devtools.explore \
+		--scenario ha_promotion --budget $(HA_EXPLORE_BUDGET)
+	env JAX_PLATFORMS=cpu $(PYTHON) -m ray_tpu.devtools.explore \
+		--scenario resubscribe_gap --budget 3000 --allow-bounded
+	env JAX_PLATFORMS=cpu $(PYTHON) -m ray_tpu.devtools.explore \
+		--scenario lease_exactly_once --mutate double_grant \
+		--expect-violation
+	env JAX_PLATFORMS=cpu $(PYTHON) -m ray_tpu.devtools.explore \
+		--replay tests/schedules/lease_double_grant.json --expect-violation
+	env JAX_PLATFORMS=cpu $(PYTHON) -m ray_tpu.devtools.explore \
+		--crash-points
 
 SEEDS ?= 20
 LATENCY_SEEDS ?= 10

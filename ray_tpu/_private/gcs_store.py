@@ -241,6 +241,11 @@ class WalStoreClient(StoreClient):
         self._tables: Dict[str, Dict[str, bytes]] = {}
         self._pending: list = []
         self._flush_scheduled = False
+        # Optional crash-point probe: called after each durable group commit
+        # with (commit_index, log_byte_offset, n_ops). Used by the explorer
+        # (devtools/explore.py) to snapshot acked state at every boundary.
+        self.commit_listener = None
+        self._commit_index = 0
         self._recover()
         self._fd = os.open(path, os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
         self._log_bytes = os.fstat(self._fd).st_size
@@ -315,6 +320,7 @@ class WalStoreClient(StoreClient):
         if not self._pending or self._closed:
             self._pending.clear()
             return
+        n_ops = len(self._pending)
         buf = b"".join(self._pending)
         self._pending.clear()
         t0 = time.perf_counter()
@@ -324,6 +330,9 @@ class WalStoreClient(StoreClient):
         _TEL_WRITE_S.default.observe(time.perf_counter() - t0)
         _TEL_WAL_BYTES.default.inc(len(buf))
         self._log_bytes += len(buf)
+        self._commit_index += 1
+        if self.commit_listener is not None:
+            self.commit_listener(self._commit_index, self._log_bytes, n_ops)
         if self._compact_bytes and self._log_bytes > self._compact_bytes:
             self._compact()
 
@@ -677,6 +686,10 @@ class ReplicatedStoreClient(StoreClient):
         self._on_fenced = on_fenced
         self._pending: list = []
         self._flush_scheduled = False
+        # Optional crash-point probe: called after each successfully shipped
+        # group commit with (seq, n_ops). Fence aborts never ack, so never
+        # fire it (see devtools/explore.py crash enumeration).
+        self.commit_listener = None
         member_paths = [self._path] + [
             os.path.abspath(p)
             for p in (followers if followers is not None else follower_paths(path))
@@ -812,6 +825,7 @@ class ReplicatedStoreClient(StoreClient):
         if not self._pending or self._closed or self.fenced:
             self._pending.clear()
             return
+        n_ops = len(self._pending)
         buf = b"".join(self._pending)
         self._pending.clear()
         t0 = time.perf_counter()
@@ -839,6 +853,8 @@ class ReplicatedStoreClient(StoreClient):
         _TEL_WRITE_S.default.observe(dt)
         _TEL_REPL_LAG_S.default.observe(dt)
         _TEL_WAL_BYTES.default.inc(len(buf))
+        if self.commit_listener is not None:
+            self.commit_listener(self._seq, n_ops)
         if self._compact_bytes and self._members[0].log_bytes > self._compact_bytes:
             snap = _rframe(
                 "snap", "", "",

@@ -403,6 +403,14 @@ class Raylet:
     _tel_locality_misses = _TEL_LOCALITY_MISSES.cell()
     _tel_node_util = _TEL_NODE_UTIL.cell()
 
+    # Mutation gate for the interleaving explorer (devtools/explore.py):
+    # when True, both layers of the PR 2 duplicate-grant fix are disabled
+    # (the ledger check in _is_duplicate_grant and the leases[] recovery
+    # branch in _grant_inner), faithfully re-introducing the double-grant
+    # bug so the explorer can prove it still finds it. Never set in
+    # production code paths.
+    _mutate_double_grant = False
+
     def __init__(
         self,
         gcs_addr: Tuple[str, int],
@@ -1679,6 +1687,8 @@ class Raylet:
         ids are unique per request, so any ledger entry — live or released —
         marks a duplicate. Actor lease ids are legitimately reused on
         restart, so only a LIVE entry counts."""
+        if self._mutate_double_grant:
+            return False  # seeded bug: forget every previous grant
         state = self.granted_lease_ids.get(lease_id)
         if state is None:
             return False
@@ -1811,7 +1821,7 @@ class Raylet:
             if not req.fut.done():
                 req.fut.set_exception(e)
             return
-        if req.lease_id in self.leases:
+        if req.lease_id in self.leases and not self._mutate_double_grant:
             # Double grant (two _grant tasks raced to the same lease id —
             # the write-write the AIOCHECK probe caught live). The first
             # write owns the lease; this grant is a no-op: re-credit the

@@ -24,10 +24,15 @@ Rules
 - ``await-interleave``: asyncio TOCTOU. The function reads a shared
   container (an attribute initialised to a dict/list/set/deque in the
   class's ``__init__``, or a module-global container), then crosses an
-  ``await``, then mutates that container without re-reading it after the
-  await and without holding an ``asyncio.Lock``. Purely additive mutations
-  (``append``/``add``/``extend``) are not treated as hazardous writes — the
-  lost-update shape needs a read-modify-write or a rebind/del.
+  interleave point, then mutates that container without re-reading it
+  after the suspension and without holding an ``asyncio.Lock``. Interleave
+  points are explicit ``await``s, the implicit awaits of ``async for`` /
+  ``async with`` (including the back-edge ``__anext__``), async-generator
+  ``yield``s (the consumer runs before the next line), and async
+  comprehensions (``[... async for ...]`` awaits in expression position).
+  Purely additive mutations (``append``/``add``/``extend``) are not
+  treated as hazardous writes — the lost-update shape needs a
+  read-modify-write or a rebind/del.
 
 Suppression: ``# aio-lint: disable=<rule>[,<rule>]`` (or ``disable=all``)
 on the flagged line or the line directly above it.
@@ -316,6 +321,25 @@ class _AsyncFnLinter:
             self._visit(node.value)
             self._cross_await()
             return
+        if isinstance(node, (ast.Yield, ast.YieldFrom)):
+            # Only reachable inside an async generator (this linter walks
+            # ``async def`` bodies): ``yield`` suspends the generator, the
+            # consumer — and any other task — runs before the next line.
+            if node.value is not None:
+                self._visit(node.value)
+            self._cross_await()
+            return
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp)):
+            if any(gen.is_async for gen in node.generators):
+                # ``[... async for ...]`` awaits __anext__ at every
+                # iteration, right here in expression position.
+                for child in ast.iter_child_nodes(node):
+                    self._visit(child)
+                self._cross_await()
+                return
+            for child in ast.iter_child_nodes(node):
+                self._visit(child)
+            return
         if isinstance(node, ast.AsyncFor):
             self._visit(node.iter)
             self._cross_await()
@@ -481,8 +505,15 @@ class _CreateTaskLinter(ast.NodeVisitor):
         self.generic_visit(node)
 
 
-def lint_source(source: str, path: str = "<string>") -> List[Finding]:
-    """Lint one module's source text; returns unsuppressed findings."""
+def lint_source(
+    source: str, path: str = "<string>", apply_suppressions: bool = True
+) -> List[Finding]:
+    """Lint one module's source text; returns unsuppressed findings.
+
+    ``apply_suppressions=False`` returns the raw findings with the
+    ``# aio-lint: disable=`` comments ignored — the stale-suppression audit
+    in ``devtools.lint`` uses this to decide which comments still earn
+    their keep."""
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
@@ -518,7 +549,7 @@ def lint_source(source: str, path: str = "<string>") -> List[Finding]:
 
     walk_functions(tree.body, None)
 
-    sup = _suppressions(source)
+    sup = _suppressions(source) if apply_suppressions else {}
 
     def suppressed(f: Finding) -> bool:
         for line in (f.line, f.line - 1):
@@ -533,9 +564,9 @@ def lint_source(source: str, path: str = "<string>") -> List[Finding]:
     )
 
 
-def lint_file(path: str) -> List[Finding]:
+def lint_file(path: str, apply_suppressions: bool = True) -> List[Finding]:
     with open(path, "r", encoding="utf-8") as fh:
-        return lint_source(fh.read(), path)
+        return lint_source(fh.read(), path, apply_suppressions=apply_suppressions)
 
 
 def iter_py_files(root: str) -> Iterable[str]:
@@ -546,15 +577,158 @@ def iter_py_files(root: str) -> Iterable[str]:
                 yield os.path.join(dirpath, fn)
 
 
-def lint_paths(paths: Iterable[str]) -> List[Finding]:
+def lint_paths(
+    paths: Iterable[str], apply_suppressions: bool = True
+) -> List[Finding]:
     findings: List[Finding] = []
     for path in paths:
         if os.path.isdir(path):
             for f in iter_py_files(path):
-                findings.extend(lint_file(f))
+                findings.extend(lint_file(f, apply_suppressions=apply_suppressions))
         else:
-            findings.extend(lint_file(path))
+            findings.extend(lint_file(path, apply_suppressions=apply_suppressions))
     return findings
+
+
+# ---------------------------------------------------------------------------
+# Shared-attribute footprints (consumed by devtools.explore for DPOR)
+# ---------------------------------------------------------------------------
+
+
+def _fn_footprint(
+    fn: ast.AST,
+    class_name: Optional[str],
+    index: _ModuleIndex,
+    modbase: str,
+) -> Tuple[Set[str], Set[str], Set[str]]:
+    """(reads, writes, callee-qualnames) of one function over shared state.
+
+    Tracks EVERY ``self.<attr>`` access (not just attributes the linter
+    recognises as shared containers — an incomplete footprint would let the
+    explorer's independence oracle judge truly conflicting events
+    independent, i.e. unsound pruning) plus module-level shared containers.
+    Deliberately over-approximate: any method call on a tracked attribute
+    counts as a write, and nested defs are folded in — a too-big footprint
+    only costs pruning, a too-small one would hide interleavings."""
+    reads: Set[str] = set()
+    writes: Set[str] = set()
+    callees: Set[str] = set()
+
+    def shared_key(node: ast.AST) -> Optional[str]:
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and class_name is not None
+        ):
+            # Keyed by bare attribute name, NOT Cls.attr: a base-class
+            # method and a subclass method touch the SAME ``self._x`` slot,
+            # and class-prefixed keys would judge them independent. Merging
+            # same-named attrs across unrelated classes is the safe
+            # direction (costs pruning, never soundness).
+            return f"self.{node.attr}"
+        if isinstance(node, ast.Name) and node.id in index.module_shared:
+            return f"{modbase}:{node.id}"
+        return None
+
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Attribute, ast.Name)):
+            key = shared_key(node)
+            if key is not None:
+                if isinstance(getattr(node, "ctx", None), (ast.Store, ast.Del)):
+                    writes.add(key)
+                else:
+                    reads.add(key)
+        elif isinstance(node, ast.Subscript):
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                key = shared_key(node.value)
+                if key is not None:
+                    writes.add(key)
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                key = shared_key(f.value)
+                if key is not None:
+                    writes.add(key)
+                if (
+                    isinstance(f.value, ast.Name)
+                    and f.value.id == "self"
+                    and class_name is not None
+                ):
+                    callees.add(f"{class_name}.{f.attr}")
+            elif isinstance(f, ast.Name):
+                callees.add(f.id)
+    return reads, writes, callees
+
+
+def extract_footprints(
+    paths: Optional[List[str]] = None,
+) -> Dict[str, Dict[str, Set[str]]]:
+    """Static read/write footprints over shared containers, per function.
+
+    Returns ``{qualname: {"reads": set, "writes": set}}`` where qualname is
+    ``Cls.method`` for methods and the bare name for module functions, and
+    footprint keys are ``self.attr`` / ``module:global``. Effects of callees
+    reachable through ``self.x()`` and same-module function calls are folded
+    in transitively (fixpoint); same qualnames across modules merge by
+    union. Sync functions are included — a loop callback need not be a
+    coroutine.
+    """
+    paths = paths or [_default_root()]
+    raw: Dict[str, Dict[str, Set[str]]] = {}
+
+    def fold(qual: str, reads: Set[str], writes: Set[str], callees: Set[str]) -> None:
+        ent = raw.setdefault(
+            qual, {"reads": set(), "writes": set(), "callees": set()}
+        )
+        ent["reads"] |= reads
+        ent["writes"] |= writes
+        ent["callees"] |= callees
+
+    files: List[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            files.extend(iter_py_files(path))
+        else:
+            files.append(path)
+    for fpath in files:
+        try:
+            with open(fpath, "r", encoding="utf-8") as fh:
+                tree = ast.parse(fh.read(), filename=fpath)
+        except (OSError, SyntaxError):
+            continue
+        index = _ModuleIndex(tree)
+        modbase = os.path.splitext(os.path.basename(fpath))[0]
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fold(node.name, *_fn_footprint(node, None, index, modbase))
+            elif isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        fold(
+                            f"{node.name}.{item.name}",
+                            *_fn_footprint(item, node.name, index, modbase),
+                        )
+
+    # Transitive closure over the intra-repo call graph.
+    changed = True
+    while changed:
+        changed = False
+        for ent in raw.values():
+            for callee in list(ent["callees"]):
+                sub = raw.get(callee)
+                if sub is None:
+                    continue
+                before = len(ent["reads"]) + len(ent["writes"]) + len(ent["callees"])
+                ent["reads"] |= sub["reads"]
+                ent["writes"] |= sub["writes"]
+                ent["callees"] |= sub["callees"]
+                if len(ent["reads"]) + len(ent["writes"]) + len(ent["callees"]) != before:
+                    changed = True
+    return {
+        qual: {"reads": ent["reads"], "writes": ent["writes"]}
+        for qual, ent in raw.items()
+    }
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -525,7 +525,9 @@ class _FnLifecycle:
         return self.findings
 
 
-def lint_source(source: str, path: str = "<string>") -> List[Finding]:
+def lint_source(
+    source: str, path: str = "<string>", apply_suppressions: bool = True
+) -> List[Finding]:
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
@@ -534,7 +536,7 @@ def lint_source(source: str, path: str = "<string>") -> List[Finding]:
     for node in ast.walk(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             findings.extend(_FnLifecycle(node, path).run())
-    sup = _suppressions(source)
+    sup = _suppressions(source) if apply_suppressions else {}
 
     def suppressed(f: Finding) -> bool:
         for line in (f.line, f.line - 1):
@@ -549,19 +551,21 @@ def lint_source(source: str, path: str = "<string>") -> List[Finding]:
     )
 
 
-def lint_file(path: str) -> List[Finding]:
+def lint_file(path: str, apply_suppressions: bool = True) -> List[Finding]:
     with open(path, "r", encoding="utf-8") as fh:
-        return lint_source(fh.read(), path)
+        return lint_source(fh.read(), path, apply_suppressions=apply_suppressions)
 
 
-def lint_paths(paths: Iterable[str]) -> List[Finding]:
+def lint_paths(
+    paths: Iterable[str], apply_suppressions: bool = True
+) -> List[Finding]:
     findings: List[Finding] = []
     for path in paths:
         if os.path.isdir(path):
             for f in iter_py_files(path):
-                findings.extend(lint_file(f))
+                findings.extend(lint_file(f, apply_suppressions=apply_suppressions))
         else:
-            findings.extend(lint_file(path))
+            findings.extend(lint_file(path, apply_suppressions=apply_suppressions))
     return findings
 
 

@@ -3,16 +3,21 @@
 Runs every static pass over the package and exits non-zero on any finding:
 the asyncio hazard linter (aio_lint), the RPC wire cross-checker
 (rpc_check), the paired-resource lifecycle pass (lifecycle), the protocol
-FSM checker (protocols), and the telemetry-registry pass (telemetry_lint,
-no ad-hoc stats dicts in runtime code). This is the CI lint job's entry
-point; ``make lint`` wraps it.
+FSM checker (protocols), the telemetry-registry pass (telemetry_lint,
+no ad-hoc stats dicts in runtime code), and the stale-suppression audit
+(a ``disable=``/``allow-`` comment that no longer masks any finding is
+itself a finding — dead waivers rot into false confidence). This is the
+CI lint job's entry point; ``make lint`` wraps it.
 """
 
 from __future__ import annotations
 
 import argparse
+import io
+import os
 import sys
-from typing import List, Optional
+import tokenize
+from typing import Dict, List, Optional, Set
 
 from ray_tpu.devtools import (
     aio_lint,
@@ -22,7 +27,106 @@ from ray_tpu.devtools import (
     telemetry_lint,
 )
 
-_PASSES = "aio-lint + rpc-check + lifecycle + protocols + telemetry-lint"
+_PASSES = (
+    "aio-lint + rpc-check + lifecycle + protocols + telemetry-lint"
+    " + suppression-audit"
+)
+
+RULE_STALE = "stale-suppression"
+
+
+def audit_suppressions(paths: List[str]) -> List[aio_lint.Finding]:
+    """Flag suppression comments that no longer mask any raw finding.
+
+    Re-runs every pass with ``apply_suppressions=False`` and checks each
+    ``# aio-lint: disable=`` / ``# lifecycle: disable=`` /
+    ``# protocol: disable=`` / ``# telemetry: allow-adhoc-stats`` comment
+    against the raw findings of its own family on the line it covers (the
+    comment's line and the line below, mirroring the passes' scoping).
+    The ``aio-lint`` syntax is shared by rpc_check, so its comments are
+    validated against both passes' findings.
+    """
+    files: List[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            files.extend(aio_lint.iter_py_files(path))
+        else:
+            files.append(path)
+
+    raw = {
+        "aio-lint": (
+            aio_lint.lint_paths(paths, apply_suppressions=False)
+            + rpc_check.check(paths, apply_suppressions=False)
+        ),
+        "lifecycle": lifecycle.lint_paths(paths, apply_suppressions=False),
+        "protocol": protocols.check(paths, apply_suppressions=False),
+        "telemetry": telemetry_lint.lint_paths(paths, apply_suppressions=False),
+    }
+    # family -> abspath -> line -> rules found there without suppression
+    idx: Dict[str, Dict[str, Dict[int, Set[str]]]] = {}
+    for family, findings in raw.items():
+        fam = idx.setdefault(family, {})
+        for f in findings:
+            fam.setdefault(os.path.abspath(f.path), {}).setdefault(
+                f.line, set()
+            ).add(f.rule)
+
+    regexes = {
+        "aio-lint": aio_lint._SUPPRESS_RE,
+        "lifecycle": lifecycle._SUPPRESS_RE,
+        "protocol": protocols._SUPPRESS_RE,
+        "telemetry": telemetry_lint._ALLOW_RE,
+    }
+    out: List[aio_lint.Finding] = []
+    for fpath in files:
+        try:
+            with open(fpath, "r", encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError:
+            continue
+        apath = os.path.abspath(fpath)
+        # Only genuine comment tokens: the suppression syntax also appears
+        # in docstrings and message strings (this file included), which are
+        # not waivers.
+        comments: List = []
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+                if tok.type == tokenize.COMMENT:
+                    comments.append((tok.start[0], tok.string))
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            continue
+        for lineno, text in comments:
+            for family, rex in regexes.items():
+                m = rex.search(text)
+                if not m:
+                    continue
+                rules: Optional[Set[str]] = None
+                if m.groups():
+                    rules = {
+                        r.strip() for r in m.group(1).split(",") if r.strip()
+                    }
+                by_line = idx.get(family, {}).get(apath, {})
+                used = False
+                for covered in (lineno, lineno + 1):
+                    found = by_line.get(covered)
+                    if not found:
+                        continue
+                    if rules is None or "all" in rules or (found & rules):
+                        used = True
+                        break
+                if not used:
+                    out.append(
+                        aio_lint.Finding(
+                            fpath,
+                            lineno,
+                            0,
+                            RULE_STALE,
+                            f"{family} suppression masks no finding any "
+                            "more — the code it waived was fixed or moved; "
+                            "delete the comment",
+                        )
+                    )
+    return out
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -39,6 +143,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     findings.extend(lifecycle.lint_paths(paths))
     findings.extend(protocols.check(paths))
     findings.extend(telemetry_lint.lint_paths(paths))
+    findings.extend(audit_suppressions(paths))
     findings.sort(key=lambda f: (f.path, f.line, f.col))
     for f in findings:
         print(f)

@@ -502,7 +502,9 @@ class _FileChecker(ast.NodeVisitor):
 _SCANNED_BASENAMES = {b for m in MACHINES for b in m.files}
 
 
-def check_source(source: str, path: str) -> List[Finding]:
+def check_source(
+    source: str, path: str, apply_suppressions: bool = True
+) -> List[Finding]:
     """Check one file's source; only files named like a scanned module
     (gcs.py / raylet.py / core_worker.py) produce findings."""
     if os.path.basename(path) not in _SCANNED_BASENAMES:
@@ -513,7 +515,7 @@ def check_source(source: str, path: str) -> List[Finding]:
         return [Finding(path, e.lineno or 0, 0, "parse-error", str(e.msg))]
     checker = _FileChecker(tree, path)
     checker.visit(tree)
-    sup = _suppressions(source)
+    sup = _suppressions(source) if apply_suppressions else {}
 
     def suppressed(f: Finding) -> bool:
         for line in (f.line, f.line - 1):
@@ -528,21 +530,23 @@ def check_source(source: str, path: str) -> List[Finding]:
     )
 
 
-def check_file(path: str) -> List[Finding]:
+def check_file(path: str, apply_suppressions: bool = True) -> List[Finding]:
     with open(path, "r", encoding="utf-8") as fh:
-        return check_source(fh.read(), path)
+        return check_source(fh.read(), path, apply_suppressions=apply_suppressions)
 
 
-def check(paths: Optional[Iterable[str]] = None) -> List[Finding]:
+def check(
+    paths: Optional[Iterable[str]] = None, apply_suppressions: bool = True
+) -> List[Finding]:
     """Full pass: spec validation + file extraction + invariants sync."""
     paths = list(paths) if paths else [_default_root()]
     findings = _spec_findings()
     for path in paths:
         if os.path.isdir(path):
             for f in iter_py_files(path):
-                findings.extend(check_file(f))
+                findings.extend(check_file(f, apply_suppressions=apply_suppressions))
         else:
-            findings.extend(check_file(path))
+            findings.extend(check_file(path, apply_suppressions=apply_suppressions))
     try:
         findings.extend(check_invariants_sync())
     except ImportError:

@@ -372,7 +372,9 @@ def _rpc_module_path() -> str:
     return os.path.abspath(rpc.__file__)
 
 
-def check(paths: Optional[List[str]] = None) -> List[Finding]:
+def check(
+    paths: Optional[List[str]] = None, apply_suppressions: bool = True
+) -> List[Finding]:
     paths = paths or [_default_root()]
     inv = build_inventory(paths)
     rpc_path = _rpc_module_path()
@@ -426,6 +428,8 @@ def check(paths: Optional[List[str]] = None) -> List[Finding]:
     findings.extend(_check_trace_declared())
 
     # Apply inline suppressions from the source files involved.
+    if not apply_suppressions:
+        return findings
     sup_cache: Dict[str, Dict[int, Set[str]]] = {}
 
     def suppressed(f: Finding) -> bool:

@@ -67,7 +67,7 @@ def _in_private_pkg(path: str) -> bool:
     return False
 
 
-def lint_file(path: str) -> List[Finding]:
+def lint_file(path: str, apply_suppressions: bool = True) -> List[Finding]:
     if not _in_private_pkg(path):
         return []
     with open(path, encoding="utf-8") as f:
@@ -76,7 +76,7 @@ def lint_file(path: str) -> List[Finding]:
         tree = ast.parse(source, filename=path)
     except SyntaxError:
         return []  # other passes report parse failures
-    allowed = _allowed_lines(source)
+    allowed = _allowed_lines(source) if apply_suppressions else set()
     findings: List[Finding] = []
     for node in ast.walk(tree):
         if isinstance(node, ast.Assign):
@@ -111,14 +111,16 @@ def lint_file(path: str) -> List[Finding]:
     return findings
 
 
-def lint_paths(paths: Iterable[str]) -> List[Finding]:
+def lint_paths(
+    paths: Iterable[str], apply_suppressions: bool = True
+) -> List[Finding]:
     findings: List[Finding] = []
     for path in paths:
         if os.path.isdir(path):
             for f in iter_py_files(path):
-                findings.extend(lint_file(f))
+                findings.extend(lint_file(f, apply_suppressions=apply_suppressions))
         else:
-            findings.extend(lint_file(path))
+            findings.extend(lint_file(path, apply_suppressions=apply_suppressions))
     return findings
 
 

@@ -502,3 +502,178 @@ def test_probe_wired_into_gcs(aiocheck_on):
     assert isinstance(srv.nodes, TrackedDict)
     assert isinstance(srv.actors, TrackedDict)
     assert isinstance(srv.kv, TrackedDict)
+
+
+# ---------------------------------------------------------------------------
+# aio_lint: await-interleave gaps closed for async-generator yields and
+# async comprehensions (both are scheduling points exactly like ``await``)
+# ---------------------------------------------------------------------------
+
+
+def test_interleave_async_generator_yield_positive():
+    findings = _lint(
+        """
+        class Streamer:
+            def __init__(self):
+                self.state = {}
+
+            async def stream(self, key):
+                val = self.state[key]
+                yield val  # consumer runs arbitrary code before __anext__
+                self.state[key] = val + 1
+        """
+    )
+    assert aio_lint.RULE_INTERLEAVE in _rules(findings)
+
+
+def test_interleave_async_comprehension_positive():
+    findings = _lint(
+        """
+        class Collector:
+            def __init__(self):
+                self.state = {}
+
+            async def collect(self, items, key):
+                val = self.state[key]
+                got = [x async for x in items]
+                self.state[key] = val + len(got)
+        """
+    )
+    assert aio_lint.RULE_INTERLEAVE in _rules(findings)
+
+
+def test_interleave_sync_comprehension_negative():
+    findings = _lint(
+        """
+        class Collector:
+            def __init__(self):
+                self.state = {}
+
+            async def collect(self, items, key):
+                val = self.state[key]
+                got = [x for x in items]
+                self.state[key] = val + len(got)
+                await asyncio.sleep(0)
+        """
+    )
+    assert aio_lint.RULE_INTERLEAVE not in _rules(findings)
+
+
+def test_interleave_async_for_regression():
+    findings = _lint(
+        """
+        class Consumer:
+            def __init__(self):
+                self.state = {}
+
+            async def consume(self, source, key):
+                val = self.state[key]
+                async for item in source:
+                    pass
+                self.state[key] = val + 1
+        """
+    )
+    assert aio_lint.RULE_INTERLEAVE in _rules(findings)
+
+
+def test_interleave_async_with_regression():
+    findings = _lint(
+        """
+        class Guard:
+            def __init__(self):
+                self.state = {}
+
+            async def guarded(self, cm, key):
+                val = self.state[key]
+                async with cm:
+                    pass
+                self.state[key] = val + 1
+        """
+    )
+    assert aio_lint.RULE_INTERLEAVE in _rules(findings)
+
+
+# ---------------------------------------------------------------------------
+# aio_lint: shared-attribute footprints (the explorer's DPOR input)
+# ---------------------------------------------------------------------------
+
+
+def test_extract_footprints(tmp_path):
+    (tmp_path / "mod.py").write_text(
+        textwrap.dedent(
+            """
+            REGISTRY = {}
+
+            class Worker:
+                def __init__(self):
+                    self.jobs = []
+                    self.done = 0
+
+                def push(self, j):
+                    self.jobs.append(j)
+
+                def pull(self):
+                    j = self.jobs.pop()
+                    self._bump()
+                    return j
+
+                def _bump(self):
+                    self.done += 1
+
+            def register(name):
+                REGISTRY[name] = 1
+            """
+        )
+    )
+    fp = aio_lint.extract_footprints([str(tmp_path / "mod.py")])
+    assert "self.jobs" in fp["Worker.push"]["writes"]
+    # Transitive closure folds _bump's write into pull.
+    assert "self.done" in fp["Worker.pull"]["writes"]
+    assert "self.jobs" in fp["Worker.pull"]["writes"]
+    assert "mod:REGISTRY" in fp["register"]["writes"]
+
+
+# ---------------------------------------------------------------------------
+# lint: stale-suppression audit
+# ---------------------------------------------------------------------------
+
+
+def test_stale_suppression_flagged(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "x = 1  # aio-lint: disable=blocking-call\n"
+    )
+    findings = lint.audit_suppressions([str(tmp_path)])
+    assert [f.rule for f in findings] == [lint.RULE_STALE]
+
+
+def test_live_suppression_not_flagged(tmp_path):
+    (tmp_path / "m.py").write_text(
+        textwrap.dedent(
+            """
+            import time
+
+            async def f():
+                time.sleep(1)  # aio-lint: disable=blocking-call
+            """
+        )
+    )
+    assert lint.audit_suppressions([str(tmp_path)]) == []
+
+
+def test_suppression_syntax_in_string_not_flagged(tmp_path):
+    # Docstrings and message strings mention the waiver syntax without
+    # being waivers; only genuine comment tokens are audited.
+    (tmp_path / "m.py").write_text(
+        'HELP = "waive with # aio-lint: disable=blocking-call"\n'
+    )
+    assert lint.audit_suppressions([str(tmp_path)]) == []
+
+
+def test_stale_telemetry_allow_flagged(tmp_path):
+    private = tmp_path / "_private"
+    private.mkdir()
+    (private / "m.py").write_text(
+        "y = 2  # telemetry: allow-adhoc-stats\n"
+    )
+    findings = lint.audit_suppressions([str(tmp_path)])
+    assert [f.rule for f in findings] == [lint.RULE_STALE]

@@ -4,11 +4,16 @@
 PYTHON ?= python
 SANITIZER ?= address
 
-.PHONY: lint test sanitize wire-docs protocols build chaos loadgen perf \
-	explore
+.PHONY: lint test sanitize wire-docs flow-docs protocols build chaos loadgen \
+	perf explore
 
+# The unified gate (all passes + stale-suppression audit + wall-time
+# budget), then the rpc_flow mutation gate: a seeded synchronous back-call
+# cycle must be detected, or the pass has lost its teeth.
 lint:
 	$(PYTHON) -m ray_tpu.devtools.lint
+	$(PYTHON) -m ray_tpu.devtools.rpc_flow --mutate back_call \
+		--expect-violation
 
 test:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow' \
@@ -38,6 +43,11 @@ sanitize:
 
 wire-docs:
 	$(PYTHON) -m ray_tpu.devtools.rpc_check --markdown > docs/wire_protocol.md
+
+# Regenerate the cross-process blocking-graph inventory; CI fails if the
+# checked-in copy is stale.
+flow-docs:
+	$(PYTHON) -m ray_tpu.devtools.rpc_flow --markdown > docs/rpc_flow.md
 
 # Regenerate the FSM reference from the machine-readable spec; CI fails if
 # the checked-in copy is stale.

@@ -1750,7 +1750,10 @@ class CoreWorker:
         task_id = entry["wire"]["task_id"]
         fut = self._recovering.get(task_id)
         if fut is not None:
-            await fut
+            # The owning recovery driver resolves this future on every path
+            # (success, re-execution failure, attempts exhausted — see the
+            # finally below); the get() caller owns the overall budget.
+            await fut  # rpc-flow: disable=unbounded-await
             return
         if entry["attempts"] <= 0:
             raise ObjectLostError(

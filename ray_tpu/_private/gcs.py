@@ -283,6 +283,38 @@ class GcsServer:
         self._bg_tasks = [t for t in self._bg_tasks if not t.done()]
         return task
 
+    def _spawn_pg_schedule(self, pg: "PlacementGroupInfo") -> asyncio.Task:
+        """Supervised ``_schedule_pg`` spawn: a crashed scheduling task must
+        not strand the PG in PENDING/RESCHEDULING with ``pg.pending``
+        futures nobody will ever resolve (CreatePlacementGroup callers with
+        ``wait_ready`` park on those)."""
+        task = self._spawn(self._schedule_pg(pg))
+
+        def _done(t: asyncio.Task, pg=pg) -> None:
+            if t.cancelled() or t.exception() is None:
+                return
+            exc = t.exception()
+            logger.error(
+                "placement group %s scheduling crashed: %s",
+                pg.spec.pg_id[:8],
+                exc,
+            )
+            if pg.state in (PG_PENDING, PG_RESCHEDULING):
+                pg.state = PG_INFEASIBLE
+                self._persist_pg(pg)
+            for fut in pg.pending:
+                if not fut.done():
+                    fut.set_exception(
+                        rpc.RpcError(
+                            f"placement group {pg.spec.pg_id[:8]} "
+                            f"scheduling failed: {exc}"
+                        )
+                    )
+            pg.pending.clear()
+
+        task.add_done_callback(_done)
+        return task
+
     # -- persistence (reference: gcs_table_storage.cc write-through) ---------
 
     def _persist_actor(self, actor: ActorInfo) -> None:
@@ -817,7 +849,7 @@ class GcsServer:
         for pg in self.placement_groups.values():
             if pg.state == PG_CREATED and node_id in pg.bundle_nodes:
                 pg.state = PG_RESCHEDULING
-                self._spawn(self._schedule_pg(pg))
+                self._spawn_pg_schedule(pg)
 
     # -- actor FSM ----------------------------------------------------------
 
@@ -835,7 +867,11 @@ class GcsServer:
             ):
                 fut = asyncio.get_running_loop().create_future()
                 existing_self.pending.append(fut)
-                return await fut
+                # actor.pending futures are flushed on every FSM transition
+                # (ALIVE, restart, death, node death); creation legitimately
+                # outwaits cluster scale-up, and callers bound the wait with
+                # their own rpc_actor_create_timeout_s budget.
+                return await fut  # rpc-flow: disable=unbounded-await
             return {"actor": existing_self.to_wire()}
         actor = ActorInfo(actor_id, spec)
         if actor.name:
@@ -856,7 +892,9 @@ class GcsServer:
         if p.get("wait_alive", True):
             fut = asyncio.get_running_loop().create_future()
             actor.pending.append(fut)
-            return await fut
+            # Same contract as the upsert branch above: pending futures are
+            # flushed on every actor FSM transition, callers own the budget.
+            return await fut  # rpc-flow: disable=unbounded-await
         return {"actor": actor.to_wire()}
 
     async def _actor_scheduler_loop(self) -> None:
@@ -1322,11 +1360,14 @@ class GcsServer:
         pg = PlacementGroupInfo(spec)
         self.placement_groups[spec.pg_id] = pg
         self._persist_pg(pg)
-        self._spawn(self._schedule_pg(pg))
+        self._spawn_pg_schedule(pg)
         if p.get("wait_ready"):
             fut = asyncio.get_running_loop().create_future()
             pg.pending.append(fut)
-            return await fut
+            # pg.pending futures are resolved by _schedule_pg on creation,
+            # infeasibility (PG_INFEASIBLE after its 120 s horizon), removal,
+            # and — via _spawn_pg_schedule supervision — scheduler crashes.
+            return await fut  # rpc-flow: disable=unbounded-await
         return {"pg_id": spec.pg_id, "state": pg.state}
 
     async def _schedule_pg(self, pg: PlacementGroupInfo) -> None:
@@ -1497,7 +1538,11 @@ class GcsServer:
                 if fut in pg.pending:
                     pg.pending.remove(fut)
                 return {"pg_id": p["pg_id"], "state": pg.state}
-        return await fut
+        # pg.ready(timeout=None) is the blocking API: parking until the PG
+        # reaches a terminal state is the contract. Every terminal path
+        # resolves pg.pending — _schedule_pg success, _remove_pg, and the
+        # _spawn_pg_schedule crash supervisor — so the future cannot strand.
+        return await fut  # rpc-flow: disable=unbounded-await
 
     async def _remove_pg(self, conn, p):
         pg = self.placement_groups.get(p["pg_id"])

@@ -128,9 +128,10 @@ class Node:
             if self.head and config.gcs_persistence:
                 # Final shutdown: the session is over, drop its durable state
                 # (restarts go through kill_gcs/restart_gcs, not stop()).
+                # The loop is about to exit; there is nothing left to stall.
                 import shutil
 
-                shutil.rmtree(
+                shutil.rmtree(  # aio-lint: disable=blocking-call
                     os.path.dirname(self.gcs_persist_path()), ignore_errors=True
                 )
 

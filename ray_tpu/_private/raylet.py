@@ -992,9 +992,14 @@ class Raylet:
         # Log pipeline (reference: log_monitor.py tailing session/logs/*):
         # worker output goes to per-worker session log files AND streams to
         # the driver via GCS pubsub.
-        rpc.spawn(self._pump_worker_logs(handle, proc.stdout, "stdout"))
-        rpc.spawn(self._pump_worker_logs(handle, proc.stderr, "stderr"))
-        rpc.spawn(self._reap_worker(handle))
+        # Per-worker infrastructure tasks. The log pumps never touch
+        # ledger/2PC state (a crashed pump loses log lines, nothing else);
+        # the reaper IS the supervisor — worker exit drives the lease-ledger
+        # repair in _handle_worker_exit, and there is no one to supervise
+        # the supervisor.
+        rpc.spawn(self._pump_worker_logs(handle, proc.stdout, "stdout"))  # rpc-flow: disable=unsupervised-spawn
+        rpc.spawn(self._pump_worker_logs(handle, proc.stderr, "stderr"))  # rpc-flow: disable=unsupervised-spawn
+        rpc.spawn(self._reap_worker(handle))  # rpc-flow: disable=unsupervised-spawn
         return handle
 
     async def _zygote_fork(self, env: Dict[str, str]) -> ZygoteProc:
@@ -1120,7 +1125,10 @@ class Raylet:
         if not handle.registered.done():
             handle.registered.set_exception(rpc.RpcError(f"worker died: {cause}"))
         if handle.actor_id:
-            rpc.spawn(
+            # _report_worker_death retries internally and the GCS also
+            # learns of the death from the dropped worker connection —
+            # ledger repair already happened above, synchronously.
+            rpc.spawn(  # rpc-flow: disable=unsupervised-spawn
                 self._report_worker_death(handle.worker_id, [handle.actor_id], cause)
             )
 
@@ -1311,7 +1319,11 @@ class Raylet:
             )
             req = LeaseRequest(p["lease_id"], demand, p)
             self.infeasible_leases.append(req)
-            return await req.fut
+            # Parking is the protocol: the demand feeds pending_demand /
+            # the autoscaler, the retry loop spills the request once a
+            # fitting node joins, and the client bounds the wait with its
+            # lease RPC budget (duplicate-grant dedup makes retries safe).
+            return await req.fut  # rpc-flow: disable=unbounded-await
         if not affinity and not p.get("spilled_from"):
             placed_by_locality = False
             hints = p.get("locality") or {}
@@ -1340,7 +1352,10 @@ class Raylet:
         req = LeaseRequest(p["lease_id"], demand, p)
         self.pending_leases.append(req)
         self._try_grant_leases()
-        return await req.fut
+        # Same parking contract as the infeasible queue above: resolved by
+        # _grant (which repairs ledger state and resolves the future on
+        # every failure path), bounded by the client's lease RPC budget.
+        return await req.fut  # rpc-flow: disable=unbounded-await
 
     def _spill_reply(self, target: dict) -> dict:
         self._tel_spillbacks.inc()
@@ -1733,10 +1748,21 @@ class Raylet:
         return {"cancelled": True}
 
     def _resolve_duplicate_lease(self, req: LeaseRequest) -> None:
-        rpc.spawn(self._resolve_duplicate_lease_async(req))
+        # Supervision is internal: the coroutine resolves req.fut on every
+        # path, including exceptions from the mirror wait.
+        rpc.spawn(self._resolve_duplicate_lease_async(req))  # rpc-flow: disable=unsupervised-spawn
 
     async def _resolve_duplicate_lease_async(self, req: LeaseRequest) -> None:
-        reply = await self._duplicate_lease_reply(req.lease_id)
+        try:
+            reply = await self._duplicate_lease_reply(req.lease_id)
+        except Exception as e:
+            # A crashed mirror must still resolve the future — the client
+            # is parked on it and would otherwise wait forever.
+            if not req.fut.done():
+                req.fut.set_exception(
+                    rpc.RpcError(f"duplicate-lease resolution failed: {e!r}")
+                )
+            return
         if not req.fut.done():
             req.fut.set_result(reply)
 
@@ -1767,7 +1793,11 @@ class Raylet:
                 self._record_granted(req.lease_id)
                 req.grant_started = time.monotonic()
                 self.grants_in_flight += 1
-                rpc.spawn(self._grant(req))
+                # Supervision is internal: _grant_inner refunds resources,
+                # clears the grant ledger, and resolves req.fut on every
+                # failure path (except Exception) — this task IS the
+                # grant's supervisor.
+                rpc.spawn(self._grant(req))  # rpc-flow: disable=unsupervised-spawn
                 granted_any = True
 
     async def _grant(self, req: LeaseRequest) -> None:
@@ -1812,14 +1842,22 @@ class Raylet:
                             if attempt >= 3:
                                 raise
                             await asyncio.sleep(0.1 * attempt)
-        except rpc.RpcError as e:
+        except Exception as e:
+            # Not just RpcError: worker spawn can raise OSError (exec
+            # failure, fd exhaustion) and an escaping exception here would
+            # leak the deducted resources and leave req.fut unresolved —
+            # the client parks forever on a lease nobody is granting.
             self.available = self.available + req.demand
             self._mark_dirty()
             # The grant never happened: clear the ledger entry so a genuine
             # client retry with the same id is not refused forever.
             self.granted_lease_ids.pop(req.lease_id, None)
             if not req.fut.done():
-                req.fut.set_exception(e)
+                req.fut.set_exception(
+                    e
+                    if isinstance(e, rpc.RpcError)
+                    else rpc.RpcError(f"lease grant failed: {e!r}")
+                )
             return
         if req.lease_id in self.leases and not self._mutate_double_grant:
             # Double grant (two _grant tasks raced to the same lease id —

@@ -854,7 +854,11 @@ class Executor:
             return
         fut = asyncio.get_running_loop().create_future()
         self.pending_seq.setdefault(caller, {})[seq] = fut
-        await fut
+        # Resolved by _advance_seq when the predecessor finishes (its
+        # finally runs even on failure); mirrors the reference
+        # out-of-order submit queue, where sequencing waits are unbounded
+        # and the caller's task-level retry owns recovery.
+        await fut  # rpc-flow: disable=unbounded-await
 
     def _advance_seq(self, caller: str, seq: int) -> None:
         nxt = max(self.expected_seq.get(caller, 0), seq + 1)

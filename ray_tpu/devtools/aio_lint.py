@@ -11,9 +11,11 @@ is a local, per-function pattern with an explicit suppression escape hatch.
 Rules
 -----
 - ``blocking-call``: a known blocking call (``time.sleep``, sync
-  ``subprocess``/``socket``/``urllib`` entry points, builtin ``open``)
-  lexically inside an ``async def``. Nested *sync* ``def``s are exempt —
-  they are usually ``run_in_executor`` targets.
+  ``subprocess``/``socket``/``urllib``/``requests``/``shutil`` entry
+  points, builtin ``open``, and the pathlib convenience I/O methods
+  ``read_text``/``read_bytes``/``write_text``/``write_bytes`` on any
+  receiver) lexically inside an ``async def``. Nested *sync* ``def``s are
+  exempt — they are usually ``run_in_executor`` targets.
 - ``raw-create-task``: ``asyncio.create_task`` / ``loop.create_task`` /
   ``asyncio.ensure_future`` anywhere. The event loop holds only weak task
   references; every background task must go through ``rpc.spawn()`` (or an
@@ -79,10 +81,29 @@ _BLOCKING_CALLS = {
     "requests.delete",
     "requests.head",
     "requests.request",
+    "shutil.copy",
+    "shutil.copy2",
+    "shutil.copyfile",
+    "shutil.copytree",
+    "shutil.copymode",
+    "shutil.copystat",
+    "shutil.move",
+    "shutil.rmtree",
 }
 
 # Builtin calls that do synchronous file I/O.
 _BLOCKING_BUILTINS = {"open"}
+
+# Method names that do synchronous file I/O on any receiver: the pathlib
+# convenience readers/writers (``cfg_path.read_text()``). Matched on the
+# trailing attribute alone because the receiver is an arbitrary Path
+# expression, not an importable module chain.
+_BLOCKING_METHODS = {
+    "read_text",
+    "read_bytes",
+    "write_text",
+    "write_bytes",
+}
 
 # Container constructors that mark an attribute as shared mutable state.
 _CONTAINER_CTORS = {"dict", "list", "set", "deque", "defaultdict", "OrderedDict"}
@@ -431,9 +452,11 @@ class _AsyncFnLinter:
 
     def _check_call(self, node: ast.Call) -> None:
         name = _dotted(node.func)
+        matched = False
         if name is not None:
             tail2 = ".".join(name.split(".")[-2:])
             if name in _BLOCKING_CALLS or tail2 in _BLOCKING_CALLS:
+                matched = True
                 self._emit(
                     node,
                     RULE_BLOCKING,
@@ -441,6 +464,18 @@ class _AsyncFnLinter:
                     f"{self.fn.name!r} stalls the event loop; use the async "
                     "equivalent or loop.run_in_executor()",
                 )
+        if (
+            not matched
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _BLOCKING_METHODS
+        ):
+            self._emit(
+                node,
+                RULE_BLOCKING,
+                f"blocking file I/O .{node.func.attr}() inside async def "
+                f"{self.fn.name!r} stalls the event loop; use the async "
+                "equivalent or loop.run_in_executor()",
+            )
         if isinstance(node.func, ast.Name) and node.func.id in _BLOCKING_BUILTINS:
             self._emit(
                 node,

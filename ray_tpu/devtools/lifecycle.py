@@ -134,6 +134,17 @@ REGISTRY: Tuple[PairSpec, ...] = (
         "(ray_tpu/_private/object_store.py)",
     ),
     PairSpec(
+        name="trace-span",
+        acquire=("set_context",),
+        release=("reset_context",),
+        receivers=("tracing",),
+        scoped=True,
+        doc="PR 13 trace-context token: set_context returns a contextvar "
+        "reset token that must be reset in the same function, or the span "
+        "leaks onto unrelated work sharing the thread/context "
+        "(ray_tpu/util/tracing.py)",
+    ),
+    PairSpec(
         name="grant-ledger",
         acquire=("_record_granted",),
         release=("_mark_lease_released", "_burn_lease_id"),

@@ -2,12 +2,19 @@
 
 Runs every static pass over the package and exits non-zero on any finding:
 the asyncio hazard linter (aio_lint), the RPC wire cross-checker
-(rpc_check), the paired-resource lifecycle pass (lifecycle), the protocol
-FSM checker (protocols), the telemetry-registry pass (telemetry_lint,
-no ad-hoc stats dicts in runtime code), and the stale-suppression audit
-(a ``disable=``/``allow-`` comment that no longer masks any finding is
-itself a finding — dead waivers rot into false confidence). This is the
-CI lint job's entry point; ``make lint`` wraps it.
+(rpc_check), the whole-program blocking-graph pass (rpc_flow: distributed
+wait cycles, deadline propagation, task supervision), the paired-resource
+lifecycle pass (lifecycle), the protocol FSM checker (protocols), the
+telemetry-registry pass (telemetry_lint, no ad-hoc stats dicts in runtime
+code), and the stale-suppression audit (a ``disable=``/``allow-`` comment
+that no longer masks any finding is itself a finding — dead waivers rot
+into false confidence). This is the CI lint job's entry point; ``make
+lint`` wraps it.
+
+The gate also times itself: each pass's wall time is printed, and the
+total is capped (``--budget-s``, or ``RAY_TPU_LINT_BUDGET_S``; default
+120 s). A pass that grows superlinearly fails the gate before it quietly
+turns the pre-merge loop into a coffee break.
 """
 
 from __future__ import annotations
@@ -16,35 +23,41 @@ import argparse
 import io
 import os
 import sys
+import time
 import tokenize
-from typing import Dict, List, Optional, Set
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ray_tpu.devtools import (
     aio_lint,
     lifecycle,
     protocols,
     rpc_check,
+    rpc_flow,
     telemetry_lint,
 )
 
 _PASSES = (
-    "aio-lint + rpc-check + lifecycle + protocols + telemetry-lint"
-    " + suppression-audit"
+    "aio-lint + rpc-check + rpc-flow + lifecycle + protocols"
+    " + telemetry-lint + suppression-audit"
 )
 
 RULE_STALE = "stale-suppression"
+RULE_BUDGET = "lint-over-budget"
+
+_DEFAULT_BUDGET_S = 120.0
 
 
 def audit_suppressions(paths: List[str]) -> List[aio_lint.Finding]:
     """Flag suppression comments that no longer mask any raw finding.
 
     Re-runs every pass with ``apply_suppressions=False`` and checks each
-    ``# aio-lint: disable=`` / ``# lifecycle: disable=`` /
-    ``# protocol: disable=`` / ``# telemetry: allow-adhoc-stats`` comment
-    against the raw findings of its own family on the line it covers (the
-    comment's line and the line below, mirroring the passes' scoping).
-    The ``aio-lint`` syntax is shared by rpc_check, so its comments are
-    validated against both passes' findings.
+    ``# aio-lint: disable=`` / ``# rpc-flow: disable=`` /
+    ``# lifecycle: disable=`` / ``# protocol: disable=`` /
+    ``# telemetry: allow-adhoc-stats`` comment against the raw findings of
+    its own family on the line it covers (the comment's line and the line
+    below, mirroring the passes' scoping). The ``aio-lint`` syntax is
+    shared by rpc_check, so its comments are validated against both
+    passes' findings.
     """
     files: List[str] = []
     for path in paths:
@@ -58,6 +71,7 @@ def audit_suppressions(paths: List[str]) -> List[aio_lint.Finding]:
             aio_lint.lint_paths(paths, apply_suppressions=False)
             + rpc_check.check(paths, apply_suppressions=False)
         ),
+        "rpc-flow": rpc_flow.check(paths, apply_suppressions=False),
         "lifecycle": lifecycle.lint_paths(paths, apply_suppressions=False),
         "protocol": protocols.check(paths, apply_suppressions=False),
         "telemetry": telemetry_lint.lint_paths(paths, apply_suppressions=False),
@@ -73,6 +87,7 @@ def audit_suppressions(paths: List[str]) -> List[aio_lint.Finding]:
 
     regexes = {
         "aio-lint": aio_lint._SUPPRESS_RE,
+        "rpc-flow": rpc_flow._SUPPRESS_RE,
         "lifecycle": lifecycle._SUPPRESS_RE,
         "protocol": protocols._SUPPRESS_RE,
         "telemetry": telemetry_lint._ALLOW_RE,
@@ -129,26 +144,66 @@ def audit_suppressions(paths: List[str]) -> List[aio_lint.Finding]:
     return out
 
 
+def run_timed(
+    paths: List[str],
+) -> Tuple[List[aio_lint.Finding], List[Tuple[str, float]]]:
+    """All passes + audit, with per-pass wall times."""
+    stages: List[Tuple[str, Callable[[], List[aio_lint.Finding]]]] = [
+        ("aio-lint", lambda: list(aio_lint.lint_paths(paths))),
+        ("rpc-check", lambda: rpc_check.check(paths)),
+        ("rpc-flow", lambda: rpc_flow.check(paths)),
+        ("lifecycle", lambda: lifecycle.lint_paths(paths)),
+        ("protocols", lambda: protocols.check(paths)),
+        ("telemetry-lint", lambda: telemetry_lint.lint_paths(paths)),
+        ("suppression-audit", lambda: audit_suppressions(paths)),
+    ]
+    findings: List[aio_lint.Finding] = []
+    timings: List[Tuple[str, float]] = []
+    for name, fn in stages:
+        t0 = time.monotonic()
+        findings.extend(fn())
+        timings.append((name, time.monotonic() - t0))
+    return findings, timings
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m ray_tpu.devtools.lint",
         description="run all ray_tpu static-analysis passes",
     )
     parser.add_argument("paths", nargs="*", default=None)
+    parser.add_argument(
+        "--budget-s",
+        type=float,
+        default=float(
+            os.environ.get("RAY_TPU_LINT_BUDGET_S", _DEFAULT_BUDGET_S)
+        ),
+        help="fail if the whole gate takes longer than this many seconds "
+        "(env RAY_TPU_LINT_BUDGET_S; <= 0 disables)",
+    )
     args = parser.parse_args(argv)
     paths = args.paths or [aio_lint._default_root()]
 
-    findings = list(aio_lint.lint_paths(paths))
-    findings.extend(rpc_check.check(paths))
-    findings.extend(lifecycle.lint_paths(paths))
-    findings.extend(protocols.check(paths))
-    findings.extend(telemetry_lint.lint_paths(paths))
-    findings.extend(audit_suppressions(paths))
+    findings, timings = run_timed(paths)
     findings.sort(key=lambda f: (f.path, f.line, f.col))
     for f in findings:
         print(f)
-    if findings:
-        print(f"lint: {len(findings)} finding(s) across {_PASSES}")
+    total = sum(dt for _, dt in timings)
+    slowest = ", ".join(
+        f"{name} {dt:.2f}s"
+        for name, dt in sorted(timings, key=lambda t: -t[1])[:3]
+    )
+    print(f"lint: {total:.2f}s wall ({slowest})")
+    over_budget = 0.0 < args.budget_s < total
+    if over_budget:
+        print(
+            f"lint: {RULE_BUDGET}: gate took {total:.2f}s, budget is "
+            f"{args.budget_s:g}s — profile the slowest pass above or raise "
+            "RAY_TPU_LINT_BUDGET_S deliberately"
+        )
+    if findings or over_budget:
+        if findings:
+            print(f"lint: {len(findings)} finding(s) across {_PASSES}")
         return 1
     print(f"lint: clean ({_PASSES})")
     return 0

@@ -618,9 +618,11 @@ def _check_trace_declared() -> List[Finding]:
 def markdown_table(paths: Optional[List[str]] = None) -> str:
     """The versioned wire-protocol inventory committed to docs/."""
     from ray_tpu._private import wire
+    from ray_tpu.devtools import rpc_flow  # deferred: rpc_flow imports us
 
     paths = paths or [_default_root()]
     inv = build_inventory(paths)
+    flow = rpc_flow.build(paths)
     root = os.path.dirname(_default_root())
 
     def rel(p: str) -> str:
@@ -663,9 +665,17 @@ def markdown_table(paths: Optional[List[str]] = None) -> str:
         "trace-context slot: ✓ = propagates (a traced caller's context",
         "rides the frame), — = control/background traffic that never",
         "joins a request trace (kind-4 blob requests cannot carry it).",
+        "Deadline is the default budget the method's frames carry, derived",
+        "from its call sites by `rpc_flow.deadline_sources`: `pinned (...)`",
+        "= every site sends an explicit timeout/deadline (the listed",
+        "sources); `ambient` = sites fold the caller's remaining budget",
+        "when one is set (`_effective_deadline`), so the TTL slot is",
+        "populated exactly when the caller is itself deadlined; `mixed",
+        "(...)` = some sites pin a budget, others fold ambient; `never` =",
+        "no site ever sends a TTL (fire-and-forget or callback vias).",
         "",
-        "| Method | Schema | Retry | Blob | Trace | Servers (handler) | Client call sites | Payload keys |",
-        "|---|---|---|---|---|---|---|---|",
+        "| Method | Schema | Retry | Blob | Trace | Deadline | Servers (handler) | Client call sites | Payload keys |",
+        "|---|---|---|---|---|---|---|---|---|",
     ]
     for method in sorted(by_method):
         info = by_method[method]
@@ -695,9 +705,23 @@ def markdown_table(paths: Optional[List[str]] = None) -> str:
             trace = "✓" if schema.trace else "—"
         else:
             keys, star, retry, blob, trace = "", "", "", "", ""
+        maybe, guaranteed, srcs = rpc_flow.deadline_sources(flow, method)
+        shown = ", ".join(f"`{s}`" for s in srcs[:3])
+        if len(srcs) > 3:
+            shown += f" +{len(srcs) - 3}"
+        if not info["calls"]:
+            deadline = "—"
+        elif guaranteed:
+            deadline = f"pinned ({shown})"
+        elif maybe and srcs:
+            deadline = f"mixed ({shown})"
+        elif maybe:
+            deadline = "ambient"
+        else:
+            deadline = "never"
         lines.append(
             f"| `{method}` | {star} | {retry} | {blob} | {trace} | "
-            f"{servers} | {callers} | {keys} |"
+            f"{deadline} | {servers} | {callers} | {keys} |"
         )
     lines.append("")
     lines.append(

@@ -34,6 +34,19 @@ class RuntimeEnv(dict):
         super().__init__(**{k: v for k, v in kwargs.items() if v is not None})
 
 
+async def _rmtree_async(path: str) -> None:
+    """Delete a tree off the event loop: half-built pip/conda envs can be
+    hundreds of MB, and a sync rmtree there stalls every heartbeat the
+    hosting loop owes while the unlink storm runs."""
+    import asyncio
+    import functools
+    import shutil
+
+    await asyncio.get_running_loop().run_in_executor(
+        None, functools.partial(shutil.rmtree, path, ignore_errors=True)
+    )
+
+
 def _zip_dir(path: str) -> bytes:
     buf = io.BytesIO()
     with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
@@ -131,9 +144,7 @@ async def _fetch_package(core, key: str) -> str:
     try:
         os.rename(tmp, dest)
     except OSError:  # concurrent extraction won the race
-        import shutil
-
-        shutil.rmtree(tmp, ignore_errors=True)
+        await _rmtree_async(tmp)
     return dest
 
 
@@ -261,9 +272,7 @@ async def ensure_pip_env(pip: Any) -> Optional[str]:
                 raise RuntimeError(f"{what} failed: {out.decode()[-2000:]}")
 
         try:
-            import shutil
-
-            shutil.rmtree(dest, ignore_errors=True)  # half-built leftovers
+            await _rmtree_async(dest)  # half-built leftovers
             await _run(
                 [sys.executable, "-m", "venv", "--system-site-packages", dest],
                 "venv creation",
@@ -286,7 +295,10 @@ async def ensure_pip_env(pip: Any) -> Optional[str]:
         except BaseException:
             import shutil
 
-            shutil.rmtree(dest, ignore_errors=True)
+            # Cancellation path: an await here could itself be interrupted by
+            # a second cancel and skip the cleanup, leaving a half-built env
+            # that later lookups would mistake for ready. Stay synchronous.
+            shutil.rmtree(dest, ignore_errors=True)  # aio-lint: disable=blocking-call
             raise
     finally:
         try:
@@ -419,10 +431,9 @@ async def ensure_conda_env(conda: Any) -> Optional[str]:
             return dest
         try:
             import json as _json
-            import shutil
             import tempfile
 
-            shutil.rmtree(dest, ignore_errors=True)
+            await _rmtree_async(dest)
             yml = {k: v for k, v in spec.items() if not k.startswith("_")}
             with tempfile.NamedTemporaryFile(
                 "w", suffix=".yml", delete=False
@@ -443,7 +454,10 @@ async def ensure_conda_env(conda: Any) -> Optional[str]:
         except BaseException:
             import shutil
 
-            shutil.rmtree(dest, ignore_errors=True)
+            # Cancellation path: an await here could itself be interrupted by
+            # a second cancel and skip the cleanup, leaving a half-built env
+            # that later lookups would mistake for ready. Stay synchronous.
+            shutil.rmtree(dest, ignore_errors=True)  # aio-lint: disable=blocking-call
             raise
     finally:
         try:

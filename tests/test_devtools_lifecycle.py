@@ -85,6 +85,37 @@ def test_double_release():
     assert lifecycle.RULE_DOUBLE_RELEASE in rules
 
 
+def test_trace_span_leak_across_await():
+    # The PR 13 span token is scoped: crossing an await before the reset
+    # means a cancellation leaks the span onto whatever runs next on this
+    # context.
+    rules = _lrules(
+        """
+        class R:
+            async def handle(self, ctx):
+                tok = tracing.set_context(ctx)
+                await self.invoke()
+                tracing.reset_context(tok)
+        """
+    )
+    assert lifecycle.RULE_HELD_AWAIT in rules
+
+
+def test_trace_span_try_finally_is_clean():
+    # The shipped idiom (worker_main, serve replica): reset in a finally.
+    assert not _lrules(
+        """
+        class R:
+            async def handle(self, ctx):
+                tok = tracing.set_context(ctx)
+                try:
+                    return await self.invoke()
+                finally:
+                    tracing.reset_context(tok)
+        """
+    )
+
+
 def test_clean_try_finally():
     assert not _lrules(
         """

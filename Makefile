@@ -103,6 +103,7 @@ SCHED_SEEDS ?= 10
 RECOVERY_SEEDS ?= 10
 COLLECTIVE_SEEDS ?= 5
 HA_SEEDS ?= 10
+SPILL_SEEDS ?= 10
 chaos:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m ray_tpu.chaos --check-determinism \
 		--suite full --seeds $(SEEDS)
@@ -118,3 +119,5 @@ chaos:
 		--suite ha --seeds $(HA_SEEDS)
 	env JAX_PLATFORMS=cpu $(PYTHON) -m ray_tpu.chaos \
 		--suite collective --seeds $(COLLECTIVE_SEEDS)
+	env JAX_PLATFORMS=cpu $(PYTHON) -m ray_tpu.chaos \
+		--suite spill --seeds $(SPILL_SEEDS)

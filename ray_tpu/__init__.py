@@ -23,6 +23,7 @@ from ray_tpu._private.common import (
     ActorUnavailableError,
     GetTimeoutError,
     ObjectLostError,
+    ObjectReconstructionFailedError,
     PlacementGroupError,
     RayTpuError,
     TaskCancelledError,
@@ -103,6 +104,7 @@ __all__ = [
     "ActorUnavailableError",
     "WorkerCrashedError",
     "ObjectLostError",
+    "ObjectReconstructionFailedError",
     "GetTimeoutError",
     "TaskCancelledError",
     "PlacementGroupError",

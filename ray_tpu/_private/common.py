@@ -92,6 +92,23 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     # Bounded wait for the spill/restore IO pool to drain at node shutdown
     # (a wedged storage backend must not hang shutdown forever).
     "io_pool_shutdown_timeout_s": 10.0,
+    # Proactive pressure loop: when arena occupancy exceeds this fraction the
+    # raylet spills sealed-and-unpinned objects (largest-first) down to the
+    # threshold without waiting for an allocation failure (reference:
+    # object_spilling_threshold, ray_config_def.h). <= 0 disables the loop;
+    # allocation-failure spilling still runs either way.
+    "object_spilling_threshold": 0.8,
+    # Pressure-loop poll interval.
+    "object_spilling_poll_interval_s": 0.25,
+    # Owner-side lineage cache budget: producing TaskSpecs retained for
+    # reconstruction, LRU-pruned beyond this many bytes (reference:
+    # RAY_max_lineage_bytes / lineage_pinning). Reconstruction of a pruned
+    # object raises ObjectReconstructionFailedError.
+    "lineage_bytes_limit": 64 * 1024 * 1024,
+    # Cap on recursive lineage reconstruction: rebuilding a lost object may
+    # find its producer's arguments also lost; each nesting level counts
+    # toward this depth before the owner gives up with a typed error.
+    "reconstruction_max_depth": 10,
     # serve: how long the controller waits for a replica to acknowledge a
     # user_config reconfigure before replacing it.
     "serve_reconfigure_timeout_s": 30.0,
@@ -554,6 +571,13 @@ class ActorUnavailableError(RayTpuError):
 
 class ObjectLostError(RayTpuError):
     pass
+
+
+class ObjectReconstructionFailedError(ObjectLostError):
+    """A lost object could not be rebuilt from lineage: the producing
+    TaskSpec was pruned under lineage_bytes_limit, the producer was a
+    ray.put / non-retriable actor task (no lineage exists), or the
+    reconstruction recursion exceeded reconstruction_max_depth."""
 
 
 class GetTimeoutError(RayTpuError, TimeoutError):

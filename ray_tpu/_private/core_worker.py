@@ -18,7 +18,7 @@ import hashlib
 import logging
 import time
 import traceback
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import cloudpickle
@@ -29,6 +29,7 @@ from ray_tpu._private.common import (
     ActorUnavailableError,
     GetTimeoutError,
     ObjectLostError,
+    ObjectReconstructionFailedError,
     ResourceSet,
     TaskCancelledError,
     TaskError,
@@ -48,6 +49,21 @@ from ray_tpu._private.ids import (
 from ray_tpu._private.object_store import IN_PLASMA, INLINE, MemoryStore, PlasmaClient
 
 logger = logging.getLogger(__name__)
+
+_TEL_RECONSTRUCTIONS = telemetry.counter(
+    "object", "reconstructions",
+    "lineage reconstructions of lost objects, by outcome "
+    "(ok = producer re-ran and the value is back; failed = re-execution "
+    "failed, attempts exhausted, depth cap hit, or no lineage existed; "
+    "pruned = the producing spec was dropped under lineage_bytes_limit)",
+)
+_TEL_RECON_OK = _TEL_RECONSTRUCTIONS.cell(outcome="ok")
+_TEL_RECON_FAILED = _TEL_RECONSTRUCTIONS.cell(outcome="failed")
+_TEL_RECON_PRUNED = _TEL_RECONSTRUCTIONS.cell(outcome="pruned")
+_TEL_LINEAGE_BYTES = telemetry.gauge(
+    "object", "lineage_bytes",
+    "bytes of retained producing TaskSpecs (bounded by lineage_bytes_limit)",
+)
 
 
 class ObjectRefGenerator:
@@ -1148,12 +1164,23 @@ class CoreWorker:
         # arrive; "done" carries the final count from the task reply.
         self._dyn_streams: Dict[str, dict] = {}
         self._oid_to_dyn: Dict[str, str] = {}
-        # Lineage: oid -> {"wire": producing TaskSpec wire, "attempts": int}.
-        # Lost plasma-resident task returns are recomputed by re-running the
-        # producing task (reference: object_recovery_manager.h:41 +
-        # task_manager.cc; deterministic return ids from ids.py make the
-        # recomputed object land under the same id).
-        self.lineage: Dict[str, dict] = {}
+        # Lineage: oid -> {"wire": producing TaskSpec wire, "attempts": int,
+        # "nbytes": retained-spec size estimate}. Lost plasma-resident task
+        # returns are recomputed by re-running the producing task (reference:
+        # object_recovery_manager.h:41 + task_manager.cc; deterministic
+        # return ids from ids.py make the recomputed object land under the
+        # same id). Ordered: total retained bytes are bounded by
+        # config.lineage_bytes_limit with least-recently-registered/used
+        # eviction (reference: lineage_pinning / TaskManager lineage bytes
+        # accounting), so a long-lived driver cannot leak every spec it ever
+        # submitted.
+        self.lineage: "OrderedDict[str, dict]" = OrderedDict()
+        self._lineage_bytes = 0
+        # Oids whose lineage fell to the byte cap (NOT freed): recovery of
+        # these raises the typed pruned error instead of the generic
+        # "no lineage", so callers can tell a tuning problem from an
+        # unreconstructable-by-design object.
+        self._lineage_pruned: set = set()
         self._recovering: Dict[str, asyncio.Future] = {}
         self.closed = False
         self._bg_tasks: List[asyncio.Task] = []
@@ -1175,6 +1202,12 @@ class CoreWorker:
     def start_background(self) -> None:
         self._loop = asyncio.get_running_loop()
         self._bg_tasks.append(rpc.spawn(self._flush_loop()))
+        # Owner-side node-death watch: when a node dies, every owned plasma
+        # object whose primary copy lived there is gone — kick lineage
+        # reconstruction eagerly instead of waiting for the next get to trip
+        # over the dead address (reference: object_recovery_manager +
+        # WaitForObjectEviction node-death subscription).
+        self._bg_tasks.append(rpc.spawn(self._watch_node_deaths()))
         # Periodic runtime-telemetry flush to the GCS aggregate. Idempotent
         # per process: in an in-process cluster the driver's CoreWorker wins
         # and the shared registry flushes once.
@@ -1294,7 +1327,8 @@ class CoreWorker:
 
     def schedule_free(self, oid: str) -> None:
         self._free_queue.append(oid)
-        self.lineage.pop(oid, None)
+        self._drop_lineage(oid)
+        self._lineage_pruned.discard(oid)
         dyn_task = self._oid_to_dyn.pop(oid, None)
         if dyn_task is not None:
             self._dyn_streams.pop(dyn_task, None)
@@ -1502,7 +1536,12 @@ class CoreWorker:
                     continue
             if status == "timeout":
                 raise GetTimeoutError(f"owner timed out resolving {ref.hex()[:12]}")
-            raise ObjectLostError(
+            err_cls = (
+                ObjectReconstructionFailedError
+                if reply.get("reconstruction_failed")
+                else ObjectLostError
+            )
+            raise err_cls(
                 f"owner reports {ref.hex()[:12]}: {status}"
                 + (f" ({reply['error']})" if reply.get("error") else "")
             )
@@ -1531,6 +1570,11 @@ class CoreWorker:
         oid = p["oid"]
         try:
             await self.recover_object(oid)
+        except ObjectReconstructionFailedError as e:
+            # Typed flag so the borrower re-raises the reconstruction error
+            # class, not the generic loss (callers branch on it to decide
+            # between re-submitting work and failing the pipeline).
+            return {"status": "lost", "error": str(e), "reconstruction_failed": True}
         except ObjectLostError as e:
             return {"status": "lost", "error": str(e)}
         entry = await self.memory_store.wait_for(oid, p.get("timeout") or 300)
@@ -1718,8 +1762,17 @@ class CoreWorker:
                         plasma_oids.append(self._dyn_item_oid(wire["task_id"], i))
         if not plasma_oids:
             return
+        # Size estimate: the spec's dominant payload is the serialized-args
+        # blob; the flat overhead covers ids/resources/etc. The same wire is
+        # shared by every return of the task, but charging it per return
+        # keeps the accounting release-order independent (each pop subtracts
+        # exactly what its insert added).
+        nbytes = len(wire.get("args_blob") or b"") + 512
         for oid in plasma_oids:
-            prev = self.lineage.get(oid)
+            prev = self.lineage.pop(oid, None)
+            if prev is not None:
+                self._lineage_bytes -= prev["nbytes"]
+            self._lineage_pruned.discard(oid)
             self.lineage[oid] = {
                 "wire": wire,
                 # A reconstruction-driven re-run must not refill the attempt
@@ -1729,24 +1782,63 @@ class CoreWorker:
                     if prev is not None
                     else config.max_lineage_reconstruction
                 ),
+                "nbytes": nbytes,
             }
+            self._lineage_bytes += nbytes
+        # LRU prune to the byte cap; never evict the entry just inserted
+        # (a single over-cap spec must still be reconstructable once).
+        while (
+            self._lineage_bytes > config.lineage_bytes_limit
+            and len(self.lineage) > 1
+        ):
+            old_oid, old = self.lineage.popitem(last=False)
+            self._lineage_bytes -= old["nbytes"]
+            self._lineage_pruned.add(old_oid)
+        _TEL_LINEAGE_BYTES.set(self._lineage_bytes)
 
-    async def recover_object(self, oid: str) -> None:
+    def _drop_lineage(self, oid: str) -> None:
+        entry = self.lineage.pop(oid, None)
+        if entry is not None:
+            self._lineage_bytes -= entry["nbytes"]
+            _TEL_LINEAGE_BYTES.set(self._lineage_bytes)
+
+    async def recover_object(self, oid: str, depth: int = 0) -> None:
         """Re-execute the producing task of a lost object (owner side).
 
         Deduplicates concurrent recoveries per producing task (one re-execution
-        regenerates every return of that task); recursive losses resolve
-        naturally because the re-executed task's worker pulls its args through
-        this same get path (recursing borrower->owner).
+        regenerates every return of that task). Lost owned *arguments* are
+        recovered first (recursively, ``depth``-capped by
+        config.reconstruction_max_depth) so the re-run's worker never fetches
+        against a dead address; anything else resolves lazily because the
+        re-executed task's worker pulls its args through this same get path
+        (recursing borrower->owner).
         Reference: src/ray/core_worker/object_recovery_manager.h:41.
         """
+        if depth > config.reconstruction_max_depth:
+            _TEL_RECON_FAILED.inc()
+            raise ObjectReconstructionFailedError(
+                f"object {oid[:12]} lost; reconstruction recursion exceeded "
+                f"reconstruction_max_depth={config.reconstruction_max_depth}"
+            )
         entry = self.lineage.get(oid)
         if entry is None:
-            raise ObjectLostError(
+            if oid in self._lineage_pruned:
+                _TEL_RECON_PRUNED.inc()
+                raise ObjectReconstructionFailedError(
+                    f"object {oid[:12]} lost and its producing task was "
+                    f"pruned under lineage_bytes_limit="
+                    f"{config.lineage_bytes_limit}; raise the limit or "
+                    "persist the value outside the object store"
+                )
+            _TEL_RECON_FAILED.inc()
+            raise ObjectReconstructionFailedError(
                 f"object {oid[:12]} lost and has no lineage "
                 "(ray.put objects and non-retriable actor-task returns are "
                 "not reconstructable)"
             )
+        # Being the subject of a recovery is an access: keep hot lineage out
+        # of the prune window.
+        self.lineage.move_to_end(oid)
         task_id = entry["wire"]["task_id"]
         fut = self._recovering.get(task_id)
         if fut is not None:
@@ -1756,7 +1848,8 @@ class CoreWorker:
             await fut  # rpc-flow: disable=unbounded-await
             return
         if entry["attempts"] <= 0:
-            raise ObjectLostError(
+            _TEL_RECON_FAILED.inc()
+            raise ObjectReconstructionFailedError(
                 f"object {oid[:12]} lost; lineage reconstruction attempts exhausted"
             )
         entry["attempts"] -= 1
@@ -1765,11 +1858,14 @@ class CoreWorker:
         wire = dict(entry["wire"])
         wire.pop("_attempt", None)
         logger.info(
-            "reconstructing object %s by re-running task %r",
+            "reconstructing object %s by re-running task %r (depth %d)",
             oid[:12],
             wire["name"],
+            depth,
         )
         self.record_task_event(wire["task_id"], wire["name"], "RECONSTRUCTING")
+        t0 = time.monotonic()
+        ws = time.time()
         # Re-install the submission bookkeeping that _run_task's finally
         # clause tears down.
         self._inflight_tasks[wire["task_id"]] = {"cancelled": False, "conn": None}
@@ -1778,6 +1874,7 @@ class CoreWorker:
         for dep_oid, _ in wire["dependencies"]:
             self.reference_table.add_submitted(dep_oid)
         try:
+            await self._recover_lost_deps(wire, depth)
             if wire.get("actor_id"):
                 # Actor-task return: resubmit through the (restarted) actor
                 # (reference: task_manager.cc actor-task resubmission).
@@ -1785,13 +1882,97 @@ class CoreWorker:
             else:
                 await self._run_task(wire)
             fut.set_result(None)
+            _TEL_RECON_OK.inc()
+            from ray_tpu.util import tracing
+
+            tracing.record_span(
+                "object.reconstruct",
+                "object",
+                ws,
+                time.monotonic() - t0,
+                oid=oid[:16],
+                task=wire["name"],
+                depth=depth,
+            )
         except BaseException as e:
+            # Typed reconstruction failures already counted their outcome at
+            # the raise site (ok/pruned/failed are mutually exclusive).
+            if not isinstance(e, ObjectReconstructionFailedError):
+                _TEL_RECON_FAILED.inc()
             fut.set_exception(e)
             # Consume it if nobody else awaits the future.
             fut.exception()
             raise
         finally:
             self._recovering.pop(task_id, None)
+
+    async def _recover_lost_deps(self, wire: dict, depth: int) -> None:
+        """Probe the task's owned, task-produced plasma arguments and
+        reconstruct any whose copy is gone (holder dead or store emptied)
+        before re-running the producer. A spilled copy counts as present —
+        the holder's ObjContains includes its spill table, and restore runs
+        on the worker's arg fetch."""
+        for dep_oid, _owner in wire.get("dependencies") or []:
+            entry = self.memory_store.get(dep_oid)
+            if entry is None or entry.kind != IN_PLASMA:
+                continue
+            if (
+                dep_oid not in self.lineage
+                and dep_oid not in self._lineage_pruned
+            ):
+                continue  # not task-produced: the pull/restore path owns it
+            try:
+                if tuple(entry.plasma_addr) == self.raylet_addr:
+                    alive = (await self.plasma.contains([dep_oid])).get(dep_oid)
+                else:
+                    conn = await self.connect_to(tuple(entry.plasma_addr))
+                    reply = await conn.call(
+                        "ObjContains",
+                        {"oids": [dep_oid]},
+                        timeout=config.rpc_object_get_timeout_s,
+                    )
+                    alive = reply["contains"].get(dep_oid)
+            except (rpc.RpcError, asyncio.TimeoutError, OSError):
+                alive = False  # unreachable holder == lost copy
+            if not alive:
+                await self.recover_object(dep_oid, depth + 1)
+
+    # -------------------------------------------- node-death object recovery
+
+    async def _watch_node_deaths(self) -> None:
+        try:
+            await self.gcs.subscribe("nodes", self._on_node_event)
+        except (rpc.RpcError, asyncio.TimeoutError, OSError) as e:
+            # Standalone/degraded boots have no pubsub; loss then surfaces
+            # lazily on the next get of an affected object.
+            logger.debug("node-death watch unavailable: %s", e)
+
+    def _on_node_event(self, msg) -> None:
+        if not isinstance(msg, dict) or msg.get("event") != "removed":
+            return
+        addr = msg.get("lost_object_addr") or (msg.get("node") or {}).get("addr")
+        if not addr:
+            return
+        dead = tuple(addr)
+        if dead == self.raylet_addr:
+            return  # our own raylet died; the session is going down with it
+        for oid in self.memory_store.plasma_oids_at(dead):
+            rpc.spawn(self._recover_lost_primary(oid))
+
+    async def _recover_lost_primary(self, oid: str) -> None:
+        try:
+            await self.recover_object(oid)
+        except ObjectLostError as e:
+            # Unreconstructable (no lineage / pruned / exhausted): leave the
+            # stale marker in place so the consumer's get raises the same
+            # typed error instead of hanging on a missing entry.
+            logger.warning("object %s lost to node death: %s", oid[:12], e)
+        except (rpc.RpcError, asyncio.TimeoutError, OSError) as e:
+            logger.warning(
+                "eager reconstruction of %s failed (%s); will retry on get",
+                oid[:12],
+                e,
+            )
 
     async def _handle_ping(self, conn, p):
         return {"pong": True, "worker_id": self.worker_id}

@@ -25,6 +25,23 @@ from typing import Callable, Dict, Optional
 from ray_tpu._private.common import config
 
 
+class SpillIntegrityError(RuntimeError):
+    """The bytes at a spill URI do not match the object that was written —
+    e.g. a torn/partial upload from a crash mid-spill. Restore must raise
+    this instead of returning short (callers would otherwise seal a buffer
+    with trailing garbage); the raylet treats it as the copy being lost,
+    not as a transient IO failure to retry."""
+
+    def __init__(self, uri: str, expected: int, actual: int):
+        super().__init__(
+            f"spill file {uri} is torn: expected {expected} bytes, "
+            f"storage holds {actual}"
+        )
+        self.uri = uri
+        self.expected = expected
+        self.actual = actual
+
+
 class ExternalStorage:
     """One spill backend. Implementations must be thread-safe: the raylet
     calls spill/restore/delete concurrently from IO-pool threads."""
@@ -34,7 +51,10 @@ class ExternalStorage:
         raise NotImplementedError
 
     def restore(self, uri: str, dest: memoryview) -> int:
-        """Read the object at ``uri`` into ``dest``; returns bytes read."""
+        """Fill ``dest`` with the object at ``uri``; returns bytes read.
+
+        Must raise SpillIntegrityError when storage holds fewer bytes than
+        ``len(dest)`` (a torn spill file) rather than returning short."""
         raise NotImplementedError
 
     def delete(self, uri: str) -> None:
@@ -72,9 +92,16 @@ class FileSystemStorage(ExternalStorage):
 
     def restore(self, uri: str, dest: memoryview) -> int:
         path = uri[len("file://") :]
+        n = 0
         with open(path, "rb") as f:
-            n = f.readinto(dest)
-        return n or 0
+            while n < len(dest):
+                got = f.readinto(dest[n:])
+                if not got:
+                    break
+                n += got
+        if n < len(dest):
+            raise SpillIntegrityError(uri, len(dest), n)
+        return n
 
     def delete(self, uri: str) -> None:
         try:
@@ -135,6 +162,11 @@ class UriStorage(ExternalStorage):
                     break
                 view[n : n + len(chunk)] = chunk
                 n += len(chunk)
+        if n < len(dest):
+            # EOF before the buffer filled: the upload was torn (partial
+            # write that a crash made visible). Distinguishable from a
+            # transient stream error, which raises from pyarrow itself.
+            raise SpillIntegrityError(uri, len(dest), n)
         return n
 
     def delete(self, uri: str) -> None:

@@ -839,7 +839,19 @@ class GcsServer:
             node_id=node_id,
             graceful=graceful,
         )
-        self._publish_msg("nodes", {"event": "removed", "node": node.to_wire()})
+        self._publish_msg(
+            "nodes",
+            {
+                "event": "removed",
+                "node": node.to_wire(),
+                # Object-location hint: every plasma copy addressed at this
+                # raylet died with the node. Owners subscribed to "nodes"
+                # match their IN_PLASMA markers against it and kick lineage
+                # reconstruction eagerly (reference: object directory
+                # location eviction on node removal).
+                "lost_object_addr": list(node.addr),
+            },
+        )
         self._bump_view(node, membership=True)
         # Fail/restart actors that lived there.
         for actor in list(self.actors.values()):

@@ -91,6 +91,17 @@ class MemoryStore:
     def delete(self, oid: str) -> None:
         self._entries.pop(oid, None)
 
+    def plasma_oids_at(self, addr) -> List[str]:
+        """Objects whose primary copy lives in the arena at ``addr`` — the
+        set a node death at that address makes candidates for lineage
+        reconstruction."""
+        addr = tuple(addr)
+        return [
+            oid
+            for oid, e in self._entries.items()
+            if e.kind == IN_PLASMA and e.plasma_addr == addr
+        ]
+
     def _notify(self, oid: str) -> None:
         for fut in self._waiters.pop(oid, []):
             if not fut.done():
@@ -289,6 +300,32 @@ class PlasmaClient:
 
     async def delete(self, oids: List[str]) -> None:
         await self.conn.call("ObjDelete", {"oids": oids})
+
+    async def spill(self, oids: List[str]) -> Dict[str, List[str]]:
+        """Direct the raylet to spill the given sealed objects to external
+        storage now (owner-driven eviction; ray._private.internal_api
+        force-spill analog). Returns {"spilled": [...], "rejected": [...]} —
+        held/unsealed/pinned objects are rejected, not errors."""
+        return await self.conn.call(
+            "SpillObjects", {"oids": oids},
+            timeout=config.rpc_transfer_timeout_s,
+        )
+
+    async def restore(self, oid: str) -> bool:
+        """Ask the raylet to restore one spilled object into the arena."""
+        reply = await self.conn.call(
+            "RestoreSpilled", {"oid": oid},
+            timeout=config.rpc_transfer_timeout_s,
+        )
+        return bool(reply.get("restored"))
+
+    async def pin(self, oid: str, pin: bool = True) -> bool:
+        """Pin (or unpin) an object against spilling/eviction."""
+        reply = await self.conn.call(
+            "PinObject", {"oid": oid, "pin": pin},
+            timeout=config.rpc_control_timeout_s,
+        )
+        return bool(reply.get("ok"))
 
     def close(self) -> None:
         for seg in self._arenas.values():

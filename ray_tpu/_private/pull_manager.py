@@ -32,6 +32,11 @@ _TEL_REREQUESTED = telemetry.counter(
     "object", "pull_streams_rerequested",
     "stalled chunk streams re-requested from the source",
 )
+_TEL_RESTORE_FALLBACKS = telemetry.counter(
+    "object", "pull_restore_fallbacks",
+    "pulls that recovered via owner-directed RestoreSpilled after the "
+    "in-memory probe missed",
+)
 
 
 class PullStalled(Exception):
@@ -57,6 +62,10 @@ class PullManager:
         self.max_rerequests = int(max_rerequests)
         self.stalled_streams = 0
         self.rerequested_streams = 0
+        # Pulls that found no in-memory copy but recovered one via an
+        # explicit RestoreSpilled to the holder (a spilled object is a valid
+        # pull source — the restore fallback runs before object-lost).
+        self.restore_fallbacks = 0
         # Heap of (priority, seq, size, future) — seq keeps FIFO order
         # within a priority class and makes heap entries comparable.
         self._waiters: List[Tuple[int, int, int, asyncio.Future]] = []
@@ -162,4 +171,5 @@ class PullManager:
             "queued_pulls": len(self._waiters),
             "stalled_streams": self.stalled_streams,
             "rerequested_streams": self.rerequested_streams,
+            "restore_fallbacks": self.restore_fallbacks,
         }

@@ -405,6 +405,91 @@ def _bench_transfer_16mb() -> float:
         cluster.shutdown()
 
 
+def _bench_spill() -> Dict[str, float]:
+    """Object plane under memory pressure (docs/perf.md "Spilling"): a
+    working set 4x the arena pushed through put + get, so the pressure loop
+    spills the cold tail on the way in and the gets pay restores on the way
+    out; then the same oversubscription driven through the data pipeline
+    (execute -> iter_batches), counted in rows/s. Runs after shutdown():
+    both phases boot their own small-arena session with filesystem
+    spilling."""
+    import os
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    spill_dir = tempfile.mkdtemp(prefix="ray_tpu_perf_spill_")
+    saved = os.environ.get("RAY_TPU_OBJECT_SPILLING_CONFIG")
+    os.environ["RAY_TPU_OBJECT_SPILLING_CONFIG"] = json.dumps(
+        {"type": "filesystem", "params": {"directory_path": spill_dir}}
+    )
+    arena = 64 * 1024 * 1024
+    obj = 8 * 1024 * 1024
+    n = 4 * arena // obj  # 32 objects: working set 4x the arena
+    results: Dict[str, float] = {}
+    try:
+        ray_tpu.init(num_cpus=2, num_tpus=0, object_store_memory=arena)
+
+        def cycle():
+            refs = [
+                ray_tpu.put(np.full(obj, i % 251, dtype=np.uint8))
+                for i in range(n)
+            ]
+            for i, ref in enumerate(refs):
+                out = ray_tpu.get(ref, timeout=120)
+                assert out[0] == i % 251
+                del out  # drop the zero-copy hold so the copy stays evictable
+
+        mb = 2 * n * obj // (1024 * 1024)  # bytes spilled in + restored out
+        results["spill_restore_mb_per_s"] = timeit(
+            f"spill+restore round trip ({n * obj >> 20}MB through "
+            f"{arena >> 20}MB arena)",
+            cycle,
+            mb,
+        )
+        ray_tpu.shutdown()
+
+        # Same oversubscription end to end through the data pipeline: blocks
+        # totaling 4x the arena must stream execute -> iter_batches with
+        # zero errors while cold blocks spill and restore under the hood.
+        ray_tpu.init(num_cpus=2, num_tpus=0, object_store_memory=arena)
+        import ray_tpu.data as rd
+
+        n_blocks = 16
+        rows_per_block = (4 * arena) // n_blocks // 1024  # 1 KB rows
+        total = n_blocks * rows_per_block
+
+        def widen(b):
+            out = dict(b)
+            out["payload"] = np.zeros((len(b["id"]), 1024), dtype=np.uint8)
+            return out
+
+        ds = rd.range(total, parallelism=n_blocks).map_batches(widen)
+
+        def data_cycle():
+            seen = 0
+            for out in ds.iter_batches(
+                batch_size=4096, batch_format="numpy", prefetch_batches=2
+            ):
+                seen += len(out["payload"])
+            assert seen == total, seen
+
+        results["oversubscribed_put_rows_per_s"] = timeit(
+            "oversubscribed ingest rows (4x arena, execute->iter_batches)",
+            data_cycle,
+            total,
+        )
+        ray_tpu.shutdown()
+    finally:
+        if saved is None:
+            os.environ.pop("RAY_TPU_OBJECT_SPILLING_CONFIG", None)
+        else:
+            os.environ["RAY_TPU_OBJECT_SPILLING_CONFIG"] = saved
+        shutil.rmtree(spill_dir, ignore_errors=True)
+    return results
+
+
 def _collective_child_main() -> None:
     """Child-process body for the collective allreduce bench.
 
@@ -662,6 +747,7 @@ def main(json_path: str = "") -> Dict[str, float]:
     ray_tpu.shutdown()
 
     results["transfer_16mb_per_s"] = _bench_transfer_16mb()
+    results.update(_bench_spill())
     results.update(_bench_collective_allreduce())
     results.update(_bench_sched())
     results["gcs_persist_puts_per_s"] = _bench_gcs_persist()

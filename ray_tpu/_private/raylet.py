@@ -32,6 +32,7 @@ from ray_tpu._private.push_manager import PushManager
 from ray_tpu._private.common import ResourceSet, adaptive_chunk_size, config
 from ray_tpu._private.gcs import GcsClient
 from ray_tpu._private.store_core import make_store_core
+from ray_tpu.util import tracing
 
 logger = logging.getLogger(__name__)
 
@@ -89,6 +90,24 @@ _TEL_OBJ_SEALED = telemetry.counter(
 )
 _TEL_OBJ_EVICTED = telemetry.counter(
     "object", "evicted", "sealed objects LRU-evicted under allocation pressure"
+)
+_TEL_OBJ_SPILLED_BYTES = telemetry.counter(
+    "object", "spilled_bytes", "bytes written to external spill storage"
+)
+_TEL_OBJ_RESTORED_BYTES = telemetry.counter(
+    "object", "restored_bytes", "bytes restored from external spill storage"
+)
+_TEL_SPILL_LATENCY = telemetry.histogram(
+    "object", "spill_latency_s", "external-storage write latency per object",
+    buckets=telemetry.LATENCY_BUCKETS_S,
+)
+_TEL_RESTORE_LATENCY = telemetry.histogram(
+    "object", "restore_latency_s", "external-storage read latency per object",
+    buckets=telemetry.LATENCY_BUCKETS_S,
+)
+_TEL_ARENA_PRESSURE = telemetry.gauge(
+    "object", "arena_pressure",
+    "shm arena occupancy fraction (used/capacity) seen by the pressure loop",
 )
 
 
@@ -402,6 +421,11 @@ class Raylet:
     _tel_locality_hits = _TEL_LOCALITY_HITS.cell()
     _tel_locality_misses = _TEL_LOCALITY_MISSES.cell()
     _tel_node_util = _TEL_NODE_UTIL.cell()
+    _tel_spilled_bytes = _TEL_OBJ_SPILLED_BYTES.cell()
+    _tel_restored_bytes = _TEL_OBJ_RESTORED_BYTES.cell()
+    _tel_spill_latency = _TEL_SPILL_LATENCY.cell()
+    _tel_restore_latency = _TEL_RESTORE_LATENCY.cell()
+    _tel_arena_pressure = _TEL_ARENA_PRESSURE.cell()
 
     # Mutation gate for the interleaving explorer (devtools/explore.py):
     # when True, both layers of the PR 2 duplicate-grant fix are disabled
@@ -489,6 +513,10 @@ class Raylet:
         self.spilled_bytes = 0
         self.spilling: Dict[str, asyncio.Task] = {}
         self.restoring: Dict[str, asyncio.Future] = {}
+        # Owner-pinned primary copies (PinObject): the spill scheduler and
+        # LRU eviction never touch these, whatever the pressure — an owner
+        # that pins is promising to unpin or delete.
+        self.pinned_objects: set = set()
         base = config.object_spilling_dir or os.path.join(
             "/tmp", "ray_tpu_spill"
         )
@@ -580,6 +608,11 @@ class Raylet:
         self._tel_locality_hits = _TEL_LOCALITY_HITS.cell(raylet=_nid)
         self._tel_locality_misses = _TEL_LOCALITY_MISSES.cell(raylet=_nid)
         self._tel_node_util = _TEL_NODE_UTIL.cell(raylet=_nid)
+        self._tel_spilled_bytes = _TEL_OBJ_SPILLED_BYTES.cell(raylet=_nid)
+        self._tel_restored_bytes = _TEL_OBJ_RESTORED_BYTES.cell(raylet=_nid)
+        self._tel_spill_latency = _TEL_SPILL_LATENCY.cell(raylet=_nid)
+        self._tel_restore_latency = _TEL_RESTORE_LATENCY.cell(raylet=_nid)
+        self._tel_arena_pressure = _TEL_ARENA_PRESSURE.cell(raylet=_nid)
 
         # Placement group bundles committed on this node:
         # pg_id -> {"base": ResourceSet deducted, "group": ResourceSet added}
@@ -693,6 +726,8 @@ class Raylet:
         self._tasks.append(rpc.spawn(self._infeasible_retry_loop()))
         if config.memory_monitor_interval_s > 0:
             self._tasks.append(rpc.spawn(self._memory_monitor_loop()))
+        if config.object_spilling_threshold > 0:
+            self._tasks.append(rpc.spawn(self._pressure_loop()))
         logger.info(
             "raylet %s on %s:%s resources=%s",
             self.node_id[:8],
@@ -769,7 +804,33 @@ class Raylet:
         if spill_tasks:
             await asyncio.gather(*spill_tasks, return_exceptions=True)
         self.spilling.clear()
+        # Delete each remaining spill file individually BEFORE destroy():
+        # destroy() is a backstop (rmtree / delete_dir_contents) that some
+        # backends implement partially or not at all, and a session sharing
+        # an external bucket must not leak its per-object keys. The deletes
+        # ride the IO pool; the bounded shutdown below drains them.
+        del_futs = []
+        for uri, _size, _pinned in self.spilled.values():
+            try:
+                del_futs.append(self._io_pool.submit(self.storage.delete, uri))
+            except RuntimeError:
+                break
         self.spilled.clear()
+        self.spilled_bytes = 0
+        self.pinned_objects.clear()
+        if del_futs:
+            try:
+                await asyncio.wait_for(
+                    asyncio.get_running_loop().run_in_executor(
+                        None,
+                        lambda: concurrent.futures.wait(
+                            del_futs, timeout=config.io_pool_shutdown_timeout_s
+                        ),
+                    ),
+                    timeout=config.io_pool_shutdown_timeout_s + 1,
+                )
+            except (asyncio.TimeoutError, RuntimeError):
+                pass
         try:
             # Bounded: a wedged storage backend (stalled NFS/remote store)
             # must not hang node shutdown; the arena-close retry below copes
@@ -827,6 +888,9 @@ class Raylet:
         s.register("ObjContains", self._obj_contains)
         s.register("PullObject", self._pull_object)
         s.register("FetchChunk", self._fetch_chunk)
+        s.register("SpillObjects", self._spill_objects)
+        s.register("RestoreSpilled", self._restore_spilled)
+        s.register("PinObject", self._pin_object)
         s.register("PushObject", self._push_object)
         s.register("PushStart", self._push_start)
         s.register_blob("PushChunk", self._push_chunk_sink)
@@ -2152,6 +2216,7 @@ class Raylet:
         are quarantined until the grace window passes. Immediate reuse keeps
         sustained large-put workloads on already-faulted arena pages."""
         self._drop_spilled(oid)
+        self.pinned_objects.discard(oid)
         info = self.store.lookup(oid)
         if oid in self.condemned or info is None:
             return
@@ -2186,7 +2251,12 @@ class Raylet:
         grace = config.object_store_eviction_grace_s
         candidates = []
         for vic, last in self.obj_last_access.items():
-            if now - last < grace or vic in self.obj_holds or vic in self.spilling:
+            if (
+                now - last < grace
+                or vic in self.obj_holds
+                or vic in self.spilling
+                or vic in self.pinned_objects
+            ):
                 continue
             info = self.store.lookup(vic)
             if info is not None and info[2] and not info[3]:
@@ -2215,8 +2285,13 @@ class Raylet:
     # -- spilling (reference: local_object_manager.cc, external_storage.py) --
 
     def _start_spills(self, need_bytes: int) -> None:
-        """Schedule spill writes for LRU victims until in-flight spills cover
-        ``need_bytes`` (or no candidates remain)."""
+        """Schedule spill writes until in-flight spills cover ``need_bytes``
+        (or no candidates remain). Largest-first: freeing the demanded bytes
+        with the fewest IO round-trips minimizes per-object spill overhead
+        and leaves the most small hot objects resident (reference:
+        LocalObjectManager::SpillObjectsOfSize picks until the byte target).
+        Ref-aware: never a client-held, condemned, pinned, or in-flight
+        spilling/restoring object."""
         in_flight = 0
         for vic in self.spilling:
             info = self.store.lookup(vic)
@@ -2231,13 +2306,15 @@ class Raylet:
                 or vic in self.condemned
                 or vic in self.spilling
                 or vic in self.restoring
+                or vic in self.pinned_objects
             ):
                 continue
             info = self.store.lookup(vic)
             if info is not None and info[2]:
-                candidates.append((last, vic, info[1]))
-        candidates.sort()
-        for _, vic, vsize in candidates:
+                candidates.append((info[1], last, vic))
+        # Largest first; LRU (oldest access) breaks size ties.
+        candidates.sort(key=lambda c: (-c[0], c[1]))
+        for vsize, _, vic in candidates:
             self.spilling[vic] = rpc.spawn(self._spill_task(vic))
             in_flight += vsize
             if in_flight >= need_bytes:
@@ -2254,6 +2331,7 @@ class Raylet:
             off, size, _, pinned = info
             view = self.arena.view[off : off + size]
             loop = asyncio.get_running_loop()
+            t0 = time.monotonic()
             try:
                 uri = await loop.run_in_executor(
                     self._io_pool, self.storage.spill, oid, view
@@ -2261,6 +2339,7 @@ class Raylet:
             except Exception:
                 logger.exception("spill of %s failed", oid[:12])
                 return
+            self._tel_spill_latency.observe(time.monotonic() - t0)
             # Re-check: a delete/condemn, a new client hold, or a
             # delete-then-recreate (same oid, new span — detectable as a
             # changed offset/size or an unsealed state) during the write
@@ -2282,6 +2361,15 @@ class Raylet:
             self.spilled_bytes += size
             self.store.free(oid)
             self.obj_last_access.pop(oid, None)
+            self._tel_spilled_bytes.inc(size)
+            telemetry.record_event(
+                "object", "spilled", oid=oid[:16], size=size,
+                node=self.node_id[:8],
+            )
+            tracing.record_span(
+                "object.spill", "object", time.time() - (time.monotonic() - t0),
+                time.monotonic() - t0, oid=oid[:16], size=size,
+            )
             logger.info(
                 "spilled %s (%d bytes) to %s; store %d/%d",
                 oid[:12],
@@ -2314,11 +2402,30 @@ class Raylet:
         ok = False
         try:
             dest = self.arena.view[offset : offset + size]
+            t0 = time.monotonic()
             try:
                 n = await loop.run_in_executor(
                     self._io_pool, self.storage.restore, uri, dest
                 )
                 ok = n == size
+            except external_storage.SpillIntegrityError as e:
+                # Torn spill file: the external copy is garbage, so this is
+                # NOT transient — drop the entry (and the bad bytes) so the
+                # object reads as lost and the owner's lineage
+                # reconstruction takes over instead of a retry loop sealing
+                # corrupt data.
+                logger.error("restore of %s hit torn spill file: %s", oid[:12], e)
+                telemetry.record_event(
+                    "object", "spill_corrupt", oid=oid[:16],
+                    node=self.node_id[:8], expected=e.expected, actual=e.actual,
+                )
+                self._drop_spilled(oid)
+                self.store.free(oid)
+                fut.set_result(None)
+                for w in self.obj_waiters.pop(oid, []):
+                    if not w.done():
+                        w.set_result(False)
+                return None
             except Exception:
                 logger.exception("restore of %s failed", oid[:12])
             if oid in self.condemned:
@@ -2339,6 +2446,17 @@ class Raylet:
                 return None
             self.store.seal(oid)
             self.obj_last_access[oid] = time.monotonic()
+            self._tel_restore_latency.observe(time.monotonic() - t0)
+            self._tel_restored_bytes.inc(size)
+            telemetry.record_event(
+                "object", "restored", oid=oid[:16], size=size,
+                node=self.node_id[:8],
+            )
+            tracing.record_span(
+                "object.restore", "object",
+                time.time() - (time.monotonic() - t0),
+                time.monotonic() - t0, oid=oid[:16], size=size,
+            )
             if self.spilled.pop(oid, None) is not None:
                 self.spilled_bytes -= size
             # Fire-and-forget: the external copy's deletion must not hold the
@@ -2380,6 +2498,87 @@ class Raylet:
             self._io_pool.submit(self.storage.delete, uri)
         except RuntimeError:  # pool already shut down at teardown
             pass
+
+    async def _pressure_loop(self) -> None:
+        """Proactive spill-under-pressure (reference: LocalObjectManager
+        triggered at object_spilling_threshold, local_object_manager.cc):
+        instead of waiting for an allocation to fail — which serializes the
+        spill IO latency into some put's backpressure loop — spill eligible
+        objects (largest-first, via _start_spills) as soon as occupancy
+        crosses the threshold, so steady-state oversubscribed workloads
+        always find headroom."""
+        threshold = config.object_spilling_threshold
+        while True:
+            await asyncio.sleep(config.object_spilling_poll_interval_s)
+            cap = self.store_capacity
+            used = self.store.used
+            frac = used / cap if cap else 0.0
+            self._tel_arena_pressure.set(frac)
+            if frac <= threshold:
+                continue
+            # Spill down to the threshold watermark, counting writes
+            # already in flight (they free their spans when the IO lands).
+            self._start_spills(used - int(threshold * cap))
+
+    async def _spill_objects(self, conn, p):
+        """SpillObjects: owner/tooling directive to move named objects to
+        external storage now. Idempotent: an already-spilled oid reports as
+        spilled; an ineligible one (unsealed, held, pinned, condemned,
+        mid-restore, or unknown) reports as rejected, never an error."""
+        scheduled = []
+        rejected = []
+        for oid in p["oids"]:
+            if oid in self.spilled:
+                scheduled.append(oid)
+                continue
+            if oid in self.spilling:
+                scheduled.append(oid)
+                continue
+            info = self.store.lookup(oid)
+            if (
+                info is None
+                or not info[2]
+                or oid in self.obj_holds
+                or oid in self.condemned
+                or oid in self.restoring
+                or oid in self.pinned_objects
+            ):
+                rejected.append(oid)
+                continue
+            self.spilling[oid] = rpc.spawn(self._spill_task(oid))
+            scheduled.append(oid)
+        waits = [self.spilling[oid] for oid in scheduled if oid in self.spilling]
+        if waits:
+            await asyncio.gather(*waits, return_exceptions=True)
+        return {
+            "spilled": [oid for oid in scheduled if oid in self.spilled],
+            "rejected": rejected,
+        }
+
+    async def _restore_spilled(self, conn, p):
+        """RestoreSpilled: bring one spilled object back into the arena —
+        the pull manager's owner-directed fallback before it declares an
+        object lost. Coalesces with in-flight restores; a no-op (already
+        resident) reports restored=True."""
+        oid = p["oid"]
+        await self._restore_with_backpressure(oid)
+        info = self.store.lookup(oid)
+        resident = (
+            info is not None and info[2] and oid not in self.condemned
+        )
+        return {"restored": resident, "spilled": oid in self.spilled}
+
+    async def _pin_object(self, conn, p):
+        """PinObject: mark/unmark an object as a pinned primary copy. The
+        spill scheduler and LRU eviction skip pinned oids entirely."""
+        oid = p["oid"]
+        if bool(p.get("pin", True)):
+            if not self.store.contains(oid) and oid not in self.spilled:
+                return {"ok": False}
+            self.pinned_objects.add(oid)
+        else:
+            self.pinned_objects.discard(oid)
+        return {"ok": True}
 
     # -- memory monitor (reference: memory_monitor.h + worker_killing_policy)
 
@@ -2702,6 +2901,26 @@ class Raylet:
         )
         probe_meta = probe["found"].get(oid)
         if probe_meta is None:
+            # A spilled copy is a valid pull source: before declaring the
+            # object absent, direct the holder to restore from its external
+            # storage (the probe's internal restore can give up early when
+            # its arena is persistently full — an explicit RestoreSpilled
+            # retries with fresh backpressure budget).
+            try:
+                rest = await remote.call(
+                    "RestoreSpilled", {"oid": oid},
+                    timeout=config.rpc_transfer_timeout_s,
+                )
+            except (rpc.RpcError, asyncio.TimeoutError, OSError):
+                rest = None
+            if rest and rest.get("restored"):
+                self.pull_manager.restore_fallbacks += 1
+                pull_manager_mod._TEL_RESTORE_FALLBACKS.inc()
+                probe = await remote.call(
+                    "ObjGet", {"oids": [oid], "block": True, "timeout": 30}
+                )
+                probe_meta = probe["found"].get(oid)
+        if probe_meta is None:
             await remote.close()
             raise rpc.RpcError(f"object {oid[:12]} not on remote node")
         pull_size = int(probe_meta.get("size", 0))
@@ -2900,6 +3119,7 @@ class Raylet:
             "pending_leases": len(self.pending_leases) + len(self.infeasible_leases),
             "spilled_objects": len(self.spilled),
             "spilled_bytes": self.spilled_bytes,
+            "pinned_objects": len(self.pinned_objects),
             "push_stats": dict(self.push_manager.stats),
             # Unmet demand shapes for the autoscaler's bin-packing
             # (reference: resource_demand_scheduler reads task demands).

@@ -221,6 +221,18 @@ SCHEMAS: Dict[str, WireSchema] = {
     # FetchChunk requests are plain control frames (only the reply blobs).
     "PushChunk": _s(["oid", "offset"], blob="push", trace=False),
     "FetchChunk": _s(["oid", "offset", "size"], blob="reply", trace=True),
+    # Spill directive: ask a raylet to move named sealed objects to external
+    # storage now (owner-driven eviction / pressure tooling). Idempotent —
+    # an already-spilled or ineligible oid is reported back, not an error.
+    "SpillObjects": _s(["oids"], retry=RETRY_SAFE, trace=False),
+    # Owner/pull-directed restore: bring one spilled object back into the
+    # arena. Restores coalesce on the raylet's restoring-future table, so
+    # re-delivery after a lost reply is indistinguishable from one delivery.
+    # On a consumer's critical path (pull fallback), hence traced.
+    "RestoreSpilled": _s(["oid"], retry=RETRY_SAFE, trace=True),
+    # Primary-copy pin/unpin: a pinned object is never chosen by the spill
+    # scheduler or LRU eviction. Keyed flag write — freely retried.
+    "PinObject": _s(["oid"], ["pin"], retry=RETRY_SAFE, trace=False),
     # -- ray-client plane ----------------------------------------------------
     # Small puts send "payload" inline; large puts ship the serialized
     # region as a kind-4 blob which the server reads back as "data".

@@ -21,6 +21,12 @@ machinery promises:
   created before the faults must still ``get`` correctly (recovery may
   re-execute lineage), and a fresh probe task must run. Both are workload
   probes rather than state inspections, so they live in the runner.
+- **no-data-loss** — for spill scenarios: every acknowledged put or task
+  return with a live ref still resolves to its exact bytes post-quiesce
+  (restored from external storage or re-executed from lineage), or fails
+  with the typed :class:`ObjectReconstructionFailedError` — never wrong
+  bytes, never a hang, never an untyped error
+  (:func:`check_no_data_loss`).
 
 All coroutines here run on the cluster's event loop.
 """
@@ -224,6 +230,52 @@ def check_store(raylet) -> List[Violation]:
                 f"in-flight restores {sorted(raylet.restoring)}",
             )
         )
+    return violations
+
+
+def check_no_data_loss(ray_mod, ledger, timeout_s: float = 120.0) -> List[Violation]:
+    """Every acknowledged object — a driver ``put`` or a task return whose
+    readiness the workload observed — with a still-live ref must resolve to
+    its exact bytes after convergence (restored from external storage or
+    re-executed from lineage), or fail with the typed
+    ``ObjectReconstructionFailedError``. Wrong bytes, hangs (a get timeout),
+    and untyped errors are data loss.
+
+    A functional probe in the objects-reconstructable mold: the runner
+    passes the ``(ref, sha256-hexdigest, kind)`` ledger it built while the
+    workload ran. Runs on the driver thread (blocking gets), not the
+    cluster loop.
+    """
+    import hashlib
+
+    from ray_tpu._private.common import ObjectReconstructionFailedError
+
+    violations = []
+    for ref, digest, kind in ledger:
+        try:
+            data = ray_mod.get(ref, timeout=timeout_s)
+        except ObjectReconstructionFailedError:
+            # Principled, typed loss (lineage pruned / unreconstructable by
+            # design): the caller knows exactly what happened and why.
+            continue
+        except Exception as e:
+            violations.append(
+                Violation(
+                    "no-data-loss",
+                    "-",
+                    f"{kind} object {ref.hex()[:12]} irrecoverable with "
+                    f"untyped {type(e).__name__}: {e}",
+                )
+            )
+            continue
+        if hashlib.sha256(data).hexdigest() != digest:
+            violations.append(
+                Violation(
+                    "no-data-loss",
+                    "-",
+                    f"{kind} object {ref.hex()[:12]} resolved to wrong bytes",
+                )
+            )
     return violations
 
 

@@ -44,6 +44,32 @@ def _produce_blob(tag):
     return (h * (300_000 // len(h) + 1))[:300_000]
 
 
+SPILL_BLOB_SIZE = 4 * 1024 * 1024  # a handful oversubscribe the spill arena
+_SPILL_ARENA = 32 * 1024 * 1024  # per-node object store for spill scenarios
+
+
+def _spill_digest(tag) -> str:
+    """sha256 of the deterministic 4 MB payload for a tag (the payload bytes
+    themselves; equal whether the value round-trips as bytes or uint8)."""
+    h = hashlib.sha256(repr(tag).encode()).digest()
+    blob = (h * (SPILL_BLOB_SIZE // len(h) + 1))[:SPILL_BLOB_SIZE]
+    return hashlib.sha256(blob).hexdigest()
+
+
+def _produce_spill_blob(tag):
+    import hashlib as _hashlib
+
+    import numpy as _np
+
+    h = _hashlib.sha256(repr(tag).encode()).digest()
+    n = 4 * 1024 * 1024
+    # uint8 array, not bytes: numpy values are weakref-able, so the driver's
+    # zero-copy value hold dies with the value and an already-pulled copy
+    # stays evictable — bytes would pin the oversubscribed arena for the
+    # ObjectRef's whole lifetime and wedge later pulls.
+    return _np.frombuffer((h * (n // len(h) + 1))[:n], dtype=_np.uint8)
+
+
 def _add(a, b):
     return a + b
 
@@ -102,11 +128,14 @@ class Scenario:
     name: str
     description: str
     specs: List[FaultSpec]
-    workload: str  # "tasks" | "transfer" | "serve" | "sched"
+    workload: str  # "tasks" | "transfer" | "serve" | "sched" | "collective" | "spill"
     steps: int = 3
     nemesis: List[str] = field(default_factory=list)
     remote_node: bool = False  # add a {"victim": 2} node for cross-node work
     env: Dict[str, str] = field(default_factory=dict)
+    # Shrink each node's arena (spill workload: working set is sized as a
+    # multiple of this, so pressure spilling is guaranteed, not incidental).
+    object_store_memory: Optional[int] = None
     # Re-add a victim node at the end of a seed run if nemesis removed one.
     repair: bool = False
     # sched workload: size of the SimCluster (in-process raylets, no driver).
@@ -132,6 +161,15 @@ _TRANSFER_ENV = {
 }
 
 _TASKS_ENV = {"RAY_TPU_WORKER_LEASE_IDLE_KEEP_S": "0.2"}
+
+_SPILL_ENV = {
+    # Spill decisions must land within a step, and a pull whose source died
+    # mid-transfer re-requests quickly instead of riding out the default
+    # stall window.
+    "RAY_TPU_OBJECT_SPILLING_POLL_INTERVAL_S": "0.05",
+    "RAY_TPU_PULL_STALL_TIMEOUT_S": "1.0",
+    "RAY_TPU_WORKER_LEASE_IDLE_KEEP_S": "0.2",
+}
 
 _LATENCY_ENV = {
     # Per-attempt cap on the retryable GCS channel: a dropped reply is
@@ -327,6 +365,36 @@ SCENARIOS: Dict[str, Scenario] = {
             env=dict(_TRANSFER_ENV),
         ),
         Scenario(
+            name="spill_kill_raylet",
+            description="working set 4x the arena forces pressure spilling "
+            "on the victim node, then the node dies (its spill files die "
+            "with it); every acknowledged object must come back bytewise "
+            "intact via restore or lineage re-execution, or fail with the "
+            "typed reconstruction error — never wrong bytes or a hang",
+            specs=[],
+            workload="spill",
+            steps=3,
+            nemesis=["kill_raylet"],
+            remote_node=True,
+            repair=True,
+            env=dict(_SPILL_ENV),
+            object_store_memory=_SPILL_ARENA,
+        ),
+        Scenario(
+            name="spill_kill_worker",
+            description="working set 4x the arena with a worker SIGKILLed "
+            "between steps: producers retry on fresh leases while the "
+            "pressure loop keeps spilling, and no acknowledged object is "
+            "lost or corrupted",
+            specs=[],
+            workload="spill",
+            steps=3,
+            nemesis=["kill_worker"],
+            remote_node=True,
+            env=dict(_SPILL_ENV),
+            object_store_memory=_SPILL_ARENA,
+        ),
+        Scenario(
             name="recovery_durable",
             description="hard-crash the GCS (no checkpoint, torn WAL tail) "
             "mid-workload; recovery truncates the torn frame, reloads every "
@@ -427,6 +495,10 @@ SUITES: Dict[str, List[str]] = {
     "serve": [
         "serve_replica_kill", "serve_deadline_storm", "serve_router_restart",
     ],
+    # Object plane under memory pressure: oversubscribed working sets with
+    # node/worker kills — the check_no_data_loss invariant suite (the
+    # chaos-spill CI job's 10-seed gate).
+    "spill": ["spill_kill_raylet", "spill_kill_worker"],
     # Simulated-cluster scheduler scenarios: no driver, hundreds of
     # in-process raylets (see _private/sim_cluster.py).
     "sched": ["sched_storm"],
@@ -438,6 +510,7 @@ SUITES: Dict[str, List[str]] = {
         "latency_storm", "latency_gcs_drop", "latency_gcs_restart",
         "serve_replica_kill", "serve_deadline_storm", "serve_router_restart",
         "kill_worker", "gcs_restart", "kill_raylet", "sched_storm",
+        "spill_kill_raylet", "spill_kill_worker",
         "recovery_durable", "recovery_durable_sim", "collective_rank_kill",
         "kill_gcs_host", "kill_gcs_host_sim",
     ],
@@ -461,6 +534,7 @@ class SeedResult:
     rerequested_streams: int = 0
     deadline_shed: int = 0
     deadline_enforced: int = 0
+    spilled_bytes: int = 0
 
     def to_wire(self) -> dict:
         return {
@@ -476,6 +550,7 @@ class SeedResult:
             "rerequested_streams": self.rerequested_streams,
             "deadline_shed": self.deadline_shed,
             "deadline_enforced": self.deadline_enforced,
+            "spilled_bytes": self.spilled_bytes,
         }
 
 
@@ -493,11 +568,16 @@ class _Session:
             os.environ[k] = v
         from ray_tpu.cluster_utils import Cluster
 
-        self.cluster = Cluster(
-            head_node_args={"num_cpus": 2, "num_tpus": 0}
-        )
+        head_args = {"num_cpus": 2, "num_tpus": 0}
+        if scenario.object_store_memory:
+            head_args["object_store_memory"] = scenario.object_store_memory
+        self.cluster = Cluster(head_node_args=head_args)
         if scenario.remote_node:
-            self.cluster.add_node(num_cpus=2, resources={"victim": 2})
+            self.cluster.add_node(
+                num_cpus=2,
+                resources={"victim": 2},
+                object_store_memory=scenario.object_store_memory,
+            )
         self.cluster.connect()
         import ray_tpu
         from ray_tpu._private import worker as worker_mod
@@ -508,6 +588,9 @@ class _Session:
         self.produce = ray_tpu.remote(
             max_retries=3, resources={"victim": 1} if scenario.remote_node else None
         )(_produce_blob)
+        self.produce_spill = ray_tpu.remote(
+            max_retries=3, resources={"victim": 1} if scenario.remote_node else None
+        )(_produce_spill_blob)
         self.serve = None
         self.serve_dep: Optional[str] = None
         if scenario.workload == "serve":
@@ -536,7 +619,11 @@ class _Session:
             "victim" in r.total.to_dict() for r in self.cluster.raylets.values()
         )
         if not have_victim:
-            self.cluster.add_node(num_cpus=2, resources={"victim": 2})
+            self.cluster.add_node(
+                num_cpus=2,
+                resources={"victim": 2},
+                object_store_memory=self.scenario.object_store_memory,
+            )
 
     def close(self) -> None:
         try:
@@ -571,6 +658,10 @@ def run_seed(session: _Session, scenario: Scenario, seed: int,
     nemesis = Nemesis(session.cluster)
     violations: List[str] = []
     probe_refs = []  # (ref, expected_digest)
+    # spill workload: every acknowledged object as (ref, digest, kind) — the
+    # check_no_data_loss invariant re-resolves all of them post-quiesce.
+    data_ledger = []
+    spill_seen = 0
 
     async def _install():
         # Start from a drained cluster (the previous seed's probe lease may
@@ -815,6 +906,49 @@ def run_seed(session: _Session, scenario: Scenario, seed: int,
                             f"workload: step {step} returned {got}, "
                             f"expected {expect}"
                         )
+                elif scenario.workload == "spill":
+                    # One step's slice of a working set sized 4x the arena:
+                    # the puts that cannot fit force the pressure loop to
+                    # spill, and refs are held for the whole seed so nothing
+                    # is merely freed instead of spilled.
+                    arena = scenario.object_store_memory or _SPILL_ARENA
+                    per_step = max(
+                        1, (4 * arena) // SPILL_BLOB_SIZE // scenario.steps
+                    )
+                    tags = [
+                        (scenario.name, seed, step, i)
+                        for i in range(per_step)
+                    ]
+                    refs = [session.produce_spill.remote(t) for t in tags]
+                    ready, not_ready = session.ray.wait(
+                        refs, num_returns=len(refs), timeout=180
+                    )
+                    if not_ready:
+                        violations.append(
+                            f"workload: step {step}: {len(not_ready)}/"
+                            f"{len(refs)} produces never acknowledged"
+                        )
+                    acked = {r.hex() for r in ready}
+                    for r, t in zip(refs, tags):
+                        if r.hex() in acked:
+                            data_ledger.append(
+                                (r, _spill_digest(t), "task-return")
+                            )
+                    put_tag = ("put", scenario.name, seed, step)
+                    put_ref = session.ray.put(_produce_spill_blob(put_tag))
+                    data_ledger.append((put_ref, _spill_digest(put_tag), "put"))
+                    # Spot-check one transfer now; the full ledger is
+                    # re-resolved by check_no_data_loss after convergence.
+                    data = session.ray.get(refs[0], timeout=120)
+                    if hashlib.sha256(data).hexdigest() != _spill_digest(tags[0]):
+                        violations.append(
+                            f"workload: step {step} spilled transfer corrupt"
+                        )
+                    del data
+                    spill_seen = max(spill_seen, sum(
+                        r.spilled_bytes
+                        for r in session.cluster.raylets.values()
+                    ))
                 else:  # transfer
                     tag = (scenario.name, seed, step)
                     ref = session.produce.remote(tag)
@@ -872,6 +1006,23 @@ def run_seed(session: _Session, scenario: Scenario, seed: int,
                 f"probe: owned object not reconstructable: "
                 f"{type(e).__name__}: {e}"
             )
+    # Probe (spill): the no-data-loss invariant — every acknowledged object
+    # still resolves to its exact bytes (restored from external storage or
+    # re-executed from lineage), or fails with the typed reconstruction
+    # error. And the pressure loop must actually have spilled along the way,
+    # else the seed proved nothing about the spill path.
+    if scenario.workload == "spill":
+        if not spill_seen:
+            violations.append(
+                "workload: spill scenario never spilled (working set did "
+                "not pressure the arena)"
+            )
+        violations.extend(
+            str(v)
+            for v in invariants.check_no_data_loss(
+                session.ray, data_ledger, timeout_s=120.0
+            )
+        )
     # Probe 2: the cluster still runs fresh work.
     try:
         if session.ray.get(session.add.remote(seed, 1), timeout=60) != seed + 1:
@@ -933,6 +1084,7 @@ def run_seed(session: _Session, scenario: Scenario, seed: int,
         rerequested_streams=rereq,
         deadline_shed=rpc.deadline_stats.shed,
         deadline_enforced=rpc.deadline_stats.enforced,
+        spilled_bytes=spill_seen,
     )
 
 

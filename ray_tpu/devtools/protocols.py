@@ -182,7 +182,53 @@ LEASE_LEDGER = Machine(
     transitions=(("LIVE", "RELEASED", "raylet"),),
 )
 
-MACHINES: Tuple[Machine, ...] = (ACTOR, PLACEMENT_GROUP, NODE, LEASE_LEDGER)
+OBJECT = Machine(
+    name="object-location",
+    doc="Location FSM of one primary object copy "
+    "(ray_tpu/_private/raylet.py store side, "
+    "ray_tpu/_private/core_worker.py owner side; see docs/object_plane.md). "
+    "The raylet tracks the store-side states by set/dict membership "
+    "(`spilling`, `spilled`, `restoring`) rather than a `.state` field, so "
+    "the static extractor has no receivers to scan — this machine is "
+    "enforced behaviorally: the chaos `store-settled` invariant rejects "
+    "SPILLING/RESTORING after quiescence, and the spill suite's "
+    "no-data-loss invariant exercises every edge, including the lost-copy "
+    "paths. LOST is owner-observed (node-death pubsub or a failed "
+    "restore); RECONSTRUCTING re-runs the producing TaskSpec from lineage.",
+    classes=(),
+    variables=(),
+    subscript_vars=(),
+    files=(),
+    states=("LOCAL", "SPILLING", "SPILLED", "RESTORING", "LOST",
+            "RECONSTRUCTING"),
+    initial=("LOCAL",),
+    terminal=(),
+    quiescent=("LOCAL", "SPILLED", "LOST"),
+    transitions=(
+        ("LOCAL", "SPILLING", "raylet (pressure loop past "
+         "object_spilling_threshold)"),
+        ("SPILLING", "SPILLED", "raylet (external-storage write fsynced)"),
+        ("SPILLING", "LOCAL", "raylet (spill aborted: freed or pinned "
+         "mid-write)"),
+        ("SPILLED", "RESTORING", "raylet (ObjGet miss or owner-directed "
+         "RestoreSpilled)"),
+        ("RESTORING", "LOCAL", "raylet (restore sealed back into the arena)"),
+        ("RESTORING", "LOST", "raylet (SpillIntegrityError: torn file — "
+         "copy dropped)"),
+        ("LOCAL", "LOST", "owner (node-death pubsub: resident copy died)"),
+        ("SPILLED", "LOST", "owner (node-death pubsub: spill namespace died "
+         "with its node)"),
+        ("LOST", "RECONSTRUCTING", "owner (lineage recovery re-submits the "
+         "producing TaskSpec)"),
+        ("RECONSTRUCTING", "LOCAL", "owner (producer re-ran; value is back)"),
+        ("RECONSTRUCTING", "LOST", "owner (re-execution failed, depth cap, "
+         "or lineage pruned → typed ObjectReconstructionFailedError)"),
+    ),
+)
+
+MACHINES: Tuple[Machine, ...] = (
+    ACTOR, PLACEMENT_GROUP, NODE, LEASE_LEDGER, OBJECT
+)
 
 # Attribute name whose subscript assignment drives the lease ledger.
 _LEDGER_ATTR = "granted_lease_ids"

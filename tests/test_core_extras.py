@@ -282,6 +282,7 @@ def test_pull_manager_priority_and_quota():
         assert pm.stats() == {
             "bytes_in_flight": 0, "active_pulls": 0, "queued_pulls": 0,
             "stalled_streams": 0, "rerequested_streams": 0,
+            "restore_fallbacks": 0,
         }
 
     asyncio.run(scenario())

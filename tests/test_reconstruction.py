@@ -126,6 +126,124 @@ def test_put_objects_are_not_reconstructable(cluster_with_victim):
         ray_tpu.get(inner_ref, timeout=30)
 
 
+def test_lineage_pruned_raises_typed_error(monkeypatch):
+    """With lineage_bytes_limit squeezed to near zero, older producing specs
+    are LRU-pruned; losing such an object raises
+    ObjectReconstructionFailedError (typed: a tuning problem, not an
+    unreconstructable-by-design object)."""
+    from ray_tpu._private.common import config
+
+    monkeypatch.setenv("RAY_TPU_LINEAGE_BYTES_LIMIT", "1")
+    config.refresh()
+    cluster = Cluster(head_node_args={"num_cpus": 1, "num_tpus": 0})
+    try:
+        cluster.add_node(num_cpus=2, resources={"victim": 2})
+        cluster.connect()
+
+        @ray_tpu.remote(num_cpus=1, resources={"victim": 1}, max_retries=3)
+        def produce(i):
+            return np.full(SIZE, float(i))
+
+        first = produce.remote(1)
+        ready, _ = ray_tpu.wait([first], num_returns=1, timeout=60)
+        assert ready
+        # A second spilling-sized return prunes first's lineage (the cap
+        # keeps only the newest entry).
+        second = produce.remote(2)
+        ready, _ = ray_tpu.wait([second], num_returns=1, timeout=60)
+        assert ready
+
+        cluster.remove_node(cluster.raylets[_victim_node_id()])
+        cluster.add_node(num_cpus=2, resources={"victim": 2})
+        time.sleep(0.5)
+
+        with pytest.raises(ray_tpu.ObjectReconstructionFailedError):
+            ray_tpu.get(first, timeout=60)
+    finally:
+        cluster.shutdown()
+        monkeypatch.delenv("RAY_TPU_LINEAGE_BYTES_LIMIT")
+        config.refresh()
+
+
+def test_node_death_triggers_eager_reconstruction(cluster_with_victim):
+    """The owner's node-death subscription recomputes lost primaries without
+    waiting for a get: after the victim dies, the owned marker re-points at
+    a live raylet on its own."""
+    from ray_tpu._private import worker as worker_mod
+
+    cluster = cluster_with_victim
+
+    @ray_tpu.remote(num_cpus=1, resources={"victim": 1}, max_retries=3)
+    def produce():
+        return np.ones(SIZE)
+
+    ref = produce.remote()
+    ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=60)
+    assert ready
+    core = worker_mod.global_worker.core
+    dead_addr = core.memory_store.get(ref.hex()).plasma_addr
+    assert dead_addr is not None
+
+    cluster.remove_node(cluster.raylets[_victim_node_id()])
+    cluster.add_node(num_cpus=2, resources={"victim": 2})
+
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        entry = core.memory_store.get(ref.hex())
+        if entry is not None and entry.plasma_addr != dead_addr:
+            break
+        time.sleep(0.2)
+    entry = core.memory_store.get(ref.hex())
+    assert entry is not None and entry.plasma_addr != dead_addr, (
+        "node-death pubsub did not trigger eager reconstruction"
+    )
+    assert float(ray_tpu.get(ref, timeout=60).sum()) == 360000.0
+
+
+def test_torn_spill_file_reconstructs_via_lineage(shutdown_only, monkeypatch):
+    """A spilled copy whose backing file is torn is a *lost* copy, not a
+    transient error: restore fails with the typed integrity error, the
+    raylet drops the entry, and the owner's lineage re-runs the producer —
+    the consumer still sees correct bytes."""
+    import json
+
+    from ray_tpu._private import external_storage as es
+
+    torn = {"count": 0}
+
+    class TornFS(es.FileSystemStorage):
+        def restore(self, uri, dest):
+            if torn["count"] == 0:
+                torn["count"] += 1
+                raise es.SpillIntegrityError(uri, len(dest), len(dest) // 2)
+            return super().restore(uri, dest)
+
+    es.register_storage_backend(
+        "tornfs",
+        lambda params: TornFS(
+            params.get("directory_path", "/tmp/ray_tpu_tornfs_test")
+        ),
+    )
+    monkeypatch.setenv(
+        "RAY_TPU_OBJECT_SPILLING_CONFIG", json.dumps({"type": "tornfs"})
+    )
+    arena = 64 * 1024 * 1024
+    ray_tpu.init(num_cpus=2, num_tpus=0, object_store_memory=arena)
+
+    @ray_tpu.remote(max_retries=3)
+    def produce(i):
+        return np.full((1024, 1024), float(i))  # 8 MB each
+
+    refs = [produce.remote(i) for i in range(12)]  # 96 MB through 64 MB
+    ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=120)
+    assert len(ready) == len(refs)
+    # Every value comes back right even though one restore hit a torn file
+    # (that object's spilled copy was dropped and its producer re-ran).
+    for i, ref in enumerate(refs):
+        out = ray_tpu.get(ref, timeout=120)
+        assert out[0, 0] == float(i) and out[-1, -1] == float(i)
+
+
 def test_reconstruct_actor_task_return(cluster_with_victim):
     """Actor-task returns with max_task_retries>0 are reconstructable by
     resubmitting through the restarted actor (reference:

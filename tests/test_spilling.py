@@ -170,6 +170,116 @@ def test_pluggable_remote_spill_backend(shutdown_only, monkeypatch):
         assert ray_tpu.get(ref, timeout=60)[0] == i
 
 
+def _spill_files(spill_dir):
+    import glob
+
+    return [
+        f
+        for f in glob.glob(os.path.join(spill_dir, "**"), recursive=True)
+        if os.path.isfile(f) and not f.endswith(".tmp")
+    ]
+
+
+def test_spill_file_deleted_on_free(shutdown_only, monkeypatch, tmp_path):
+    """Regression for the spill-file leak: freeing a spilled object must
+    delete its backing file from external storage, not just the spilled[]
+    table entry (reference: local_object_manager.cc spilled-object deletion
+    on ref release)."""
+    import gc
+    import json
+    import time
+
+    spill_dir = str(tmp_path / "spill")
+    monkeypatch.setenv(
+        "RAY_TPU_OBJECT_SPILLING_CONFIG",
+        json.dumps(
+            {"type": "filesystem", "params": {"directory_path": spill_dir}}
+        ),
+    )
+    ray_tpu.init(num_cpus=2, num_tpus=0, object_store_memory=ARENA)
+    n = 2 * ARENA // OBJ
+    refs = [ray_tpu.put(np.full(OBJ // 8, i, dtype=np.float64)) for i in range(n)]
+    deadline = time.monotonic() + 30
+    while not _spill_files(spill_dir) and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert _spill_files(spill_dir), "pressure never spilled anything"
+    # Drop the only refs: the owner's free must reach the raylet and the
+    # raylet must unlink every spilled file, not only forget the URI.
+    del refs
+    gc.collect()
+    deadline = time.monotonic() + 30
+    while _spill_files(spill_dir) and time.monotonic() < deadline:
+        time.sleep(0.2)
+    leaked = _spill_files(spill_dir)
+    assert not leaked, f"freed objects leaked spill files: {leaked}"
+
+
+def test_spill_files_deleted_on_shutdown(shutdown_only, monkeypatch, tmp_path):
+    """Session teardown deletes every still-spilled object's backing file
+    (per-entry delete runs before the IO pool shuts down; destroy() then
+    removes the session subtree)."""
+    import json
+    import time
+
+    spill_dir = str(tmp_path / "spill")
+    monkeypatch.setenv(
+        "RAY_TPU_OBJECT_SPILLING_CONFIG",
+        json.dumps(
+            {"type": "filesystem", "params": {"directory_path": spill_dir}}
+        ),
+    )
+    ray_tpu.init(num_cpus=2, num_tpus=0, object_store_memory=ARENA)
+    n = 2 * ARENA // OBJ
+    refs = [ray_tpu.put(np.full(OBJ // 8, i, dtype=np.float64)) for i in range(n)]
+    deadline = time.monotonic() + 30
+    while not _spill_files(spill_dir) and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert _spill_files(spill_dir), "pressure never spilled anything"
+    assert refs  # keep the refs live until shutdown
+    ray_tpu.shutdown()
+    leaked = _spill_files(spill_dir)
+    assert not leaked, f"shutdown leaked spill files: {leaked}"
+
+
+def test_pinned_object_never_spills(small_store):
+    """A pinned object survives pressure that spills everything else, and
+    spilling it explicitly is rejected."""
+    import time
+
+    from ray_tpu._private import worker as worker_mod
+
+    pin_ref = ray_tpu.put(np.full(OBJ // 8, 7.0, dtype=np.float64))
+
+    async def _pin(oid):
+        core = worker_mod.global_worker.core
+        return await core.plasma.pin(oid)
+
+    assert worker_mod.global_worker.run_async(_pin(pin_ref.hex()), timeout=30)
+
+    refs = [ray_tpu.put(np.full(OBJ // 8, i, dtype=np.float64)) for i in range(12)]
+
+    async def _probe(oid):
+        core = worker_mod.global_worker.core
+        spill = await core.plasma.spill([oid])
+        contains = await core.plasma.contains([oid])
+        return spill, contains[oid]
+
+    deadline = time.monotonic() + 30
+    spilled_any = False
+    while time.monotonic() < deadline and not spilled_any:
+        stats = worker_mod.global_worker.run_async(_node_stats(), timeout=30)
+        spilled_any = any(s.get("spilled_objects", 0) > 0 for s in stats)
+        time.sleep(0.1)
+    assert spilled_any, "pressure never spilled anything"
+    spill_reply, in_arena = worker_mod.global_worker.run_async(
+        _probe(pin_ref.hex()), timeout=30
+    )
+    assert pin_ref.hex() in spill_reply["rejected"]
+    assert in_arena
+    assert ray_tpu.get(pin_ref, timeout=60)[0] == 7.0
+    assert ray_tpu.get(refs[0], timeout=60)[0] == 0.0
+
+
 def test_memory_monitor_kills_runaway_actor(shutdown_only, monkeypatch):
     """With no task workers leased, an actor worker is eligible (reference:
     group-by-owner policy kills actors as last resort — a runaway actor must

@@ -14,6 +14,8 @@ lint:
 	$(PYTHON) -m ray_tpu.devtools.lint
 	$(PYTHON) -m ray_tpu.devtools.rpc_flow --mutate back_call \
 		--expect-violation
+	$(PYTHON) -m ray_tpu.devtools.exc_flow --mutate swallow_cancel \
+		--expect-violation
 
 test:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow' \

@@ -681,7 +681,10 @@ class GcsServer:
             bisect.insort(self._util_sorted, (util, nid))
             self._node_utils[nid] = util
         if membership:
-            self.view_epoch += 1
+            # Monotonic broadcast version: a retried RegisterNode bumping it
+            # twice only costs one extra (idempotent) view broadcast —
+            # subscribers key on "newest epoch wins", gaps are meaningless.
+            self.view_epoch += 1  # exc-flow: disable=retry-unsafe-mutation
         batch_ms = config.scheduler_view_batch_ms
         if batch_ms <= 0:
             self._publish_view_head()
@@ -709,7 +712,9 @@ class GcsServer:
         plus the ``head`` least-utilized nodes in utilization order —
         everything the hybrid top-k pick and spillback targeting consume,
         sized O(head cap) regardless of cluster size."""
-        self.view_version += 1
+        # Monotonic, gap-tolerant (see view_epoch above): double-bump on a
+        # retried registration is benign.
+        self.view_version += 1  # exc-flow: disable=retry-unsafe-mutation
         self._publish_msg("syncer:nodes", self._view_head_msg())
 
     def _view_head_msg(self) -> dict:
@@ -899,7 +904,10 @@ class GcsServer:
             self._persist_named()
         self.actors[actor_id] = actor
         self._persist_actor(actor)
-        self._pending_actor_queue.append(actor_id)
+        # Keyed-guarded: a retried CreateActor returns from the idempotent
+        # upsert branch above (self.actors membership) before reaching this
+        # append, so the queue cannot double-enqueue.
+        self._pending_actor_queue.append(actor_id)  # exc-flow: disable=retry-unsafe-mutation
         self._wake_scheduler.set()
         if p.get("wait_alive", True):
             fut = asyncio.get_running_loop().create_future()
@@ -1083,7 +1091,10 @@ class GcsServer:
             )
             self._persist_actor(actor)
             self._publish_msg(f"actor:{actor.actor_id}", actor.to_wire())
-            self._pending_actor_queue.append(actor.actor_id)
+            # Keyed-guarded: a retried ReportWorkerDied sees the actor
+            # already RESTARTING (caller filters on ALIVE/PENDING_CREATION)
+            # and never re-enters this branch.
+            self._pending_actor_queue.append(actor.actor_id)  # exc-flow: disable=retry-unsafe-mutation
             self._wake_scheduler.set()
             self.events.emit(
                 "ACTOR_RESTARTING",
@@ -1110,6 +1121,13 @@ class GcsServer:
             cause=cause,
         )
         actor.death_cause = cause
+        # Write-through BEFORE acking waiters or publishing: a crash in the
+        # window would hand callers a DEAD outcome that a restarted GCS
+        # reloads as ALIVE/PENDING (exc_flow ack-before-persist).
+        if actor.name and self.named_actors.get((actor.namespace, actor.name)) == actor.actor_id:
+            del self.named_actors[(actor.namespace, actor.name)]
+            self._persist_named()
+        self._persist_actor(actor)
         for fut in actor.pending:
             if not fut.done():
                 if creation_failed:
@@ -1117,10 +1135,6 @@ class GcsServer:
                 else:
                     fut.set_result({"actor": actor.to_wire()})
         actor.pending.clear()
-        if actor.name and self.named_actors.get((actor.namespace, actor.name)) == actor.actor_id:
-            del self.named_actors[(actor.namespace, actor.name)]
-            self._persist_named()
-        self._persist_actor(actor)
         self._publish_msg(f"actor:{actor.actor_id}", actor.to_wire())
 
     async def _report_worker_died(self, conn, p):
@@ -1653,7 +1667,10 @@ class GcsServer:
             return
         for span in tracing.span_flush_delta():
             span.setdefault("worker_id", "gcs")
-            self.spans.append(span)
+            # Observability ring, not control-plane state: span_flush_delta
+            # snapshots-and-resets, so a retried ListSpans drains an empty
+            # delta; worst case is a duplicated trace row.
+            self.spans.append(span)  # exc-flow: disable=retry-unsafe-mutation
 
     async def _list_spans(self, conn, p):
         """Server-side-filtered span read: the trace_id filter and limit

@@ -2358,7 +2358,10 @@ class Raylet:
                 await loop.run_in_executor(self._io_pool, self.storage.delete, uri)
                 return
             self.spilled[oid] = (uri, size, pinned)
-            self.spilled_bytes += size
+            # Counter rides the keyed self.spilled entry: the re-check above
+            # discards the duplicate copy when oid is already spilled, so a
+            # retried SpillObjects cannot double-count.
+            self.spilled_bytes += size  # exc-flow: disable=retry-unsafe-mutation
             self.store.free(oid)
             self.obj_last_access.pop(oid, None)
             self._tel_spilled_bytes.inc(size)
@@ -2458,7 +2461,9 @@ class Raylet:
                 time.monotonic() - t0, oid=oid[:16], size=size,
             )
             if self.spilled.pop(oid, None) is not None:
-                self.spilled_bytes -= size
+                # Guarded by the keyed pop: the second application sees no
+                # entry and skips the decrement.
+                self.spilled_bytes -= size  # exc-flow: disable=retry-unsafe-mutation
             # Fire-and-forget: the external copy's deletion must not hold the
             # RPC reply (or fail it after a successful restore).
             try:
@@ -2492,7 +2497,8 @@ class Raylet:
         entry = self.spilled.pop(oid, None)
         if entry is None:
             return
-        self.spilled_bytes -= entry[1]
+        # Guarded by the keyed pop above: idempotent under re-delivery.
+        self.spilled_bytes -= entry[1]  # exc-flow: disable=retry-unsafe-mutation
         uri = entry[0]
         try:
             self._io_pool.submit(self.storage.delete, uri)

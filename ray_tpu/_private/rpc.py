@@ -305,8 +305,14 @@ class StaleLeaderError(RpcError):
 # Error-reply payloads are ``f"{type(e).__name__}: {e}"`` plus traceback;
 # these prefixes re-type the caller-side exception so control flow (leader
 # fencing, deadline budgeting) doesn't have to string-match at every site.
+# Only RpcError subclasses belong here: callers' ``except RpcError`` blocks
+# must keep catching every wire-level failure. Schemas in wire.py declare
+# which of these (plus the RayTpuError family, which crosses inside reply
+# payloads, not error frames) each method's handler can raise — the
+# exc_flow lint pass keeps the declarations honest.
 _TYPED_ERRORS = {
     "StaleLeaderError:": StaleLeaderError,
+    "DeadlineExceeded:": DeadlineExceeded,
 }
 
 

@@ -64,6 +64,35 @@ _RETRY_CLASSES = (RETRY_SAFE, RETRY_DEDUP, RETRY_NONE)
 
 _BLOB_DIRECTIONS = (None, "push", "request", "reply")
 
+# The typed-error taxonomy ``errors=`` declarations draw from: the
+# RayTpuError family (common.py) plus the RpcError control errors that
+# cross the wire *re-typed* (rpc._TYPED_ERRORS prefixes the error-reply
+# string with the class name and the caller side reconstructs the class).
+# ``__post_init__`` rejects names outside this set so a typo'd declaration
+# fails at import, not at the first error. ``DeadlineExceeded`` and
+# ``ConnectionLost`` are ambient — the RPC machinery itself can produce
+# them for ANY deadlined/disconnected method — so schemas declare only the
+# errors their *handler logic* can raise; the exc_flow lint pass
+# cross-checks the declarations against each handler closure's actual
+# interprocedural escape set.
+KNOWN_ERRORS = frozenset(
+    {
+        "RayTpuError",
+        "TaskError",
+        "WorkerCrashedError",
+        "ActorDiedError",
+        "ActorUnavailableError",
+        "ObjectLostError",
+        "ObjectReconstructionFailedError",
+        "GetTimeoutError",
+        "TaskCancelledError",
+        "PlacementGroupError",
+        "CollectiveGroupDiedError",
+        "StaleLeaderError",
+        "DeadlineExceeded",
+    }
+)
+
 
 @dataclass(frozen=True)
 class WireSchema:
@@ -94,6 +123,11 @@ class WireSchema:
     dedup_key: Optional[str] = None
     blob: Optional[str] = None
     trace: Optional[bool] = None
+    # Typed errors the method's handler logic can raise across the wire
+    # (names from KNOWN_ERRORS). An escaping typed error NOT declared here
+    # reaches the caller as an untyped RpcError — the exc_flow lint rule
+    # ``error-wire-undeclared`` fails on the drift.
+    errors: Tuple[str, ...] = ()
 
     def __post_init__(self):
         if self.retry not in _RETRY_CLASSES:
@@ -102,6 +136,12 @@ class WireSchema:
             raise ValueError("RETRY_DEDUP requires a dedup_key")
         if self.blob not in _BLOB_DIRECTIONS:
             raise ValueError(f"unknown blob direction {self.blob!r}")
+        unknown = set(self.errors) - KNOWN_ERRORS
+        if unknown:
+            raise ValueError(
+                f"unknown error name(s) {sorted(unknown)} in errors= "
+                "declaration (KNOWN_ERRORS is the taxonomy)"
+            )
 
 
 def _s(
@@ -111,9 +151,16 @@ def _s(
     dedup_key: Optional[str] = None,
     blob: Optional[str] = None,
     trace: Optional[bool] = None,
+    errors: Iterable[str] = (),
 ) -> WireSchema:
     return WireSchema(
-        frozenset(required), frozenset(optional), retry, dedup_key, blob, trace
+        frozenset(required),
+        frozenset(optional),
+        retry,
+        dedup_key,
+        blob,
+        trace,
+        tuple(sorted(errors)),
     )
 
 
@@ -127,27 +174,36 @@ SCHEMAS: Dict[str, WireSchema] = {
     # "actors" is the hosting report ([{actor_id, worker_id}]) a raylet
     # attaches when re-registering with a restarted GCS: it confirms
     # restored-ALIVE actors without a per-actor probe storm.
+    #
+    # errors= declares the typed errors a handler can let escape as a typed
+    # error reply (exc_flow's error-wire-undeclared rule cross-checks the
+    # handlers). GCS methods with a durable write-through declare
+    # StaleLeaderError: the replicated store fences writes from a deposed
+    # leader (gcs_store.py), and callers dispatch on the typed re-raise to
+    # re-resolve the leader. Ambient machinery errors (ConnectionLost,
+    # deadline shedding) are not per-method facts and stay undeclared.
     "RegisterNode": _s(
         ["node_id", "addr", "resources"], ["labels", "actors"],
-        retry=RETRY_SAFE, trace=False,
+        retry=RETRY_SAFE, trace=False, errors=(),
     ),
     "UpdateResources": _s(
         ["node_id", "available"], ["total", "version"],
-        retry=RETRY_SAFE, trace=False,
+        retry=RETRY_SAFE, trace=False, errors=(),
     ),
     # Keyed upsert on actor_id: a retried CreateActor attaches to the
     # existing record instead of double-enqueueing (gcs.py _create_actor).
     "CreateActor": _s(
         ["spec"], ["wait_alive", "get_if_exists"], retry=RETRY_SAFE,
-        trace=False,
+        trace=False, errors=("StaleLeaderError",),
     ),
-    "GetActor": _s(["actor_id"], retry=RETRY_SAFE, trace=False),
+    "GetActor": _s(["actor_id"], retry=RETRY_SAFE, trace=False, errors=()),
     "ReportActorReady": _s(
         ["actor_id"], ["addr", "worker_id", "node_id", "error"],
-        retry=RETRY_SAFE, trace=False,
+        retry=RETRY_SAFE, trace=False, errors=("StaleLeaderError",),
     ),
     "ReportWorkerDied": _s(
-        ["actor_ids"], ["cause", "worker_id"], retry=RETRY_SAFE, trace=False
+        ["actor_ids"], ["cause", "worker_id"], retry=RETRY_SAFE, trace=False,
+        errors=("StaleLeaderError",),
     ),
     # Worker-subprocess deadline-enforcement deltas (snapshot-and-reset on
     # the worker side). Deltas are additive, so a blind retry after a lost
@@ -155,28 +211,34 @@ SCHEMAS: Dict[str, WireSchema] = {
     # into the worker's next flush.
     "ReportDeadlineStats": _s(
         ["worker_id", "met", "shed", "enforced", "overruns"],
-        retry=RETRY_NONE, trace=False,
+        retry=RETRY_NONE, trace=False, errors=(),
     ),
-    "KillActor": _s(["actor_id"], ["no_restart"], retry=RETRY_SAFE, trace=False),
+    "KillActor": _s(
+        ["actor_id"], ["no_restart"], retry=RETRY_SAFE, trace=False,
+        errors=("StaleLeaderError",),
+    ),
     # NB: a KVPut retry after a lost reply reports added=False on the
     # re-issue when overwrite=False — the effect is still exactly-once.
     "KVPut": _s(
-        ["key", "value"], ["ns", "overwrite"], retry=RETRY_SAFE, trace=False
+        ["key", "value"], ["ns", "overwrite"], retry=RETRY_SAFE, trace=False,
+        errors=("StaleLeaderError",),
     ),
-    "KVGet": _s(["key"], ["ns"], retry=RETRY_SAFE, trace=False),
-    "Subscribe": _s(["channel"], retry=RETRY_SAFE, trace=False),
-    "Unsubscribe": _s(["channel"], retry=RETRY_SAFE, trace=False),
+    "KVGet": _s(["key"], ["ns"], retry=RETRY_SAFE, trace=False, errors=()),
+    "Subscribe": _s(["channel"], retry=RETRY_SAFE, trace=False, errors=()),
+    "Unsubscribe": _s(["channel"], retry=RETRY_SAFE, trace=False, errors=()),
     # Pubsub is at-least-once: a retried Publish may deliver twice.
-    "Publish": _s(["channel", "msg"], retry=RETRY_SAFE, trace=False),
+    "Publish": _s(
+        ["channel", "msg"], retry=RETRY_SAFE, trace=False, errors=()
+    ),
     # Server->client pubsub delivery push; "seq" is the channel's monotonic
     # publish seqno (gap detection, pubsub.py).
-    "Pub": _s(["channel", "msg"], ["seq"], trace=False),
+    "Pub": _s(["channel", "msg"], ["seq"], trace=False, errors=()),
     # Per-tick coalesced fan-out: one frame carries every publish on one
     # channel from one flush tick as [channel, msg, seq] triples.
-    "PubBatch": _s(["items"], trace=False),
+    "PubBatch": _s(["items"], trace=False, errors=()),
     # Channel-state resync for a subscriber that detected a seq gap (its
     # backlog was shed, or it missed a window across a reconnect).
-    "Snapshot": _s(["channel"], retry=RETRY_SAFE, trace=False),
+    "Snapshot": _s(["channel"], retry=RETRY_SAFE, trace=False, errors=()),
     # -- raylet scheduling ---------------------------------------------------
     # Deduped by the raylet's granted-lease ledger (PR 2): a retried frame
     # with the same lease_id mirrors the original grant outcome.
@@ -187,56 +249,67 @@ SCHEMAS: Dict[str, WireSchema] = {
         retry=RETRY_DEDUP,
         dedup_key="lease_id",
         trace=True,
+        errors=(),
     ),
-    "CancelWorkerLease": _s(["lease_id"], retry=RETRY_SAFE, trace=False),
+    "CancelWorkerLease": _s(
+        ["lease_id"], retry=RETRY_SAFE, trace=False, errors=()
+    ),
     "ReturnWorker": _s(
         ["lease_id"], ["dirty"], retry=RETRY_DEDUP, dedup_key="lease_id",
-        trace=False,
+        trace=False, errors=(),
     ),
     # Deduped on spec.actor_id ("actor:<id>" lease ids) via the raylet's
     # actor_creations_in_flight set + grant ledger.
     "LeaseWorkerForActor": _s(
-        ["spec"], retry=RETRY_DEDUP, dedup_key="spec", trace=True
+        ["spec"], retry=RETRY_DEDUP, dedup_key="spec", trace=True, errors=()
     ),
     "KillWorker": _s(
-        ["worker_id"], ["probe", "force"], retry=RETRY_SAFE, trace=False
+        ["worker_id"], ["probe", "force"], retry=RETRY_SAFE, trace=False,
+        errors=(),
     ),
     # -- task dispatch (ordered streams: retries owned by the task layer) ----
-    "PushTask": _s(["spec"], trace=True),
-    "PushActorTask": _s(["spec"], trace=True),
+    # Task failures travel IN the reply payload ({"error": ...}), not as
+    # typed error replies — hence no errors= even though tasks fail freely.
+    "PushTask": _s(["spec"], trace=True, errors=()),
+    "PushActorTask": _s(["spec"], trace=True, errors=()),
     # -- object plane --------------------------------------------------------
     "ObjCreate": _s(
         ["oid", "size"], ["pin"], retry=RETRY_DEDUP, dedup_key="oid",
-        trace=True,
+        trace=True, errors=(),
     ),
-    "ObjSeal": _s(["oid"], retry=RETRY_SAFE, trace=True),
-    "WaitObject": _s(["oid"], ["timeout"], retry=RETRY_SAFE, trace=True),
+    "ObjSeal": _s(["oid"], retry=RETRY_SAFE, trace=True, errors=()),
+    "WaitObject": _s(
+        ["oid"], ["timeout"], retry=RETRY_SAFE, trace=True, errors=()
+    ),
     "PushStart": _s(
-        ["oid", "size"], retry=RETRY_DEDUP, dedup_key="oid", trace=True
+        ["oid", "size"], retry=RETRY_DEDUP, dedup_key="oid", trace=True,
+        errors=(),
     ),
     # Blob-sidecar data plane: the chunk bytes are NOT a payload key — they
     # follow the control frame on the stream. Blob calls are never
     # transparently retried (the sink may be a live arena span). PushChunk
     # requests ARE kind-4 blob frames, so they cannot carry trace context;
     # FetchChunk requests are plain control frames (only the reply blobs).
-    "PushChunk": _s(["oid", "offset"], blob="push", trace=False),
-    "FetchChunk": _s(["oid", "offset", "size"], blob="reply", trace=True),
+    "PushChunk": _s(["oid", "offset"], blob="push", trace=False, errors=()),
+    "FetchChunk": _s(
+        ["oid", "offset", "size"], blob="reply", trace=True, errors=()
+    ),
     # Spill directive: ask a raylet to move named sealed objects to external
     # storage now (owner-driven eviction / pressure tooling). Idempotent —
     # an already-spilled or ineligible oid is reported back, not an error.
-    "SpillObjects": _s(["oids"], retry=RETRY_SAFE, trace=False),
+    "SpillObjects": _s(["oids"], retry=RETRY_SAFE, trace=False, errors=()),
     # Owner/pull-directed restore: bring one spilled object back into the
     # arena. Restores coalesce on the raylet's restoring-future table, so
     # re-delivery after a lost reply is indistinguishable from one delivery.
     # On a consumer's critical path (pull fallback), hence traced.
-    "RestoreSpilled": _s(["oid"], retry=RETRY_SAFE, trace=True),
+    "RestoreSpilled": _s(["oid"], retry=RETRY_SAFE, trace=True, errors=()),
     # Primary-copy pin/unpin: a pinned object is never chosen by the spill
     # scheduler or LRU eviction. Keyed flag write — freely retried.
-    "PinObject": _s(["oid"], ["pin"], retry=RETRY_SAFE, trace=False),
+    "PinObject": _s(["oid"], ["pin"], retry=RETRY_SAFE, trace=False, errors=()),
     # -- ray-client plane ----------------------------------------------------
     # Small puts send "payload" inline; large puts ship the serialized
     # region as a kind-4 blob which the server reads back as "data".
-    "CPut": _s([], ["payload", "data"], blob="request", trace=False),
+    "CPut": _s([], ["payload", "data"], blob="request", trace=False, errors=()),
     # -- logs / observability ------------------------------------------------
     # Runtime-telemetry flush (telemetry.py flush_delta): counter/histogram
     # deltas plus drained flight-recorder events. Additive like
@@ -244,22 +317,24 @@ SCHEMAS: Dict[str, WireSchema] = {
     # undelivered payload is folded back locally and rides the next flush.
     "ReportTelemetry": _s(
         ["source", "node", "metrics"], ["events"], retry=RETRY_NONE,
-        trace=False,
+        trace=False, errors=(),
     ),
     # Read of the GCS telemetry aggregate (dashboard /metrics).
-    "GetTelemetry": _s([], retry=RETRY_SAFE, trace=False),
+    "GetTelemetry": _s([], retry=RETRY_SAFE, trace=False, errors=()),
     "GetLog": _s(
         [], ["filename", "worker_id", "stream", "tail"], retry=RETRY_SAFE,
-        trace=False,
+        trace=False, errors=(),
     ),
     # Runtime-span flush (tracing.span_flush_delta): same snapshot-and-reset
     # delta semantics as ReportTelemetry, same RETRY_NONE reasoning.
     "ReportSpans": _s(
-        ["source", "node", "spans"], retry=RETRY_NONE, trace=False
+        ["source", "node", "spans"], retry=RETRY_NONE, trace=False, errors=()
     ),
     # Server-side-filtered span read: trace_id narrows to one trace, limit
     # bounds the reply — the client never ships the whole span ring.
-    "ListSpans": _s([], ["trace_id", "limit"], retry=RETRY_SAFE, trace=False),
+    "ListSpans": _s(
+        [], ["trace_id", "limit"], retry=RETRY_SAFE, trace=False, errors=()
+    ),
 }
 
 

@@ -790,6 +790,11 @@ class Executor:
                     "node_id": self.core.node_id,
                 }
             )
+        except asyncio.CancelledError:
+            # Teardown cancellation is not a creation failure: unwind so the
+            # raylet's worker-death report drives the actor FSM instead of a
+            # bogus "creation failed" report pinning the actor DEAD.
+            raise
         except BaseException as e:
             logger.exception("actor creation failed")
             await self._report_actor_ready(
@@ -809,7 +814,11 @@ class Executor:
             try:
                 await self.core.gcs.call("ReportActorReady", payload)
                 return
-            except Exception:
+            # This bounded retry loop IS the StaleLeaderError handling: the
+            # gcs channel re-resolves the leader on reconnect, and after 5
+            # failures the worker exits so the raylet surfaces the failure —
+            # nothing is converted to silent success.
+            except Exception:  # exc-flow: disable=swallowed-control-error
                 logger.exception(
                     "ReportActorReady attempt %d/5 failed", attempt + 1
                 )
@@ -985,6 +994,13 @@ class Executor:
                     return {"dynamic_count": idx}
             returns = await self.store_returns(wire, result)
             return {"returns": returns}
+        except asyncio.CancelledError:
+            # Same contract as the plain-task path above: ray.cancel must
+            # cross the wire as typed TaskCancelledError, not as an opaque
+            # CancelledError string the caller cannot dispatch on.
+            from ray_tpu._private.common import TaskCancelledError
+
+            return {"error": self._error_payload(TaskCancelledError("task cancelled"))}
         except BaseException as e:  # noqa: BLE001
             if isinstance(e, SystemExit):
                 asyncio.get_running_loop().call_later(0.1, os._exit, 0)

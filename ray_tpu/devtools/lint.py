@@ -3,8 +3,11 @@
 Runs every static pass over the package and exits non-zero on any finding:
 the asyncio hazard linter (aio_lint), the RPC wire cross-checker
 (rpc_check), the whole-program blocking-graph pass (rpc_flow: distributed
-wait cycles, deadline propagation, task supervision), the paired-resource
-lifecycle pass (lifecycle), the protocol FSM checker (protocols), the
+wait cycles, deadline propagation, task supervision), the
+exception-propagation pass (exc_flow: wire error declarations, swallowed
+control errors, retry-unsafe mutations, ack-before-persist), the
+paired-resource lifecycle pass (lifecycle), the protocol FSM checker
+(protocols), the
 telemetry-registry pass (telemetry_lint, no ad-hoc stats dicts in runtime
 code), and the stale-suppression audit (a ``disable=``/``allow-`` comment
 that no longer masks any finding is itself a finding — dead waivers rot
@@ -29,6 +32,7 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ray_tpu.devtools import (
     aio_lint,
+    exc_flow,
     lifecycle,
     protocols,
     rpc_check,
@@ -37,7 +41,7 @@ from ray_tpu.devtools import (
 )
 
 _PASSES = (
-    "aio-lint + rpc-check + rpc-flow + lifecycle + protocols"
+    "aio-lint + rpc-check + rpc-flow + exc-flow + lifecycle + protocols"
     " + telemetry-lint + suppression-audit"
 )
 
@@ -72,6 +76,7 @@ def audit_suppressions(paths: List[str]) -> List[aio_lint.Finding]:
             + rpc_check.check(paths, apply_suppressions=False)
         ),
         "rpc-flow": rpc_flow.check(paths, apply_suppressions=False),
+        "exc-flow": exc_flow.check(paths, apply_suppressions=False),
         "lifecycle": lifecycle.lint_paths(paths, apply_suppressions=False),
         "protocol": protocols.check(paths, apply_suppressions=False),
         "telemetry": telemetry_lint.lint_paths(paths, apply_suppressions=False),
@@ -88,6 +93,7 @@ def audit_suppressions(paths: List[str]) -> List[aio_lint.Finding]:
     regexes = {
         "aio-lint": aio_lint._SUPPRESS_RE,
         "rpc-flow": rpc_flow._SUPPRESS_RE,
+        "exc-flow": exc_flow._SUPPRESS_RE,
         "lifecycle": lifecycle._SUPPRESS_RE,
         "protocol": protocols._SUPPRESS_RE,
         "telemetry": telemetry_lint._ALLOW_RE,
@@ -152,6 +158,7 @@ def run_timed(
         ("aio-lint", lambda: list(aio_lint.lint_paths(paths))),
         ("rpc-check", lambda: rpc_check.check(paths)),
         ("rpc-flow", lambda: rpc_flow.check(paths)),
+        ("exc-flow", lambda: exc_flow.check(paths)),
         ("lifecycle", lambda: lifecycle.lint_paths(paths)),
         ("protocols", lambda: protocols.check(paths)),
         ("telemetry-lint", lambda: telemetry_lint.lint_paths(paths)),

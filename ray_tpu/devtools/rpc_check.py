@@ -339,31 +339,105 @@ class _FileScanner(ast.NodeVisitor):
         self.generic_visit(node)
 
 
-def build_inventory(paths: List[str]) -> Inventory:
-    inv = Inventory()
+def _collect_files(paths: List[str]) -> List[str]:
     files: List[str] = []
     for path in paths:
         if os.path.isdir(path):
             files.extend(iter_py_files(path))
         else:
             files.append(path)
-    for f in files:
-        with open(f, "r", encoding="utf-8") as fh:
+    return files
+
+
+def _scan_tree(path: str, tree: ast.Module) -> Inventory:
+    """Per-file Inventory fragment (scanner pass + stray string literals)."""
+    frag = Inventory()
+    _FileScanner(path, frag).visit(tree)
+    reg_lines = {(r.path, r.line) for r in frag.regs}
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and (path, node.lineno) not in reg_lines
+        ):
+            frag.str_literals.add(node.value)
+    return frag
+
+
+# Per-file parse+scan cache shared by every pass that needs the wire
+# Inventory (rpc_check, rpc_flow, exc_flow): the unified lint gate runs
+# them in one process, and re-parsing the tree three times is the
+# difference between fitting the 120 s budget and not.  Keyed by
+# (mtime_ns, size) so an edited file re-scans; holds (tree, fragment).
+_FILE_CACHE: Dict[
+    str, Tuple[int, int, Optional[ast.Module], Optional[Inventory]]
+] = {}
+
+
+def _scan_file(path: str) -> Tuple[Optional[ast.Module], Optional[Inventory]]:
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None, None
+    sig = (st.st_mtime_ns, st.st_size)
+    ent = _FILE_CACHE.get(path)
+    if ent is not None and (ent[0], ent[1]) == sig:
+        return ent[2], ent[3]
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
             src = fh.read()
+        tree: Optional[ast.Module] = ast.parse(src, filename=path)
+    except (OSError, SyntaxError):
+        tree = None
+    frag = _scan_tree(path, tree) if tree is not None else None
+    _FILE_CACHE[path] = (sig[0], sig[1], tree, frag)
+    return tree, frag
+
+
+def cached_tree(path: str) -> Optional[ast.Module]:
+    """Parsed AST for a file, via the shared per-file cache."""
+    return _scan_file(path)[0]
+
+
+def _merge_inventories(
+    fragments: List[Inventory],
+    extra_sources: Optional[List[Tuple[str, str]]] = None,
+) -> Inventory:
+    """Merge fragments (plus ad-hoc virtual sources, e.g. mutation
+    overlays) into one fresh Inventory — cached fragments are never
+    mutated."""
+    import textwrap as _textwrap
+
+    inv = Inventory()
+    frags = list(fragments)
+    for vpath, vsrc in extra_sources or ():
         try:
-            tree = ast.parse(src, filename=f)
+            vtree = ast.parse(_textwrap.dedent(vsrc), filename=vpath)
         except SyntaxError:
             continue
-        _FileScanner(f, inv).visit(tree)
-        reg_lines = {(r.path, r.line) for r in inv.regs}
-        for node in ast.walk(tree):
-            if (
-                isinstance(node, ast.Constant)
-                and isinstance(node.value, str)
-                and (f, node.lineno) not in reg_lines
-            ):
-                inv.str_literals.add(node.value)
+        frags.append(_scan_tree(vpath, vtree))
+    for frag in frags:
+        inv.calls.extend(frag.calls)
+        inv.regs.extend(frag.regs)
+        inv.handler_keys.update(frag.handler_keys)
+        inv.str_literals |= frag.str_literals
+        inv.wait_for_literals.extend(frag.wait_for_literals)
+        inv.timeout_s_literals.extend(frag.timeout_s_literals)
     return inv
+
+
+def cached_inventory(paths: List[str]) -> Inventory:
+    """Tree-wide Inventory assembled from the per-file cache."""
+    frags = []
+    for f in _collect_files(paths):
+        frag = _scan_file(f)[1]
+        if frag is not None:
+            frags.append(frag)
+    return _merge_inventories(frags)
+
+
+def build_inventory(paths: List[str]) -> Inventory:
+    return cached_inventory(paths)
 
 
 def _rpc_module_path() -> str:
@@ -673,9 +747,15 @@ def markdown_table(paths: Optional[List[str]] = None) -> str:
         "populated exactly when the caller is itself deadlined; `mixed",
         "(...)` = some sites pin a budget, others fold ambient; `never` =",
         "no site ever sends a TTL (fire-and-forget or callback vias).",
+        "Errors is the schema's `errors=` declaration: the typed errors the",
+        "handler can let escape as a typed error reply (reconstructed",
+        "caller-side by `rpc._typed_error`; `exc_flow`'s",
+        "error-wire-undeclared rule cross-checks handlers against it).",
+        "Ambient machinery errors — ConnectionLost, deadline shedding — are",
+        "channel facts, not per-method declarations.",
         "",
-        "| Method | Schema | Retry | Blob | Trace | Deadline | Servers (handler) | Client call sites | Payload keys |",
-        "|---|---|---|---|---|---|---|---|---|",
+        "| Method | Schema | Retry | Blob | Trace | Deadline | Errors | Servers (handler) | Client call sites | Payload keys |",
+        "|---|---|---|---|---|---|---|---|---|---|",
     ]
     for method in sorted(by_method):
         info = by_method[method]
@@ -703,8 +783,9 @@ def markdown_table(paths: Optional[List[str]] = None) -> str:
                 retry = schema.retry
             blob = schema.blob or "—"
             trace = "✓" if schema.trace else "—"
+            errors = ", ".join(f"`{e}`" for e in schema.errors) or "—"
         else:
-            keys, star, retry, blob, trace = "", "", "", "", ""
+            keys, star, retry, blob, trace, errors = "", "", "", "", "", ""
         maybe, guaranteed, srcs = rpc_flow.deadline_sources(flow, method)
         shown = ", ".join(f"`{s}`" for s in srcs[:3])
         if len(srcs) > 3:
@@ -721,7 +802,7 @@ def markdown_table(paths: Optional[List[str]] = None) -> str:
             deadline = "never"
         lines.append(
             f"| `{method}` | {star} | {retry} | {blob} | {trace} | "
-            f"{deadline} | {servers} | {callers} | {keys} |"
+            f"{deadline} | {errors} | {servers} | {callers} | {keys} |"
         )
     lines.append("")
     lines.append(

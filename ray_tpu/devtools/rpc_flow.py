@@ -508,24 +508,25 @@ def build(
     extra_sources: Optional[Sequence[Tuple[str, str]]] = None,
 ) -> Analysis:
     paths = list(paths or [_default_root()])
-    sources: List[Tuple[str, str]] = []
-    for f in _collect_files(paths):
-        try:
-            with open(f, "r", encoding="utf-8") as fh:
-                sources.append((f, fh.read()))
-        except OSError:
-            continue
-    sources.extend(extra_sources or [])
-
-    inv = rpc_check.Inventory()
+    # Share rpc_check's per-file parse+scan cache: the lint gate runs
+    # rpc_check, rpc_flow, and exc_flow in one process over the same tree.
+    frags: List[rpc_check.Inventory] = []
     scans: Dict[str, _ModuleScan] = {}
-    for path, src in sources:
+    for f in _collect_files(paths):
+        tree, frag = rpc_check._scan_file(f)
+        if tree is None:
+            continue
+        if frag is not None:
+            frags.append(frag)
+        scans[f] = _ModuleScan(f, tree)
+    for vpath, vsrc in extra_sources or ():
         try:
-            tree = ast.parse(src, filename=path)
+            vtree = ast.parse(vsrc, filename=vpath)
         except SyntaxError:
             continue
-        rpc_check._FileScanner(path, inv).visit(tree)
-        scans[path] = _ModuleScan(path, tree)
+        frags.append(rpc_check._scan_tree(vpath, vtree))
+        scans[vpath] = _ModuleScan(vpath, vtree)
+    inv = rpc_check._merge_inventories(frags)
 
     analysis = Analysis()
     for scan in scans.values():

@@ -90,6 +90,8 @@ explore:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m ray_tpu.devtools.explore \
 		--scenario ha_promotion --budget $(HA_EXPLORE_BUDGET)
 	env JAX_PLATFORMS=cpu $(PYTHON) -m ray_tpu.devtools.explore \
+		--scenario quorum_election --budget 4000 --check-determinism
+	env JAX_PLATFORMS=cpu $(PYTHON) -m ray_tpu.devtools.explore \
 		--scenario resubscribe_gap --budget 3000 --allow-bounded
 	env JAX_PLATFORMS=cpu $(PYTHON) -m ray_tpu.devtools.explore \
 		--scenario lease_exactly_once --mutate double_grant \

@@ -228,11 +228,21 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     # table state is rewritten as one frame and the log truncated).
     "gcs_wal_compact_bytes": 4 * 1024 * 1024,
     # ---- HA control plane (gcs_ha.py, docs/fault_tolerance.md §HA). ----
-    # Follower count for gcs_persist_backend=replicated: every ack'd write
-    # is appended to the primary log AND this many follower logs before the
-    # caller's put() resolves (synchronous log shipping; machine loss of
-    # the primary leaves a complete copy on each follower).
-    "gcs_replication_followers": 1,
+    # Follower count for gcs_persist_backend=replicated. The group (primary
+    # + followers) acks a group commit once a majority of members —
+    # ⌈(n+1)/2⌉, the primary's own append included — holds it durably;
+    # laggard members catch up asynchronously (per-member lag is exported
+    # as gcs_replica_lag_seq). Default 2 → a 3-member group that tolerates
+    # one slow/partitioned/lost member without stalling commits. With 1
+    # follower the quorum is 2-of-2, i.e. the original synchronous
+    # wait-for-all shipping.
+    "gcs_replication_followers": 2,
+    # How the warm standby receives the shipped stream (gcs_ha.py):
+    # "rpc" — subscribe to the leader over ShipFrames/ShipSnapshot wire
+    # RPCs (works across OS processes/hosts; falls back to file tailing
+    # while the leader is unreachable); "file" — tail a follower log on
+    # shared storage (original in-process mode).
+    "gcs_standby_mode": "rpc",
     # Leadership lease duration. The leader re-asserts its leadership
     # record every lease/3; a standby promotes when the record's deadline
     # is this far in the past (plus one grace interval to absorb clock

@@ -270,10 +270,15 @@ class GcsServer:
             term=term,
             on_fenced=self._on_store_fenced,
         )
+        # Cross-process standbys subscribed to the quorum-acked commit
+        # stream (ShipSubscribe); each push mirrors the raw WAL frames of
+        # one group commit (gcs_ha.GcsStandby rpc mode).
+        self._ship_subs: set = set()
         if isinstance(self.store, ReplicatedStoreClient):
             if term is None:
                 self.store.set_term(self.store.term + 1)
             self.leader_term = self.store.term
+            self.store.ship_listener = self._on_ship_commit
         self._load_from_store()
         self._register_handlers()
 
@@ -651,6 +656,44 @@ class GcsServer:
         s.register("ListSpans", self._list_spans)
         s.register("GetClusterStatus", self._cluster_status)
         s.register("Ping", self._ping)
+        s.register("ShipSubscribe", self._ship_subscribe)
+        s.register("ShipSnapshot", self._ship_snapshot)
+
+    # -- HA replication stream (cross-process standby feed) ------------------
+
+    async def _ship_subscribe(self, conn: rpc.Connection, p: dict) -> dict:
+        """Subscribe a cross-process standby to the quorum-acked commit
+        stream; every subsequent group commit is pushed as one ShipFrames
+        frame. The reply's watermark tells the standby where the pushes
+        start — it bootstraps the gap before it with ShipSnapshot."""
+        from ray_tpu._private.gcs_store import ReplicatedStoreClient
+
+        if not isinstance(self.store, ReplicatedStoreClient):
+            return {"ok": False, "term": 0, "seq": 0}
+        self._ship_subs.add(conn)
+        return {"ok": True, "term": self.store.term, "seq": self.store.seq}
+
+    async def _ship_snapshot(self, conn: rpc.Connection, p: dict) -> dict:
+        from ray_tpu._private.gcs_store import ReplicatedStoreClient
+
+        if not isinstance(self.store, ReplicatedStoreClient):
+            return {"ok": False, "term": 0, "seq": 0, "snap": b""}
+        snap, term, seq = self.store.snapshot_tables()
+        return {"ok": True, "term": term, "seq": seq, "snap": snap}
+
+    def _on_ship_commit(self, frames: bytes, term: int, seq: int, prev_seq: int) -> None:
+        """store.ship_listener: fan one quorum-acked group commit out to
+        subscribed standbys. Runs on the GCS loop (the flush is scheduled
+        with call_soon), so push_nowait is safe; a dead subscriber is
+        dropped by the disconnect callback."""
+        if not self._ship_subs:
+            return
+        payload = {"frames": frames, "term": term, "seq": seq, "prev_seq": prev_seq}
+        for conn in list(self._ship_subs):
+            try:
+                conn.push_nowait("ShipFrames", payload)
+            except rpc.ConnectionLost:
+                self._ship_subs.discard(conn)
 
     # -- nodes --------------------------------------------------------------
 
@@ -817,6 +860,7 @@ class GcsServer:
         return {"ok": True}
 
     def _on_disconnect(self, conn: rpc.Connection) -> None:
+        self._ship_subs.discard(conn)
         if self._stopping:
             return
         node_id = conn.context.get("node_id")

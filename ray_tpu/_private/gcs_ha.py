@@ -11,14 +11,26 @@ plus its "who is leader" coordination, in miniature):
   the fencing primitive: it carries the writer's term, so a deposed
   leader's renewal bounces off the store's fence with StaleLeaderError
   and the GCS demotes (stops serving) instead of split-braining.
-- **Warm standby** (``GcsStandby``): tails a follower log from disk
-  (ReplicaTailer — the cross-process analog of a follower applying its
-  shipped stream), watches the leadership record, and when the lease
-  deadline expires unrenewed, promotes: builds a ``GcsServer`` over the
-  replicated store at ``term + 1``. Opening the store at the new term
-  raises the fence on every member before the first write, and the new
-  server's fresh publisher epoch + term-stamped records drive every
-  resubscribing client through a snapshot pull (docs/fault_tolerance.md).
+- **Warm standby** (``GcsStandby``): mirrors the leader's quorum-acked
+  commit stream, watches the leadership record, and when the lease
+  deadline expires unrenewed, promotes: claims the next term
+  (gcs_store.try_claim_term — losers re-enter the watch loop) and builds
+  a ``GcsServer`` over the replicated store at that term. Opening the
+  store runs the quorum election: a majority of members must be
+  reachable, and the highest (term, seq) among them is adopted — any ack
+  quorum intersects any such majority, so every acknowledged record
+  survives even when the single freshest file sits on an unreachable
+  laggard. Opening also raises the fence on every reachable member
+  before the first write, and the new server's fresh publisher epoch +
+  term-stamped records drive every resubscribing client through a
+  snapshot pull (docs/fault_tolerance.md).
+
+  Two feed modes (``gcs_standby_mode``): ``"rpc"`` (default) subscribes
+  to the leader over ShipFrames/ShipSnapshot wire RPCs — the standby can
+  be its own OS process on another host (``python -m
+  ray_tpu._private.gcs_ha``) — and falls back to file tailing while the
+  leader is unreachable; ``"file"`` tails a follower log on shared
+  storage (ReplicaTailer).
 - **Leader pointer file**: ``<persist_path>.leader`` holds "host port",
   atomically replaced on every (re)election. ``file_resolver`` adapts it
   to RetryableConnection's pluggable resolver so raylets/workers re-dial
@@ -135,15 +147,54 @@ def file_resolver(path: Optional[str]):
 # -- warm standby ------------------------------------------------------------
 
 
-class GcsStandby:
-    """Warm-standby GCS: tails the replicated log and promotes itself when
-    the leader's lease expires unrenewed.
+class _ShipMirror:
+    """Standby-side state mirror fed by the leader's ShipFrames pushes:
+    the cross-process analog of a follower applying its received stream.
+    Same read interface as ReplicaTailer (``get``/``get_all``/``term``/
+    ``seq``) so read_leadership works on either feed."""
 
-    The standby holds the whole control-plane state as a live mirror (the
-    tailer applies every shipped frame as it lands), so promotion is
-    bounded by recovery *reconciliation* — requeueing in-flight actor/PG
-    placements — not by replaying history. ``on_promote(server)`` fires
-    after the new server is listening; ``promoted`` is set for waiters.
+    def __init__(self):
+        self.tables: dict = {}
+        self.term = 0
+        self.seq = 0
+
+    def apply_snapshot(self, snap: bytes, term: int, seq: int) -> None:
+        self.tables = {
+            t: dict(kv) for t, kv in msgpack.unpackb(snap, raw=False).items()
+        }
+        self.term = term
+        self.seq = seq
+
+    def apply_frames(self, data: bytes) -> None:
+        from ray_tpu._private.gcs_store import apply_replicated
+
+        self.tables, term, seq, _ = apply_replicated(self.tables, data)
+        self.term = max(self.term, term)
+        self.seq = max(self.seq, seq)
+
+    def get(self, table: str, key: str):
+        return self.tables.get(table, {}).get(key)
+
+    def get_all(self, table: str) -> dict:
+        return dict(self.tables.get(table, {}))
+
+
+class GcsStandby:
+    """Warm-standby GCS: mirrors the replicated log and promotes itself
+    when the leader's lease expires unrenewed.
+
+    The standby holds the whole control-plane state as a live mirror —
+    fed over ShipFrames/ShipSnapshot RPCs from the leader (``mode="rpc"``,
+    works across OS processes) or by tailing a follower log from shared
+    storage (``mode="file"``); rpc mode falls back to the file tailer
+    while the leader is unreachable. Promotion is therefore bounded by
+    recovery *reconciliation* — requeueing in-flight actor/PG placements —
+    not by replaying history. ``on_promote(server)`` fires after the new
+    server is listening; ``promoted`` is set for waiters.
+
+    Losing a promotion race (another standby claimed or fenced past us)
+    re-enters the watch loop at the new term — the standby pool survives
+    any number of consecutive failovers.
     """
 
     def __init__(
@@ -153,6 +204,7 @@ class GcsStandby:
         session_name: str = "",
         persist_path: Optional[str] = None,
         on_promote=None,
+        mode: Optional[str] = None,
     ):
         from ray_tpu._private.gcs_store import ReplicaTailer, follower_paths
 
@@ -162,12 +214,20 @@ class GcsStandby:
         self.port = port
         self.session_name = session_name
         self.persist_path = persist_path
+        self.mode = mode or config.gcs_standby_mode
         self.tailer = ReplicaTailer(follower_paths(persist_path)[0])
+        self.mirror = _ShipMirror()
+        # Stream-health counters (tests + debugging): frames/snapshots
+        # received over the RPC feed.
+        self.frames_received = 0
+        self.snapshots_pulled = 0
         self.server = None  # GcsServer once promoted
         self.promoted = asyncio.Event()
         self._on_promote = on_promote
         self._task: Optional[asyncio.Task] = None
         self._stopped = False
+        self._conn = None  # ShipFrames subscription to the leader
+        self._need_snapshot = False
 
     async def start(self) -> "GcsStandby":
         from ray_tpu._private import rpc
@@ -177,24 +237,107 @@ class GcsStandby:
         self._task = rpc.spawn(self._watch_loop())
         return self
 
+    # -- rpc feed ------------------------------------------------------------
+
+    async def _on_ship_frames(self, conn, p: dict) -> None:
+        """Client-side push handler: one quorum-acked group commit. A
+        watermark gap (we missed a window across a reconnect) flags a
+        snapshot re-pull instead of splicing a hole into the mirror."""
+        if p["prev_seq"] != self.mirror.seq:
+            self._need_snapshot = True
+            return
+        self.mirror.apply_frames(p["frames"])
+        self.frames_received += 1
+
+    async def _ensure_stream(self) -> bool:
+        """Dial the current leader (pointer file) and (re)subscribe;
+        returns True while the RPC feed is live. Any failure leaves the
+        file tailer as the feed for this poll round."""
+        from ray_tpu._private import rpc
+
+        if self._conn is not None and not self._conn.closed:
+            if self._need_snapshot:
+                await self._pull_snapshot(self._conn)
+            return True
+        self._conn = None
+        addr = resolve_leader_file(leader_file_path(self.persist_path))
+        if addr is None:
+            return False
+        try:
+            conn = await rpc.connect(
+                addr[0],
+                addr[1],
+                handlers={"ShipFrames": self._on_ship_frames},
+                retry=1,
+            )
+            sub = await conn.call(
+                "ShipSubscribe", {}, timeout=config.gcs_leader_lease_s
+            )
+            if not sub.get("ok"):
+                await conn.close()
+                return False
+            await self._pull_snapshot(conn)
+            self._conn = conn
+            return True
+        except (rpc.RpcError, OSError, asyncio.TimeoutError):
+            return False
+
+    async def _pull_snapshot(self, conn) -> None:
+        snap = await conn.call(
+            "ShipSnapshot", {}, timeout=config.gcs_leader_lease_s
+        )
+        if snap.get("ok"):
+            self.mirror.apply_snapshot(snap["snap"], snap["term"], snap["seq"])
+            self.snapshots_pulled += 1
+            self._need_snapshot = False
+
+    def _view(self, streaming: bool):
+        """The freshest feed for leadership-record reads this round."""
+        if streaming and self.mirror.seq >= self.tailer.seq:
+            return self.mirror
+        return self.tailer
+
+    # -- watch loop ----------------------------------------------------------
+
     async def _watch_loop(self) -> None:
+        from ray_tpu._private import rpc
+        from ray_tpu._private.gcs_store import try_claim_term
+
         grace = config.gcs_leader_lease_s / 3.0
         while not self._stopped:
             await asyncio.sleep(config.gcs_standby_poll_s)
-            self.tailer.poll()
-            rec = read_leadership(self.tailer)
+            streaming = False
+            if self.mode == "rpc":
+                try:
+                    streaming = await self._ensure_stream()
+                except rpc.ConnectionLost:
+                    streaming = False
+            if not streaming:
+                self.tailer.poll()
+            view = self._view(streaming)
+            rec = read_leadership(view)
             if rec is None:
                 continue  # no leader has ever asserted: nothing to succeed
             if time.time() <= rec["deadline"] + grace:
                 continue
+            # Election round: claim the next term atomically so racing
+            # standbys cannot both open the store at the same term. The
+            # loser re-enters the loop and sees either the winner's renewed
+            # lease or a later expiry at a higher term.
+            term = max(rec["term"], view.term) + 1
+            if not try_claim_term(self.persist_path, term):
+                continue
             try:
-                await self._promote(rec["term"] + 1)
+                await self._promote(term)
+                return
             except Exception:
-                # Lost the promotion race (another standby fenced past us)
-                # or the store is gone; either way this standby is done.
-                logger.exception("standby promotion at term %d failed",
-                                 rec["term"] + 1)
-            return
+                # Lost the race past the claim (fenced by a higher term) or
+                # a majority of members is unreachable (QuorumLostError):
+                # stay armed and re-enter the loop at the new term.
+                logger.exception(
+                    "standby promotion at term %d failed; re-arming", term
+                )
+                continue
 
     async def _promote(self, term: int) -> None:
         from ray_tpu._private.gcs import GcsServer
@@ -230,7 +373,61 @@ class GcsStandby:
             self._task.cancel()
             try:
                 await self._task
-            except (asyncio.CancelledError, Exception):
+            except asyncio.CancelledError:
                 pass
+        if self._conn is not None:
+            await self._conn.close()
+            self._conn = None
         if self.server is not None:
             await self.server.stop()
+
+
+# -- OS-process standby entrypoint -------------------------------------------
+#
+# Run a standby as its own process (its own host, in a real deployment):
+#
+#     python -m ray_tpu._private.gcs_ha --persist-path /path/to/gcs.db
+#
+# The process arms a GcsStandby (rpc mode by default: it dials the leader
+# from the pointer file and mirrors the quorum-acked stream), promotes on
+# lease expiry, then keeps serving as the leader until SIGTERM/SIGINT.
+
+
+def _main(argv=None) -> None:
+    import argparse
+    import signal
+
+    ap = argparse.ArgumentParser(
+        prog="python -m ray_tpu._private.gcs_ha",
+        description="Run a warm-standby GCS as its own OS process.",
+    )
+    ap.add_argument("--persist-path", required=True,
+                    help="replicated store path of the group to stand by for")
+    ap.add_argument("--session", default="standby")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--mode", choices=("rpc", "file"), default=None,
+                    help="stream feed (default: the gcs_standby_mode knob)")
+    args = ap.parse_args(argv)
+
+    async def _run() -> None:
+        standby = GcsStandby(
+            args.host,
+            args.port,
+            session_name=args.session,
+            persist_path=args.persist_path,
+            mode=args.mode,
+        )
+        await standby.start()
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        await standby.stop()
+
+    asyncio.run(_run())
+
+
+if __name__ == "__main__":
+    _main()

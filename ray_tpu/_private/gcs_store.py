@@ -436,10 +436,21 @@ class WalStoreClient(StoreClient):
 #
 # A replication *group* is one primary log plus N follower logs (default
 # paths ``<path>.follower<i>``), each modeling an independent store
-# process on another host. ``put``/``delete`` ack only after the frame is
-# appended to every member under the ``gcs_store_sync`` contract —
-# synchronous log shipping, so machine loss of the primary leaves a
-# complete acknowledged copy on each follower.
+# process on another host. A group commit acks once a *majority* of
+# members — ⌈(n+1)/2⌉, the leader's own append included — have the frame
+# durable under the ``gcs_store_sync`` contract. Laggards (a slow or
+# partitioned minority) catch up asynchronously: each follower has its own
+# serial ship lane, and a member whose applied ``seq`` fell behind the
+# stream receives the full state as one snapshot frame instead of the
+# incremental buffer. Losing or partitioning a minority therefore never
+# stalls the commit path; losing a majority demotes the leader (it fences
+# itself rather than acking writes no quorum holds).
+#
+# The election on open mirrors Raft's: it requires a *majority* of members
+# reachable and adopts the highest (term, seq) among them. Any ack quorum
+# intersects any election majority, so every acknowledged record is seen
+# by — and adopted into — the new leader's log, even when the single
+# freshest *file* belongs to an unreachable member.
 
 
 def _parse_replicated(data: bytes):
@@ -473,6 +484,38 @@ def _parse_replicated(data: bytes):
             tables.get(table, {}).pop(key, None)
         off += _HDR.size + blen
         good = off
+    return tables, term, seq, good
+
+
+def apply_replicated(tables: Dict[str, Dict[str, bytes]], data: bytes):
+    """Splice replicated frames over a live mirror — frame by frame so
+    deletes stay correct and a "snap" frame replaces the whole state.
+    Returns (tables, term, seq, good): the (possibly replaced) mirror
+    dict, the max term/seq seen, and how many bytes formed whole valid
+    frames (a torn tail stops the splice, as in _parse_replicated).
+    Shared by ReplicaTailer (file mode) and the RPC-fed standby mirror."""
+    term = 0
+    seq = 0
+    _, _, _, good = _parse_replicated(data)
+    off = 0
+    while off < good:
+        blen, _ = _HDR.unpack_from(data, off)
+        body = data[off + _HDR.size : off + _HDR.size + blen]
+        fields = msgpack.unpackb(body, raw=False)
+        op, table, key, value = fields[:4]
+        if len(fields) >= 6:
+            term = max(term, fields[4])
+            seq = max(seq, fields[5])
+        if op == "snap":
+            tables = {
+                t: dict(kv)
+                for t, kv in msgpack.unpackb(value, raw=False).items()
+            }
+        elif op == "put":
+            tables.setdefault(table, {})[key] = value
+        else:
+            tables.get(table, {}).pop(key, None)
+        off += _HDR.size + blen
     return tables, term, seq, good
 
 
@@ -513,7 +556,10 @@ class _ReplicaLog:
     def raise_fence(self, term: int) -> None:
         """Adopt ``term`` as the minimum acceptable leader term. Called on
         open/promotion so a new leader fences the old one before its
-        first write, not after."""
+        first write, not after. A partitioned member cannot receive the
+        fence — it is fenced on rejoin by the catch-up snapshot instead."""
+        if self.path in _PARTITIONED:
+            return
         with self._lock:
             if term > self.fence_term:
                 self.fence_term = term
@@ -524,6 +570,10 @@ class _ReplicaLog:
         ``seq``; reject stale terms with StaleLeaderError."""
         from ray_tpu._private.rpc import StaleLeaderError  # lazy: no cycle at import
 
+        if self.path in _PARTITIONED:
+            raise ReplicaUnreachableError(
+                f"replica {os.path.basename(self.path)} unreachable (partitioned)"
+            )
         with self._lock:
             if term < self.fence_term:
                 raise StaleLeaderError(
@@ -544,8 +594,23 @@ class _ReplicaLog:
     def reset_with(self, snap: bytes, term: int, seq: int, sync: str) -> None:
         """Replace the whole log with one snapshot frame (compaction, and
         catch-up of a stale member): temp file + atomic rename, same
-        crash-safety argument as WalStoreClient._compact."""
+        crash-safety argument as WalStoreClient._compact. Fenced exactly
+        like append: a deposed leader must not be able to "catch up" a
+        member that a newer term already fenced — that would replace the
+        new leader's state wholesale (split-brain through compaction)."""
+        from ray_tpu._private.rpc import StaleLeaderError  # lazy: no cycle at import
+
+        if self.path in _PARTITIONED:
+            raise ReplicaUnreachableError(
+                f"replica {os.path.basename(self.path)} unreachable (partitioned)"
+            )
         with self._lock:
+            if term < self.fence_term:
+                raise StaleLeaderError(
+                    f"catch-up snapshot from term {term} rejected by "
+                    f"replica {os.path.basename(self.path)} "
+                    f"(fence at term {self.fence_term})"
+                )
             tmp = self.path + ".compact"
             fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
             try:
@@ -566,6 +631,8 @@ class _ReplicaLog:
 
     def write_unsynced(self, buf: bytes) -> None:
         """crash() path: the buffered tail reaches the OS, no fsync."""
+        if self.path in _PARTITIONED:
+            return  # a dying leader cannot reach a partitioned member either
         with self._lock:
             try:
                 os.write(self._fd, buf)
@@ -643,26 +710,108 @@ def drop_host(path: str) -> list:
     return removed
 
 
+class ReplicaUnreachableError(OSError):
+    """A shipped append/snapshot could not be delivered because the member
+    host is network-partitioned from the leader (chaos/explorer fault).
+    Fail-fast and deterministic: the member votes nothing toward the ack
+    quorum and its lag grows until the partition heals."""
+
+
+class QuorumLostError(RuntimeError):
+    """Fewer than a majority of replication-group members are reachable:
+    no election may be held (an ack quorum might hide entirely inside the
+    unreachable set) and no leader may commit."""
+
+
+# Network-partition fault injection: a partitioned member host is
+# unreachable from everyone — appends, snapshot catch-up, and fence raises
+# all fail fast with ReplicaUnreachableError, and elections must not count
+# it toward the reachable majority. Keyed by abspath, like _REPLICAS.
+_PARTITIONED: set = set()
+
+
+def partition_host(path: str) -> str:
+    """Partition one member host away from the group (chaos nemesis /
+    explorer fault). Returns the normalized path for heal_host."""
+    path = os.path.abspath(path)
+    _PARTITIONED.add(path)
+    return path
+
+
+def heal_host(path: str) -> None:
+    _PARTITIONED.discard(os.path.abspath(path))
+
+
+def heal_all_partitions() -> None:
+    """Chaos per-seed hygiene: drop every injected partition."""
+    _PARTITIONED.clear()
+
+
+def partitioned_hosts() -> set:
+    return set(_PARTITIONED)
+
+
+# Election claim registry: standbys racing a promotion claim their target
+# term here atomically; only the highest claim proceeds to open the store.
+# In-process analog of a Raft RequestVote round — cross-process safety
+# still rests on the durable fence frames (an open at or below a durable
+# fence raises StaleLeaderError on the first write).
+_TERM_CLAIMS: Dict[str, int] = {}
+
+
+def try_claim_term(path: str, term: int) -> bool:
+    """Atomically claim leadership ``term`` for the group rooted at
+    ``path``. Returns False if an equal-or-higher claim exists (another
+    standby won this round — re-enter the watch loop at the new term)."""
+    path = os.path.abspath(path)
+    with _REGISTRY_LOCK:
+        if _TERM_CLAIMS.get(path, 0) >= term:
+            return False
+        _TERM_CLAIMS[path] = term
+        return True
+
+
 _TEL_REPL_LAG_S = telemetry.histogram(
     "gcs",
     "replication_lag_s",
     "follower ack latency per shipped group-commit",
     buckets=telemetry.LATENCY_BUCKETS_S,
 )
+_TEL_REPL_LAG_SEQ = telemetry.gauge(
+    "gcs",
+    "replica_lag_seq",
+    "per-member replication lag: leader seq minus the member's applied seq",
+)
+_TEL_QUORUM_SIZE = telemetry.gauge(
+    "gcs",
+    "quorum_size",
+    "ack quorum of the replication group: ⌈(members+1)/2⌉",
+)
+_TEL_QUORUM_WAIT_S = telemetry.histogram(
+    "gcs",
+    "commit_quorum_wait_s",
+    "group-commit wait from first member append to quorum ack",
+    buckets=telemetry.LATENCY_BUCKETS_S,
+)
 
 
 class ReplicatedStoreClient(StoreClient):
-    """WAL chained with synchronous log-shipping to follower members (see
-    the replicated-backend comment above). Keeps WalStoreClient's group
-    commit: mutations from one event-loop tick coalesce into one buffer
-    that is appended — and per ``gcs_store_sync`` fsynced — on *every*
-    member before the flush returns.
+    """WAL chained with majority-quorum log-shipping to follower members
+    (see the replicated-backend comment above). Keeps WalStoreClient's
+    group commit: mutations from one event-loop tick coalesce into one
+    buffer that is appended — and per ``gcs_store_sync`` fsynced — on a
+    *majority* of members (leader included) before the flush acks.
+    Laggard members catch up asynchronously on their own serial ship
+    lanes; a two-member group degenerates to wait-for-all (quorum 2 of 2),
+    preserving the original synchronous-shipping semantics.
 
     Leadership: the client carries the writer's ``term``. ``set_term``
-    raises the fence on every member (promotion); a put/delete under a
-    term older than any member's fence raises StaleLeaderError without
-    touching the mirror, and a fence raised mid-tick poisons the client
-    (``fenced``) so the deposed leader stops cleanly.
+    raises the fence on every reachable member (promotion); a put/delete
+    under a term older than any member's fence raises StaleLeaderError
+    without touching the mirror, and a fence raised mid-tick poisons the
+    client (``fenced``) so the deposed leader stops cleanly. Losing a
+    reachable majority mid-flight fences the client the same way — the
+    leader demotes rather than acking unreplicated writes.
     """
 
     def __init__(
@@ -686,42 +835,61 @@ class ReplicatedStoreClient(StoreClient):
         self._on_fenced = on_fenced
         self._pending: list = []
         self._flush_scheduled = False
-        # Optional crash-point probe: called after each successfully shipped
-        # group commit with (seq, n_ops). Fence aborts never ack, so never
-        # fire it (see devtools/explore.py crash enumeration).
+        # Optional crash-point probe: called after each quorum-acked group
+        # commit with (seq, n_ops). Fence aborts never ack, so never fire
+        # it (see devtools/explore.py crash enumeration).
         self.commit_listener = None
+        # Optional stream hook for the RPC-fed standby: called after each
+        # quorum ack with (frames, term, seq, prev_seq) — the raw shipped
+        # bytes plus the watermark they start after (gap detection).
+        self.ship_listener = None
         member_paths = [self._path] + [
             os.path.abspath(p)
             for p in (followers if followers is not None else follower_paths(path))
         ]
         self._members = [_open_replica(p) for p in member_paths]
-        # Follower shipping pool: one thread per follower so the member
-        # fsyncs overlap (os.fsync drops the GIL) — the ack still waits for
-        # every member, the wall cost is max(fsync) instead of sum(fsync).
-        self._ship_pool = (
+        self._quorum = len(self._members) // 2 + 1
+        # Per-follower serial ship lanes: one single-thread executor per
+        # follower so member fsyncs overlap (os.fsync drops the GIL) while
+        # each member still applies its stream in order — required now that
+        # a laggard's append may still be in flight when the next group
+        # commit acks on the quorum.
+        self._ship_lanes = [
             concurrent.futures.ThreadPoolExecutor(
-                max_workers=len(self._members) - 1,
-                thread_name_prefix="gcs-repl-ship",
+                max_workers=1, thread_name_prefix=f"gcs-repl-ship-{i}"
             )
-            if len(self._members) > 1
-            else None
-        )
-        # Adopt the freshest member: after machine loss of the primary the
-        # follower carries the acknowledged history, and a fresh primary
-        # file starts at (term 0, seq 0) and loses the election below.
-        states = []
-        for m in self._members:
+            for i in range(1, len(self._members))
+        ]
+        # Election (Raft-style): require a majority of members reachable,
+        # then adopt the highest (term, seq) among the *reachable* set.
+        # Any ack quorum intersects any reachable majority, so every
+        # acknowledged record is present in the adopted log — even when
+        # the single freshest file sits on a partitioned member. After
+        # machine loss of the primary a fresh primary file starts at
+        # (term 0, seq 0) and loses this election.
+        reachable = [
+            i for i, m in enumerate(self._members) if m.path not in _PARTITIONED
+        ]
+        if len(reachable) < self._quorum:
+            self.close()
+            raise QuorumLostError(
+                f"only {len(reachable)} of {len(self._members)} replication "
+                f"members reachable; need a majority of {self._quorum} to elect"
+            )
+        states = {}
+        for i in reachable:
+            m = self._members[i]
             data = b""
             if os.path.exists(m.path):
                 with open(m.path, "rb") as f:
                     data = f.read()
-            states.append(_parse_replicated(data))
-        best = max(range(len(states)), key=lambda i: (states[i][1], states[i][2]))
+            states[i] = _parse_replicated(data)
+        best = max(reachable, key=lambda i: (states[i][1], states[i][2]))
         tables, bterm, bseq, _ = states[best]
         self._tables = tables
         self._seq = bseq
         self._term = bterm if term is None else term
-        fence = max(m.fence_term for m in self._members)
+        fence = max(self._members[i].fence_term for i in reachable)
         if self._term < fence:
             from ray_tpu._private.rpc import StaleLeaderError
 
@@ -730,10 +898,12 @@ class ReplicatedStoreClient(StoreClient):
                 f"store opened at term {self._term} behind "
                 f"fence {fence}"
             )
-        # Catch-up: stale members (lost host replaced, follower behind)
-        # receive the full state as one snapshot frame, then ride the tail.
+        # Catch-up: stale reachable members (lost host replaced, follower
+        # behind) receive the full state as one snapshot frame, then ride
+        # the tail. Partitioned members catch up the same way when their
+        # lag is noticed after the partition heals.
         snap = None
-        for i, m in enumerate(self._members):
+        for i in reachable:
             if states[i][2] < bseq or states[i][1] < bterm:
                 if snap is None:
                     snap = _rframe(
@@ -741,9 +911,17 @@ class ReplicatedStoreClient(StoreClient):
                         msgpack.packb(self._tables, use_bin_type=True),
                         self._term, self._seq,
                     )
-                m.reset_with(snap, self._term, self._seq, self._sync)
-        for m in self._members:
-            m.raise_fence(self._term)
+                self._members[i].reset_with(snap, self._term, self._seq, self._sync)
+        for i in reachable:
+            self._members[i].raise_fence(self._term)
+        # Per-follower shipped watermark: the seq after the last frame
+        # SUBMITTED to the member's lane. Laggard detection keys off this,
+        # not the member's applied seq — an in-flight append on a lane is
+        # ordered, not behind, and must not trigger a snapshot re-ship.
+        # Partitioned members keep their stale applied seq here, so their
+        # first post-heal flush mismatches and ships the catch-up snapshot.
+        self._shipped = [m.seq for m in self._members[1:]]
+        _TEL_QUORUM_SIZE.default.set(self._quorum)
 
     @property
     def term(self) -> int:
@@ -753,13 +931,55 @@ class ReplicatedStoreClient(StoreClient):
     def seq(self) -> int:
         return self._seq
 
+    @property
+    def quorum(self) -> int:
+        """Ack quorum: ⌈(members+1)/2⌉, the leader's own append included."""
+        return self._quorum
+
+    def replica_lag(self) -> Dict[str, int]:
+        """Per-member replication lag in sequence numbers (leader seq minus
+        the member's applied seq). 0 = fully caught up; the leader's own
+        entry is always 0 by the time a commit acks."""
+        return {
+            os.path.basename(m.path): max(0, self._seq - m.seq)
+            for m in self._members
+        }
+
+    def wait_replication(self) -> None:
+        """Barrier: block until every in-flight follower ship (including
+        laggard catch-up) has drained. Test/scan hook — the commit path
+        never waits for more than the quorum."""
+        if self._closed:
+            return
+        futs = [lane.submit(lambda: None) for lane in self._ship_lanes]
+        for fut in futs:
+            fut.result()
+
+    def snapshot_tables(self):
+        """Full state as (packed_tables, term, seq) — the ShipSnapshot RPC
+        body for bootstrapping a cross-process standby mirror."""
+        with self._lock:
+            return (
+                msgpack.packb(self._tables, use_bin_type=True),
+                self._term,
+                self._seq,
+            )
+
     def set_term(self, term: int) -> None:
-        """Adopt a (higher) leadership term and fence every member at it:
-        the promoted standby's first store act, before any write."""
+        """Adopt a (higher) leadership term and fence every reachable
+        member at it: the promoted standby's first store act, before any
+        write. Partitioned members are fenced on rejoin by catch-up."""
         from ray_tpu._private.rpc import StaleLeaderError
 
         with self._lock:
-            fence = max(m.fence_term for m in self._members)
+            fence = max(
+                (
+                    m.fence_term
+                    for m in self._members
+                    if m.path not in _PARTITIONED
+                ),
+                default=0,
+            )
             if term < fence:
                 raise StaleLeaderError(
                     f"cannot adopt term {term} behind "
@@ -819,6 +1039,36 @@ class ReplicatedStoreClient(StoreClient):
             self._flush_scheduled = False
             self._flush()
 
+    def _ship_one(self, fi: int, m: "_ReplicaLog", buf, term, seq, prev_seq, snap) -> str:
+        """Deliver one group commit to one follower on its serial lane.
+        ``snap`` non-None means the member was behind the stream at submit
+        time: ship the full state at (term, seq) instead of the
+        incremental buffer (also how a healed partition rejoins — the
+        snapshot carries the fence bump). Any failure (and any append that
+        would land after a failed predecessor, leaving a gap in the
+        member's log) demotes the shipped watermark to -1 so the next
+        group commit re-ships the full state; the member never applies an
+        out-of-order frame, so it can at worst be stale, never torn."""
+        from ray_tpu._private.rpc import StaleLeaderError
+
+        try:
+            if snap is not None:
+                m.reset_with(snap, term, seq, self._sync)
+            else:
+                if m.seq != prev_seq:
+                    self._shipped[fi] = -1
+                    return "resync"
+                m.append(buf, term, seq, self._sync)
+            return "ok"
+        except ReplicaUnreachableError:
+            self._shipped[fi] = -1
+            return "unreachable"
+        except StaleLeaderError:
+            return "fenced"
+        except OSError:
+            self._shipped[fi] = -1
+            return "error"
+
     def _flush(self) -> None:  # caller holds _lock
         from ray_tpu._private.rpc import StaleLeaderError
 
@@ -828,41 +1078,103 @@ class ReplicatedStoreClient(StoreClient):
         n_ops = len(self._pending)
         buf = b"".join(self._pending)
         self._pending.clear()
+        prev_seq = self._seq - n_ops  # watermark the buffer starts after
         t0 = time.perf_counter()
+        # Leader's own append is the first quorum vote.
         try:
-            if self._ship_pool is not None:
-                futs = [
-                    self._ship_pool.submit(
-                        m.append, buf, self._term, self._seq, self._sync
-                    )
-                    for m in self._members[1:]
-                ]
-                self._members[0].append(buf, self._term, self._seq, self._sync)
-                for fut in futs:
-                    fut.result()
-            else:
-                for m in self._members:
-                    m.append(buf, self._term, self._seq, self._sync)
+            self._members[0].append(buf, self._term, self._seq, self._sync)
         except StaleLeaderError:
             # Fenced mid-tick: this tick's writes were never replicated and
             # the leadership that acknowledged them is over — the deposed
             # leader must stop serving, not limp on with a diverged mirror.
             self._mark_fenced()
             return
+        # Ship to each follower on its serial lane. A member whose shipped
+        # watermark is behind the stream (healed partition, failed ship,
+        # reset file) gets the full state as one snapshot frame instead —
+        # idempotent, and it truncates any unacked garbage the member may
+        # carry. In-flight lane work does NOT count as behind: the lane
+        # applies its stream in order.
+        snap = None
+        futs = []
+        for fi, m in enumerate(self._members[1:]):
+            if m.path in _PARTITIONED:
+                continue  # fail-fast: no vote, lag accrues until heal
+            this_snap = None
+            if self._shipped[fi] != prev_seq:
+                if snap is None:
+                    snap = _rframe(
+                        "snap", "", "",
+                        msgpack.packb(self._tables, use_bin_type=True),
+                        self._term, self._seq,
+                    )
+                this_snap = snap
+            self._shipped[fi] = self._seq
+            futs.append(
+                self._ship_lanes[fi].submit(
+                    self._ship_one, fi, m, buf, self._term, self._seq,
+                    prev_seq, this_snap,
+                )
+            )
+        # Quorum tally: ack as soon as a majority (leader included) holds
+        # the commit. Laggard futures keep running on their lanes; their
+        # lag is visible through replica_lag()/the replica_lag_seq gauge.
+        needed = self._quorum - 1
+        acks = 0
+        saw_fence = False
+        pending = set(futs)
+        while pending and acks < needed and not saw_fence:
+            done, pending = concurrent.futures.wait(
+                pending, return_when=concurrent.futures.FIRST_COMPLETED
+            )
+            for fut in done:
+                verdict = fut.result()
+                if verdict == "ok":
+                    acks += 1
+                elif verdict == "fenced":
+                    saw_fence = True
+        if acks < needed:
+            # No majority holds this commit: a newer leader fenced us, or
+            # a majority of members is gone/partitioned. Either way the
+            # leader demotes (fences itself) rather than acking writes no
+            # quorum can recover.
+            self._mark_fenced()
+            return
         dt = time.perf_counter() - t0
         _TEL_WRITE_S.default.observe(dt)
         _TEL_REPL_LAG_S.default.observe(dt)
+        _TEL_QUORUM_WAIT_S.default.observe(dt)
         _TEL_WAL_BYTES.default.inc(len(buf))
+        for m in self._members[1:]:
+            _TEL_REPL_LAG_SEQ.cell(member=os.path.basename(m.path)).set(
+                max(0, self._seq - m.seq)
+            )
         if self.commit_listener is not None:
             self.commit_listener(self._seq, n_ops)
+        if self.ship_listener is not None:
+            self.ship_listener(buf, self._term, self._seq, prev_seq)
         if self._compact_bytes and self._members[0].log_bytes > self._compact_bytes:
             snap = _rframe(
                 "snap", "", "",
                 msgpack.packb(self._tables, use_bin_type=True),
                 self._term, self._seq,
             )
-            for m in self._members:
-                m.reset_with(snap, self._term, self._seq, self._sync)
+            try:
+                self._members[0].reset_with(snap, self._term, self._seq, self._sync)
+            except StaleLeaderError:
+                # Fenced after the ack: the commit stands (a quorum holds
+                # it), but this leadership is over — demote, skip compaction.
+                self._mark_fenced()
+                return
+            # Follower resets ride their serial lanes so they cannot
+            # reorder against an in-flight laggard append.
+            for i, m in enumerate(self._members[1:]):
+                if m.path in _PARTITIONED:
+                    continue  # healed members catch up via the lag snapshot
+                self._ship_lanes[i].submit(
+                    self._ship_one, i, m, b"", self._term, self._seq,
+                    self._seq, snap,
+                )
             _TEL_WAL_COMPACTIONS.default.inc()
 
     # -- StoreClient API -----------------------------------------------------
@@ -915,21 +1227,23 @@ class ReplicatedStoreClient(StoreClient):
                 return
             self._flush()
             self._closed = True
-        if self._ship_pool is not None:
-            self._ship_pool.shutdown(wait=True)
+        for lane in self._ship_lanes:
+            lane.shutdown(wait=True)  # drain laggard catch-up before release
         for m in self._members:
             m._release()
 
     def crash(self) -> None:
-        """Process-death analog: the buffered tick reaches every member's
-        file (no fsync) — what a real leader that writes-before-acking
-        would have already shipped."""
+        """Process-death analog: the buffered tick reaches every reachable
+        member's file (no fsync) — what a real leader that writes-before-
+        acking would have already shipped."""
         with self._lock:
             if self._closed:
                 return
             buf = b"" if self.fenced else b"".join(self._pending)
             self._pending.clear()
             self._closed = True
+        for lane in self._ship_lanes:
+            lane.shutdown(wait=False, cancel_futures=True)
         if buf:
             for m in self._members:
                 m.write_unsynced(buf)
@@ -981,30 +1295,11 @@ class ReplicaTailer:
             data = f.read()
         if self._off == 0:
             self._head = data[:32]
-        _, _, _, good = _parse_replicated(data)
+        self.tables, term, seq, good = apply_replicated(self.tables, data)
         if good == 0:
             return 0
-        # _parse_replicated replays from scratch; splice its view over the
-        # running mirror frame by frame instead to keep deletes correct.
-        off = 0
-        while off + _HDR.size <= len(data) and off < good:
-            blen, _ = _HDR.unpack_from(data, off)
-            body = data[off + _HDR.size : off + _HDR.size + blen]
-            fields = msgpack.unpackb(body, raw=False)
-            op, table, key, value = fields[:4]
-            if len(fields) >= 6:
-                self.term = max(self.term, fields[4])
-                self.seq = max(self.seq, fields[5])
-            if op == "snap":
-                self.tables = {
-                    t: dict(kv)
-                    for t, kv in msgpack.unpackb(value, raw=False).items()
-                }
-            elif op == "put":
-                self.tables.setdefault(table, {})[key] = value
-            else:
-                self.tables.get(table, {}).pop(key, None)
-            off += _HDR.size + blen
+        self.term = max(self.term, term)
+        self.seq = max(self.seq, seq)
         self._off += good
         return good
 

@@ -163,6 +163,14 @@ class Node:
 
         await self.gcs_server.crash()
         drop_host(self.gcs_persist_path())
+        return await self.adopt_promoted_gcs(timeout)
+
+    async def adopt_promoted_gcs(self, timeout: float = 30.0) -> Tuple[str, int]:
+        """Wait for the armed standby to promote, adopt its server as this
+        node's GCS, and re-arm a fresh standby. Used after any leader loss
+        the standby must absorb — a killed host, or a leader that demoted
+        itself on losing its replication majority."""
+        assert self.gcs_standby is not None
         await asyncio.wait_for(self.gcs_standby.promoted.wait(), timeout)
         self.gcs_server = self.gcs_standby.server
         self.gcs_addr = self.gcs_server.server.address

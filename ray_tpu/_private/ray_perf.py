@@ -13,7 +13,7 @@ from __future__ import annotations
 import asyncio
 import json
 import time
-from typing import Dict
+from typing import Dict, Optional
 
 import ray_tpu
 
@@ -110,25 +110,40 @@ def _bench_sched() -> Dict[str, float]:
     }
 
 
-def _bench_gcs_persist(replicated: bool = False) -> float:
+def _bench_gcs_persist(
+    replicated: bool = False, followers: Optional[int] = None
+) -> float:
     """Write-through rate of the persistent store under group commit: each
     cycle issues N keyed puts inside one event-loop context and then runs
     the per-tick flush — one os.write + one fsync for the whole batch, the
     shape every GCS control-plane mutation pays (docs/fault_tolerance.md
     "Durability contract"). With ``replicated=True`` the same workload runs
-    through ReplicatedStoreClient — every flush is fsynced on the primary
-    AND synchronously shipped + fsynced on the follower member before the
-    tick's writes are acknowledged (the HA deployment's write path)."""
+    through ReplicatedStoreClient; ``followers=1`` pins the historical
+    wait-for-all 2-member shape (every flush fsyncs primary AND the single
+    follower before ack), while the default 2-follower group acks at the
+    majority (2 of 3) with the laggard catching up off the commit path —
+    the HA deployment's quorum write path."""
     import os
     import shutil
     import tempfile
 
-    from ray_tpu._private.gcs_store import ReplicatedStoreClient, WalStoreClient
+    from ray_tpu._private.gcs_store import (
+        ReplicatedStoreClient,
+        WalStoreClient,
+        follower_paths,
+    )
 
     d = tempfile.mkdtemp(prefix="perf_wal_")
     if replicated:
-        store = ReplicatedStoreClient(os.path.join(d, "gcs.wal"), term=1)
-        label = "gcs persist puts (replicated, 1 follower)"
+        path = os.path.join(d, "gcs.wal")
+        fols = follower_paths(path, followers) if followers else None
+        store = ReplicatedStoreClient(path, followers=fols, term=1)
+        label = (
+            f"gcs persist puts (replicated, {followers} follower)"
+            if followers
+            else f"gcs persist puts (quorum {store.quorum} of "
+            f"{len(store._members)})"
+        )
     else:
         store = WalStoreClient(os.path.join(d, "gcs.wal"))
         label = "gcs persist puts (wal group commit)"
@@ -752,6 +767,9 @@ def main(json_path: str = "") -> Dict[str, float]:
     results.update(_bench_sched())
     results["gcs_persist_puts_per_s"] = _bench_gcs_persist()
     results["gcs_persist_puts_per_s_replicated"] = _bench_gcs_persist(
+        replicated=True, followers=1
+    )
+    results["gcs_persist_puts_per_s_quorum"] = _bench_gcs_persist(
         replicated=True
     )
     results["gcs_failover_converge_s"] = _bench_gcs_failover()

@@ -222,6 +222,14 @@ class SimCluster:
 
         await self.gcs_server.crash()
         drop_host(self.persist_path)
+        return await self.adopt_promoted_gcs_async(timeout)
+
+    async def adopt_promoted_gcs_async(self, timeout: float = 30.0) -> bool:
+        """Wait for the armed standby to promote, adopt its server, and
+        re-arm. Shared tail of kill_gcs_host_async, also used standalone
+        when the leader demoted itself (lost its replication majority)."""
+        if self.gcs_standby is None:
+            return False
         await asyncio.wait_for(self.gcs_standby.promoted.wait(), timeout)
         self.gcs_server = self.gcs_standby.server
         self.gcs_addr = self.gcs_server.server.address

@@ -239,6 +239,18 @@ SCHEMAS: Dict[str, WireSchema] = {
     # Channel-state resync for a subscriber that detected a seq gap (its
     # backlog was shed, or it missed a window across a reconnect).
     "Snapshot": _s(["channel"], retry=RETRY_SAFE, trace=False, errors=()),
+    # -- HA replication stream (gcs_ha.py standby, docs/fault_tolerance) -----
+    # A cross-process standby subscribes to the leader's quorum-acked
+    # group-commit stream; the reply carries the (term, seq) watermark the
+    # pushes start after.
+    "ShipSubscribe": _s([], retry=RETRY_SAFE, trace=False, errors=()),
+    # Server->client push of one quorum-acked group commit: raw replicated
+    # WAL frames plus the watermark they start after ("prev_seq"; a gap
+    # means the standby missed a window and must re-pull ShipSnapshot).
+    "ShipFrames": _s(["frames", "term", "seq", "prev_seq"], trace=False, errors=()),
+    # Full-state bootstrap/resync of the standby mirror: packed tables at
+    # one (term, seq) watermark.
+    "ShipSnapshot": _s([], retry=RETRY_SAFE, trace=False, errors=()),
     # -- raylet scheduling ---------------------------------------------------
     # Deduped by the raylet's granted-lease ledger (PR 2): a retried frame
     # with the same lease_id mirrors the original grant outcome.

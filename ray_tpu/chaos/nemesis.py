@@ -19,7 +19,8 @@ logger = logging.getLogger(__name__)
 
 ACTIONS = (
     "kill_worker", "kill_replica", "kill_raylet", "restart_gcs", "crash_gcs",
-    "kill_collective_rank", "kill_gcs_host",
+    "kill_collective_rank", "kill_gcs_host", "partition_follower",
+    "heal_partition", "partition_majority",
 )
 
 # Actor-name prefix of Serve replica workers (ReplicaID.to_actor_name).
@@ -66,6 +67,12 @@ class Nemesis:
             return await self._crash_gcs()
         if action == "kill_gcs_host":
             return await self._kill_gcs_host()
+        if action == "partition_follower":
+            return self._partition_follower(pick)
+        if action == "heal_partition":
+            return self._heal_partition()
+        if action == "partition_majority":
+            return await self._partition_majority()
         raise ValueError(f"unknown nemesis action {action!r}")
 
     def _kill_worker(self, pick: int) -> Optional[str]:
@@ -321,3 +328,150 @@ class Nemesis:
             new.leader_term,
         )
         return f"kill_gcs_host term={new.leader_term}"
+
+    # -- replication-group partitions (docs/fault_tolerance.md §HA) ----------
+
+    def _gcs_persist_path(self) -> Optional[str]:
+        node = getattr(self.cluster, "head_node", None)
+        if node is not None and hasattr(node, "gcs_persist_path"):
+            return node.gcs_persist_path()
+        return getattr(self.cluster, "persist_path", None)
+
+    def _partition_follower(self, pick: int) -> Optional[str]:
+        """Partition one follower member away from the leader — a strict
+        minority of a ≥3-member group. The quorum-ack contract says this
+        must NOT stall or demote the leader: commits keep acking on the
+        remaining majority while the partitioned member's lag grows."""
+        import os
+
+        from ray_tpu._private.gcs_store import (
+            follower_paths, partition_host, partitioned_hosts,
+        )
+
+        gcs = self.cluster.gcs_server
+        path = self._gcs_persist_path()
+        if gcs is None or not path:
+            return None
+        followers = follower_paths(path)
+        # One partition at a time: this action models a minority fault, and
+        # stacking it must not silently become a majority partition.
+        if partitioned_hosts() or len(followers) < 2:
+            return None
+        target = followers[pick % len(followers)]
+        partition_host(target)
+        self.actions_fired.append("partition_follower")
+        logger.info("nemesis: partitioned follower %s", os.path.basename(target))
+        return f"partition_follower {os.path.basename(target)}"
+
+    def _heal_partition(self) -> Optional[str]:
+        """Heal every injected partition. Before healing, verify the
+        minority partition did not demote the leader — commits must have
+        kept flowing on the majority the whole time."""
+        from ray_tpu._private.gcs_store import heal_all_partitions, partitioned_hosts
+
+        if not partitioned_hosts():
+            return None
+        gcs = self.cluster.gcs_server
+        if gcs is not None and gcs.fenced:
+            self.state_loss.append(
+                "quorum: leader demoted under a minority partition "
+                "(commits must keep acking on the majority)"
+            )
+        heal_all_partitions()
+        self.actions_fired.append("heal_partition")
+        logger.info("nemesis: healed all partitions")
+        return "heal_partition"
+
+    async def _partition_majority(self) -> Optional[str]:
+        """Partition EVERY follower away from the leader: no write can
+        reach a majority, so the leader must demote itself (fence, typed
+        StaleLeaderError to clients) rather than ack unreplicated writes.
+        After healing, the standby promotes at a higher term and every
+        record acknowledged before the partition must survive."""
+        import asyncio
+
+        from ray_tpu._private.common import config
+        from ray_tpu._private.gcs_store import (
+            follower_paths, heal_all_partitions, partition_host,
+        )
+
+        gcs = self.cluster.gcs_server
+        path = self._gcs_persist_path()
+        if gcs is None or not path:
+            return None
+        node = getattr(self.cluster, "head_node", None)
+        has_standby = (
+            node is not None and getattr(node, "gcs_standby", None) is not None
+        ) or hasattr(self.cluster, "adopt_promoted_gcs_async")
+        if not has_standby:
+            return None
+        pre = {
+            "actors": set(gcs.actors),
+            "pgs": set(gcs.placement_groups),
+            "jobs": set(gcs.jobs),
+            "named": dict(gcs.named_actors),
+            "kv": dict(gcs.kv),
+        }
+        pre_term = gcs.leader_term
+        for f in follower_paths(path):
+            partition_host(f)
+        # The leader discovers the loss on its next group commit — at the
+        # latest the lease renewal, every lease/3. Wait for the demotion.
+        deadline = config.gcs_leader_lease_s * 4.0 + 5.0
+        waited = 0.0
+        while not gcs.fenced and waited < deadline:
+            await asyncio.sleep(0.05)
+            waited += 0.05
+        if not gcs.fenced:
+            heal_all_partitions()
+            self.state_loss.append(
+                "quorum: leader kept serving with every follower partitioned "
+                "(must demote rather than ack unreplicated writes)"
+            )
+            return None
+        heal_all_partitions()
+        # With the partition healed the standby promotes past the demoted
+        # leader; adopt the new server like kill_gcs_host does.
+        if node is not None and getattr(node, "gcs_standby", None) is not None:
+            await node.adopt_promoted_gcs()
+            self.cluster.gcs_server = node.gcs_server
+        else:
+            if not await self.cluster.adopt_promoted_gcs_async():
+                return None
+        new = self.cluster.gcs_server
+        if new.leader_term <= pre_term:
+            self.state_loss.append(
+                f"split-brain: promoted leader term {new.leader_term} did "
+                f"not advance past {pre_term} after majority partition"
+            )
+        post = {
+            "actors": set(new.actors),
+            "pgs": set(new.placement_groups),
+            "jobs": set(new.jobs),
+        }
+        for table in ("actors", "pgs", "jobs"):
+            lost = pre[table] - post[table]
+            if lost:
+                self.state_loss.append(
+                    f"state-loss: {len(lost)} {table} record(s) gone "
+                    f"after majority-partition failover (e.g. {sorted(lost)[:3]})"
+                )
+        for (ns, name), aid in pre["named"].items():
+            if new.named_actors.get((ns, name)) != aid:
+                self.state_loss.append(
+                    f"state-loss: named actor {ns}/{name} -> {aid[:8]} "
+                    "gone after majority-partition failover"
+                )
+        for key, value in pre["kv"].items():
+            if new.kv.get(key) != value:
+                self.state_loss.append(
+                    f"state-loss: kv {key} changed/gone after "
+                    "majority-partition failover"
+                )
+        self.actions_fired.append("partition_majority")
+        logger.info(
+            "nemesis: majority partition -> leader demoted, standby "
+            "promoted at term %d",
+            new.leader_term,
+        )
+        return f"partition_majority term={new.leader_term}"

@@ -458,6 +458,42 @@ SCENARIOS: Dict[str, Scenario] = {
             },
         ),
         Scenario(
+            name="partition_follower",
+            description="partition one follower of the 3-member replication "
+            "group mid-workload, then heal: commits must keep acking on the "
+            "remaining majority (the leader never stalls or demotes), and "
+            "the healed member catches back up via a snapshot frame with "
+            "zero acknowledged loss",
+            specs=[],
+            workload="tasks",
+            steps=4,
+            nemesis=["partition_follower", "heal_partition"],
+            env=dict(
+                _TASKS_ENV,
+                RAY_TPU_GCS_PERSIST_BACKEND="replicated",
+                RAY_TPU_GCS_LEADER_LEASE_S="1.0",
+                RAY_TPU_GCS_STANDBY_POLL_S="0.05",
+            ),
+        ),
+        Scenario(
+            name="partition_majority",
+            description="partition every follower away from the leader: the "
+            "next group commit cannot reach a majority, so the leader must "
+            "demote itself (typed StaleLeaderError, no unreplicated acks); "
+            "after the heal the standby promotes at a higher term and every "
+            "record acknowledged before the partition survives",
+            specs=[],
+            workload="tasks",
+            steps=4,
+            nemesis=["partition_majority"],
+            env=dict(
+                _TASKS_ENV,
+                RAY_TPU_GCS_PERSIST_BACKEND="replicated",
+                RAY_TPU_GCS_LEADER_LEASE_S="1.0",
+                RAY_TPU_GCS_STANDBY_POLL_S="0.05",
+            ),
+        ),
+        Scenario(
             name="sched_storm",
             description="120-node simulated cluster saturated with "
             "concurrent lease bursts; raylets killed mid-spillback-chain, "
@@ -485,8 +521,13 @@ SUITES: Dict[str, List[str]] = {
         "recovery_durable", "recovery_durable_sim",
         "kill_gcs_host", "kill_gcs_host_sim",
     ],
-    # HA failover only: the chaos-ha CI job's 10+-seed gate.
-    "ha": ["kill_gcs_host", "kill_gcs_host_sim"],
+    # HA failover + replication-group partitions: the chaos-ha CI job's
+    # 10+-seed gate (minority partition must not stall commits; majority
+    # partition must demote the leader, then fail over on heal).
+    "ha": [
+        "kill_gcs_host", "kill_gcs_host_sim",
+        "partition_follower", "partition_majority",
+    ],
     # Delay/drop-heavy schedules exercising the RPC resilience layer
     # (retryable channels, deadline propagation, GCS failover queueing).
     "latency": ["latency_storm", "latency_gcs_drop", "latency_gcs_restart"],
@@ -513,6 +554,7 @@ SUITES: Dict[str, List[str]] = {
         "spill_kill_raylet", "spill_kill_worker",
         "recovery_durable", "recovery_durable_sim", "collective_rank_kill",
         "kill_gcs_host", "kill_gcs_host_sim",
+        "partition_follower", "partition_majority",
     ],
 }
 
@@ -668,6 +710,11 @@ def run_seed(session: _Session, scenario: Scenario, seed: int,
         # still be warm): every seed then re-requests leases and re-transfers
         # objects, so its schedule actually sees traffic to fault.
         await invariants.quiesce(session.cluster, timeout=15.0)
+        # A previous seed's unhealed replication partition must not leak
+        # into this one (partition_* nemesis actions are module-global).
+        from ray_tpu._private.gcs_store import heal_all_partitions
+
+        heal_all_partitions()
         # Per-seed deadline accounting: the no-call-outlives-deadline
         # invariant reads these process-wide counters — and the GCS-side
         # aggregate of worker-subprocess flushes — at convergence.
@@ -1118,6 +1165,9 @@ def run_sched_seed(cluster, client, scenario: Scenario, seed: int,
         # Same per-seed hygiene as run_seed: drained cluster, fresh deadline
         # accounting and telemetry so check()/flight dumps see one seed only.
         await invariants.quiesce(cluster, timeout=15.0)
+        from ray_tpu._private.gcs_store import heal_all_partitions
+
+        heal_all_partitions()
         rpc.deadline_stats.reset()
         gcs = cluster.gcs_server
         if gcs is not None:

@@ -282,6 +282,162 @@ class HaPromotion:
         shutil.rmtree(self._tmp, ignore_errors=True)
 
 
+class QuorumElection:
+    """Quorum-freshest election racing a partitioned follower's rejoin.
+
+    A 3-member group (primary + followers fA, fB) runs with fB dark behind
+    a minority partition: quorum commits keep acking on primary+fA, so fB
+    is a stale laggard.  Then the primary host dies (crash + drop_host —
+    disk gone) and a promoter elects over the survivors [fA, fB], which is
+    a 2-member group needing BOTH members reachable; while fB is still
+    partitioned the election must fail closed with QuorumLostError, and
+    the promoter retries until a concurrently-scheduled healer rejoins fB.
+    The explorer probes every landing point of the heal against the retry
+    loop.  Invariants, checked per schedule:
+
+    - liveness under a minority partition: every writer flush acks
+      (commit_listener fires) while fB is dark;
+    - fail-closed: no promotion happens before fB is healed, and the
+      first election attempt after the heal must succeed;
+    - quorum-freshest adoption: the promoted store (elected at max
+      (term, seq) over the rejoined members) contains every acked term-1
+      key — stale fB must never win over fA;
+    - fence bump on rejoin: after a post-election write, fB's file carries
+      the promoted term and the full acked state (catch-up snapshot).
+    """
+
+    MUTATIONS = ()
+    PUTS = 2
+    RETRIES = 40
+
+    def __init__(self, mutations: Sequence[str] = ()):
+        self._tmp = tempfile.mkdtemp(prefix="explore-quorum-")
+        self._stores: List[Any] = []
+
+    async def run(self) -> List[str]:
+        from ray_tpu._private import gcs_store
+
+        violations: List[str] = []
+        f_a = os.path.join(self._tmp, "member.fA")
+        f_b = os.path.join(self._tmp, "member.fB")
+        primary_path = os.path.join(self._tmp, "member.primary")
+
+        gcs_store.partition_host(f_b)
+        primary = gcs_store.ReplicatedStoreClient(
+            primary_path, followers=[f_a, f_b], term=1, sync="off"
+        )
+        self._stores.append(primary)
+
+        sent: List[str] = []
+        acked: List[str] = []
+
+        def on_commit(seq: int, n_ops: int) -> None:
+            acked.extend(sent[:n_ops])
+            del sent[:n_ops]
+
+        primary.commit_listener = on_commit
+        for i in range(self.PUTS):
+            key = f"t1-k{i}"
+            sent.append(key)
+            primary.put("data", key, b"v1")
+            primary.flush()
+        if sent:
+            violations.append(
+                "quorum-liveness: writes did not ack under a minority "
+                f"partition (unacked: {sent})"
+            )
+        # Host loss: the leader process dies AND its log member's disk is
+        # gone. Survivors are fA (quorum-fresh) and fB (stale, still dark).
+        primary.crash()
+        gcs_store.drop_host(primary_path)
+
+        promoted_box: List[Any] = []
+        healed = asyncio.Event()
+
+        async def healer() -> None:
+            await asyncio.sleep(0)
+            gcs_store.heal_host(f_b)
+            healed.set()
+
+        async def promoter() -> None:
+            # Election attempts race the heal: an attempt landing before it
+            # must fail closed (QuorumLostError), after which the promoter
+            # blocks on the heal signal and the next attempt must succeed.
+            # (An unconditional retry-on-sleep loop would depend on
+            # scheduler fairness, which the explorer rightly violates.)
+            for _ in range(self.RETRIES):
+                try:
+                    promoted = gcs_store.ReplicatedStoreClient(
+                        f_a, followers=[f_b], term=2, sync="off"
+                    )
+                except gcs_store.QuorumLostError:
+                    if f_b not in gcs_store.partitioned_hosts():
+                        violations.append(
+                            "quorum-election: QuorumLostError after the "
+                            "partition healed"
+                        )
+                        return
+                    await healed.wait()
+                    continue
+                if f_b in gcs_store.partitioned_hosts():
+                    violations.append(
+                        "quorum-election: promotion succeeded while the "
+                        "2-member survivor group was missing fB"
+                    )
+                self._stores.append(promoted)
+                promoted_box.append(promoted)
+                return
+            violations.append(
+                "quorum-election: election kept failing after the heal"
+            )
+
+        await asyncio.gather(healer(), promoter())
+        if not promoted_box:
+            return violations
+        promoted = promoted_box[0]
+
+        for key in acked:
+            if promoted.get("data", key) is None:
+                violations.append(
+                    f"quorum-freshest: acked key {key!r} missing from the "
+                    "elected state (stale rejoined member won?)"
+                )
+        promoted.put("data", "t2-k0", b"v2")
+        promoted.flush()
+        promoted.wait_replication()
+        if promoted.fenced:
+            violations.append("quorum-election: promoted store got fenced")
+
+        tailer = gcs_store.ReplicaTailer(f_b)
+        tailer.poll()
+        tables, term = tailer.tables, tailer.term
+        if term != 2:
+            violations.append(
+                f"quorum-rejoin: fB fence/term is {term} after catch-up, "
+                "expected the promoted term 2"
+            )
+        have = set(tables.get("data", {}).keys())
+        missing = (set(acked) | {"t2-k0"}) - have
+        if missing:
+            violations.append(
+                "quorum-rejoin: fB missing keys after catch-up snapshot: "
+                f"{sorted(missing)}"
+            )
+        return violations
+
+    def cleanup(self) -> None:
+        from ray_tpu._private import gcs_store
+
+        gcs_store.heal_all_partitions()
+        for store in self._stores:
+            try:
+                store.close()
+            except Exception:
+                pass
+        self._stores.clear()
+        shutil.rmtree(self._tmp, ignore_errors=True)
+
+
 class ResubscribeGap:
     """Pubsub overflow-shed / snapshot-pull gap closure, frame by frame.
 
@@ -411,6 +567,11 @@ SCENARIOS: Dict[str, ScenarioSpec] = {
         HaPromotion,
         "term-2 standby promotion racing a still-writing term-1 primary "
         "over a shared follower: fencing, ack durability, leadership",
+    ),
+    "quorum_election": ScenarioSpec(
+        QuorumElection,
+        "promotion over 2 survivors racing a partitioned laggard's rejoin: "
+        "fail-closed QuorumLostError, quorum-freshest adoption, fence bump",
     ),
     "resubscribe_gap": ScenarioSpec(
         ResubscribeGap,

@@ -683,9 +683,14 @@ def crash_scan_wal(workdir: str, workload: Optional[Callable[[Any], None]] = Non
 
 
 def crash_scan_replicated(workdir: str) -> CrashReport:
-    """Replicated-store crash points: at every commit the follower copy must
-    already contain all acknowledged writes (truncated-primary + torn-tail
-    follower variants both recover the acked prefix)."""
+    """Quorum-replicated crash points over a 3-member group with a rotating
+    partitioned laggard. At every quorum commit boundary the member files
+    are imaged; for each image we enumerate losing each single member ×
+    {clean, torn-tail-on-survivors} and run the quorum-freshest election
+    (max (term, seq) via _parse_replicated) over the two survivors. Every
+    acknowledged write must appear in the elected state — the on-disk
+    proof of the ack-quorum ∩ election-majority intersection argument.
+    Writes *after* the imaged commit may legitimately be lost."""
     import os
     import shutil
 
@@ -693,25 +698,44 @@ def crash_scan_replicated(workdir: str) -> CrashReport:
 
     report = CrashReport(backend="replicated")
     primary = os.path.join(workdir, "repl-crash.log")
-    follower = os.path.join(workdir, "repl-crash.follower")
+    followers = [
+        os.path.join(workdir, "repl-crash.follower0"),
+        os.path.join(workdir, "repl-crash.follower1"),
+    ]
+    members = [primary] + followers
     acked: List[Set[str]] = []
-    copies: List[str] = []
+    images: List[List[str]] = []
     written: List[str] = []
 
     store = gcs_store.ReplicatedStoreClient(
-        primary, followers=[follower], term=1, sync="off"
+        primary, followers=followers, term=1, sync="off"
     )
 
     def on_commit(seq: int, n_ops: int) -> None:
-        idx = len(copies)
-        copy_path = os.path.join(workdir, f"repl-case-{idx}.follower")
-        shutil.copyfile(follower, copy_path)
-        copies.append(copy_path)
+        # Image every member file at the commit boundary. A partitioned or
+        # lagging member's copy may be stale or mid-append (torn) — that is
+        # the point: the election must not need it.
+        idx = len(images)
+        image = []
+        for mi, path in enumerate(members):
+            copy_path = os.path.join(workdir, f"repl-case-{idx}.m{mi}")
+            shutil.copyfile(path, copy_path)
+            image.append(copy_path)
+        images.append(image)
         acked.append(set(written))
 
     store.commit_listener = on_commit
+    # Rotate a minority partition across the followers: commits 0-2 with
+    # follower0 dark, 3-5 with follower1 dark (follower0 catches up via a
+    # snapshot frame), 6-9 fully healed. Quorum (2 of 3) must keep acking
+    # throughout.
+    schedule = {0: followers[0], 3: followers[1], 6: None}
     try:
-        for i in range(5):
+        for i in range(10):
+            if i in schedule:
+                gcs_store.heal_all_partitions()
+                if schedule[i] is not None:
+                    gcs_store.partition_host(schedule[i])
             key = f"rk{i}"
             store.put("t", key, b"rv%d" % i)
             written.append(key)
@@ -719,26 +743,34 @@ def crash_scan_replicated(workdir: str) -> CrashReport:
     finally:
         store.commit_listener = None
         store.close()
+        gcs_store.heal_all_partitions()
 
-    report.commits = len(copies)
-    for idx, copy_path in enumerate(copies):
-        for torn in (False, True):
-            case = copy_path + (".torn" if torn else ".clean")
-            shutil.copyfile(copy_path, case)
-            if torn:
-                gcs_store.inject_torn_tail(case)
-            report.cases += 1
-            tailer = gcs_store.ReplicaTailer(case)
-            tailer.poll()
-            have = set(tailer.get_all("t").keys())
-            missing = acked[idx] - have
-            if missing:
-                report.failures.append(
-                    f"commit {idx} (torn={torn}): acked keys missing from "
-                    f"follower after crash: {sorted(missing)}"
-                )
-            os.unlink(case)
-        os.unlink(copy_path)
+    report.commits = len(images)
+    for idx, image in enumerate(images):
+        for lost in range(len(members)):
+            survivors = [p for mi, p in enumerate(image) if mi != lost]
+            for torn in (False, True):
+                report.cases += 1
+                states = []
+                for sp in survivors:
+                    case = sp + (".torn" if torn else ".clean")
+                    shutil.copyfile(sp, case)
+                    if torn:
+                        gcs_store.inject_torn_tail(case)
+                    with open(case, "rb") as fh:
+                        data = fh.read()
+                    states.append(gcs_store._parse_replicated(data))
+                    os.unlink(case)
+                tables, term, seq, _ = max(states, key=lambda s: (s[1], s[2]))
+                have = set(tables.get("t", {}).keys())
+                missing = acked[idx] - have
+                if missing:
+                    report.failures.append(
+                        f"commit {idx} (lost=m{lost}, torn={torn}): acked "
+                        f"keys missing from elected state: {sorted(missing)}"
+                    )
+        for copy_path in image:
+            os.unlink(copy_path)
     return report
 
 

@@ -215,7 +215,9 @@ def test_crash_scan_wal(tmp_path):
 def test_crash_scan_replicated(tmp_path):
     report = explore.crash_scan_replicated(str(tmp_path))
     assert report.commits > 0
-    assert report.cases == 2 * report.commits
+    # Per commit image of the 3-member group: lose each single member,
+    # each with a clean and a torn-tail survivor variant.
+    assert report.cases == 6 * report.commits
     assert report.failures == []
 
 
@@ -251,6 +253,14 @@ def test_resubscribe_gap_bounded_clean():
     report = _explore_scenario("resubscribe_gap", budget=300)
     assert report.violations == 0, report.first_violation
     assert report.schedules + report.pruned > 100
+
+
+def test_quorum_election_exhausts_clean():
+    # Measured space: 591 schedules — small enough to exhaust inline.
+    report = _explore_scenario("quorum_election", budget=2000)
+    assert report.complete, report.summary()
+    assert report.violations == 0, report.first_violation
+    assert report.schedules > 100
 
 
 @pytest.mark.slow

@@ -292,3 +292,210 @@ def test_lease_during_failover_granted_exactly_once(ray_ha):
     calls = ray_tpu.get(c.all.remote(), timeout=30)
     # Exactly once: no mark ran twice (a duplicated grant would double-run).
     assert calls == {i: 1 for i in range(16)}
+
+
+# -- quorum HA: standby pools, majority loss, RPC-fed standbys ---------------
+
+
+def test_two_consecutive_failovers_same_standby_pool(ha_env):
+    """Regression: a standby that loses the promotion race (or whose
+    promotion attempt fails) must RE-ARM at the next term, not give up
+    forever — the same two-standby pool must absorb two failovers."""
+    from ray_tpu._private.gcs_store import drop_host
+
+    path = ha_env
+
+    async def go():
+        primary = GcsServer(session_name="ha", persist_path=path,
+                            persist_backend="replicated")
+        await primary.start()
+        sb1 = gcs_ha.GcsStandby(session_name="ha", persist_path=path)
+        sb2 = gcs_ha.GcsStandby(session_name="ha", persist_path=path)
+        await sb1.start()
+        await sb2.start()
+
+        conn = await rpc.connect(*primary.server.address)
+        await conn.call("KVPut", {"ns": "", "key": "k1", "value": b"v1"})
+        await conn.close()
+
+        # Failover #1: both standbys race; try_claim_term lets exactly one
+        # open the store at term 2, the loser re-enters its watch loop.
+        await primary.crash()
+        drop_host(path)
+        deadline = time.monotonic() + 30
+        while not (sb1.promoted.is_set() or sb2.promoted.is_set()):
+            assert time.monotonic() < deadline, "no standby promoted"
+            await asyncio.sleep(0.05)
+        winner, loser = (sb1, sb2) if sb1.promoted.is_set() else (sb2, sb1)
+        new1 = winner.server
+        assert new1.leader_term == 2
+        assert not loser.promoted.is_set()
+
+        conn = await rpc.connect(*new1.server.address)
+        await conn.call("KVPut", {"ns": "", "key": "k2", "value": b"v2"})
+        await conn.close()
+
+        # Failover #2 through the SAME pool: the first race's loser must
+        # still be armed and take term 3.
+        await new1.crash()
+        drop_host(path)
+        await asyncio.wait_for(loser.promoted.wait(), 30)
+        new2 = loser.server
+        assert new2.leader_term == 3
+        assert new2.kv.get(("", "k1")) == b"v1"
+        assert new2.kv.get(("", "k2")) == b"v2"
+
+        await winner.stop()
+        await loser.stop()
+
+    asyncio.run(go())
+
+
+def test_majority_loss_demotes_leader_server(ha_env):
+    """Graceful degradation's hard edge: with EVERY follower partitioned no
+    majority can hold a commit — the leader must demote (typed rejection
+    to clients, serve loop exits), never ack unreplicated writes."""
+    from ray_tpu._private import gcs_store
+    from ray_tpu._private.gcs_store import follower_paths, partition_host
+
+    path = ha_env
+
+    async def go():
+        server = GcsServer(session_name="ha", persist_path=path,
+                           persist_backend="replicated")
+        await server.start()
+        conn = await rpc.connect(*server.server.address)
+        await conn.call("KVPut", {"ns": "", "key": "pre", "value": b"1"})
+        await asyncio.sleep(0.1)  # let the pre write's group commit land
+        try:
+            for fol in follower_paths(path):
+                partition_host(fol)
+            # Batch sync: the RPC reply can precede the group commit, so
+            # this write may be accepted in-memory — but its flush finds no
+            # majority and the leader must demote instead of limping on.
+            try:
+                await conn.call(
+                    "KVPut", {"ns": "", "key": "lost", "value": b"2"},
+                    timeout=10,
+                )
+            except (rpc.StaleLeaderError, rpc.RpcError, OSError):
+                pass
+            for _ in range(200):
+                if server.fenced and server._stopping:
+                    break
+                await asyncio.sleep(0.05)
+            assert server.fenced and server._stopping
+            # The demoted leader never shipped the unreplicated write: no
+            # member of the (partitioned) majority holds it, while the
+            # quorum-acked pre-partition write is on every follower.
+            for fol in follower_paths(path):
+                with open(fol, "rb") as f:
+                    tables, _, _, _ = gcs_store._parse_replicated(f.read())
+                assert "\x00pre" in tables.get("kv", {})
+                assert "\x00lost" not in tables.get("kv", {})
+        finally:
+            gcs_store.heal_all_partitions()
+            await conn.close()
+            await server.stop()
+
+    asyncio.run(go())
+
+
+def test_standby_rpc_stream_mirrors_commits(ha_env):
+    """The cross-process standby feed: a ShipSubscribe'd standby bootstraps
+    from ShipSnapshot and then mirrors every quorum commit from pushed
+    ShipFrames — no reliance on reading the leader's local files."""
+    path = ha_env
+
+    async def go():
+        server = GcsServer(session_name="ha", persist_path=path,
+                           persist_backend="replicated")
+        await server.start()
+        standby = gcs_ha.GcsStandby(session_name="ha", persist_path=path,
+                                    mode="rpc")
+        await standby.start()
+        conn = await rpc.connect(*server.server.address)
+        # Let the standby's watch loop dial and subscribe first so the
+        # puts arrive as streamed frames, not just the bootstrap snapshot.
+        deadline = time.monotonic() + 30
+        while standby.snapshots_pulled == 0:
+            assert time.monotonic() < deadline, "standby never subscribed"
+            await asyncio.sleep(0.05)
+        for i in range(3):
+            await conn.call(
+                "KVPut", {"ns": "", "key": f"k{i}", "value": b"v"}
+            )
+        while standby.mirror.seq < server.store.seq:
+            assert time.monotonic() < deadline, "mirror never caught up"
+            await asyncio.sleep(0.05)
+        assert standby.frames_received > 0
+        assert standby.mirror.term == server.store.term
+        await conn.close()
+        await standby.stop()
+        await server.stop()
+
+    asyncio.run(go())
+
+
+def test_os_process_standby_promotes_after_host_loss(ha_env):
+    """E2E with a REAL second process: ``python -m ray_tpu._private.gcs_ha``
+    arms a standby in its own OS process; when the leader host dies the
+    subprocess promotes, flips the leader file, and serves the acked state
+    to clients that re-target through it."""
+    import sys
+
+    from ray_tpu._private import gcs_store
+    from ray_tpu._private.gcs_store import drop_host, follower_paths
+
+    path = ha_env
+    leader_file = gcs_ha.leader_file_path(path)
+
+    async def go():
+        primary = GcsServer(session_name="ha", persist_path=path,
+                            persist_backend="replicated")
+        await primary.start()
+        conn = await rpc.connect(*primary.server.address)
+        await conn.call("KVPut", {"ns": "", "key": "k", "value": b"v"})
+        await conn.close()
+        old_addr = gcs_ha.resolve_leader_file(leader_file)
+        assert old_addr == primary.server.address
+
+        env = dict(
+            os.environ,
+            RAY_TPU_GCS_PERSIST_BACKEND="replicated",
+            RAY_TPU_GCS_LEADER_LEASE_S="1.0",
+            RAY_TPU_GCS_STANDBY_POLL_S="0.05",
+            JAX_PLATFORMS="cpu",
+        )
+        proc = await asyncio.create_subprocess_exec(
+            sys.executable, "-m", "ray_tpu._private.gcs_ha",
+            "--persist-path", path, "--session", "ha",
+            env=env,
+        )
+        try:
+            await primary.crash()
+            drop_host(path)
+            deadline = time.monotonic() + 30
+            new_addr = None
+            while time.monotonic() < deadline:
+                addr = gcs_ha.resolve_leader_file(leader_file)
+                if addr is not None and addr != old_addr:
+                    new_addr = addr
+                    break
+                await asyncio.sleep(0.1)
+            assert new_addr is not None, "subprocess standby never promoted"
+
+            conn2 = await rpc.connect(*new_addr)
+            reply = await conn2.call(
+                "KVGet", {"ns": "", "key": "k"}, timeout=10
+            )
+            assert reply.get("value") == b"v"
+            await conn2.close()
+            tailer = gcs_store.ReplicaTailer(follower_paths(path)[0])
+            tailer.poll()
+            assert gcs_ha.read_leadership(tailer)["term"] == 2
+        finally:
+            proc.terminate()
+            await proc.wait()
+
+    asyncio.run(go())

@@ -392,3 +392,243 @@ def test_make_store_replicated_selection(tmp_path, monkeypatch):
     finally:
         monkeypatch.delenv("RAY_TPU_GCS_PERSIST_BACKEND")
         config.refresh()
+
+
+# ---------------------------------------------------------------------------
+# Quorum replication (>= 3-member groups)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def quorum_heal():
+    """Partitions are module-global fault injection; never leak them."""
+    yield
+    gcs_store.heal_all_partitions()
+
+
+def _member_state(path):
+    with open(path, "rb") as f:
+        return gcs_store._parse_replicated(f.read())
+
+
+def test_quorum_acks_at_exact_majority(repl_path, quorum_heal):
+    from ray_tpu._private.gcs_store import (
+        ReplicatedStoreClient,
+        follower_paths,
+        partition_host,
+    )
+
+    fols = follower_paths(repl_path, 2)
+    s = ReplicatedStoreClient(repl_path, followers=fols, term=1, sync="off")
+    assert s.quorum == 2  # ceil((3+1)/2)... floor(3/2)+1: 2 of 3
+    partition_host(fols[1])
+    commits = []
+    s.commit_listener = lambda seq, n_ops: commits.append((seq, n_ops))
+    s.put("kv", "a", b"1")
+    s.flush()
+    # Exactly the majority (leader + one follower) is reachable: the
+    # commit must ack and the leader must stay un-fenced.
+    assert commits == [(1, 1)]
+    assert not s.fenced
+    assert s.get("kv", "a") == b"1"
+    tables, _, _, _ = _member_state(fols[0])
+    assert tables["kv"]["a"] == b"1"
+    # The dark minority member holds nothing and shows up as lag.
+    tables, _, _, _ = _member_state(fols[1])
+    assert "a" not in tables.get("kv", {})
+    assert s.replica_lag()[os.path.basename(fols[1])] == 1
+    assert s.replica_lag()[os.path.basename(fols[0])] == 0
+    s.close()
+
+
+def test_quorum_loss_demotes_leader_without_acking(repl_path, quorum_heal):
+    from ray_tpu._private.gcs_store import (
+        ReplicatedStoreClient,
+        follower_paths,
+        partition_host,
+    )
+    from ray_tpu._private.rpc import StaleLeaderError
+
+    fols = follower_paths(repl_path, 2)
+    s = ReplicatedStoreClient(repl_path, followers=fols, term=1, sync="off")
+    commits = []
+    s.commit_listener = lambda seq, n_ops: commits.append(seq)
+    partition_host(fols[0])
+    partition_host(fols[1])
+    s.put("kv", "a", b"1")
+    s.flush()
+    # Every follower is unreachable: no majority can hold the write, so
+    # the leader demotes itself rather than acking it.
+    assert commits == []
+    assert s.fenced
+    with pytest.raises(StaleLeaderError):
+        s.put("kv", "b", b"2")
+        s.flush()
+    s.close()
+
+
+def test_quorum_laggard_catches_up_via_snapshot(repl_path, quorum_heal):
+    from ray_tpu._private.gcs_store import (
+        ReplicatedStoreClient,
+        follower_paths,
+        heal_host,
+        partition_host,
+    )
+
+    fols = follower_paths(repl_path, 2)
+    s = ReplicatedStoreClient(repl_path, followers=fols, term=1, sync="off")
+    partition_host(fols[1])
+    for i in range(5):
+        s.put("kv", f"k{i}", str(i).encode())
+        s.flush()
+    assert s.replica_lag()[os.path.basename(fols[1])] == 5
+    heal_host(fols[1])
+    # The next commit notices the healed member is behind the stream and
+    # ships the full state as one snapshot frame on its lane.
+    s.put("kv", "post", b"p")
+    s.flush()
+    s.wait_replication()
+    tables, term, seq, _ = _member_state(fols[1])
+    assert term == 1 and seq == s.seq
+    assert tables["kv"]["post"] == b"p"
+    for i in range(5):
+        assert tables["kv"][f"k{i}"] == str(i).encode()
+    assert s.replica_lag()[os.path.basename(fols[1])] == 0
+    s.close()
+
+
+def test_quorum_freshest_election_beats_file_freshest(repl_path, quorum_heal, tmp_path):
+    from ray_tpu._private.gcs_store import (
+        ReplicatedStoreClient,
+        drop_host,
+        follower_paths,
+        heal_host,
+        partition_host,
+    )
+
+    fols = follower_paths(repl_path, 2)
+    # Phase 1: 6KB of overwrites of one key land on every member.
+    s1 = ReplicatedStoreClient(repl_path, followers=fols, term=1, sync="off")
+    for i in range(4):
+        s1.put("kv", "x", bytes([65 + i]) * 1500)
+        s1.flush()
+    s1.close()
+    # Phase 2: fol0 partitions; the new term compacts the survivors down
+    # to a ~1.5KB snapshot and commits a fresh key on the majority.
+    partition_host(fols[0])
+    s2 = ReplicatedStoreClient(
+        repl_path, followers=fols, term=2, compact_bytes=2048, sync="off"
+    )
+    s2.put("kv", "fresh", b"F")
+    s2.flush()
+    s2.wait_replication()
+    s2.crash()
+    drop_host(repl_path)
+    heal_host(fols[0])
+    # fol0 has the LARGEST file (the long un-compacted term-1 log) but the
+    # LOWEST (term, seq); fol1 is byte-small but quorum-fresh. Election
+    # must adopt fol1 — a file-size/mtime heuristic would resurrect stale
+    # state and lose the acked "fresh" key.
+    assert os.path.getsize(fols[0]) > os.path.getsize(fols[1])
+    s3 = ReplicatedStoreClient(repl_path, followers=fols, term=3, sync="off")
+    assert s3.get("kv", "fresh") == b"F"
+    assert s3.get("kv", "x") == b"D" * 1500
+    s3.close()
+
+
+def test_quorum_lost_error_until_majority_heals(repl_path, quorum_heal):
+    from ray_tpu._private.gcs_store import (
+        QuorumLostError,
+        ReplicatedStoreClient,
+        follower_paths,
+        heal_host,
+        partition_host,
+    )
+
+    fols = follower_paths(repl_path, 2)
+    s = ReplicatedStoreClient(repl_path, followers=fols, term=1, sync="off")
+    s.put("kv", "a", b"1")
+    s.flush()
+    s.close()
+    partition_host(fols[0])
+    partition_host(fols[1])
+    # Only the leader member is reachable (1 of 3): the election must
+    # fail closed — it cannot prove it sees every possibly-acked write.
+    with pytest.raises(QuorumLostError):
+        ReplicatedStoreClient(repl_path, followers=fols, term=2, sync="off")
+    heal_host(fols[0])
+    s2 = ReplicatedStoreClient(repl_path, followers=fols, term=2, sync="off")
+    assert s2.get("kv", "a") == b"1"
+    s2.close()
+
+
+def test_quorum_rejoin_gets_fence_bump(repl_path, quorum_heal):
+    from ray_tpu._private.gcs_store import (
+        ReplicatedStoreClient,
+        drop_host,
+        follower_paths,
+        heal_host,
+        partition_host,
+    )
+
+    fols = follower_paths(repl_path, 2)
+    s1 = ReplicatedStoreClient(repl_path, followers=fols, term=1, sync="off")
+    partition_host(fols[1])
+    s1.put("kv", "a", b"1")
+    s1.flush()
+    s1.crash()
+    drop_host(repl_path)
+    # Successor elects over the reachable majority while fol1 is dark...
+    s2 = ReplicatedStoreClient(repl_path, followers=fols, term=2, sync="off")
+    s2.put("kv", "b", b"2")
+    s2.flush()
+    # ...and fol1's rejoin rides the catch-up snapshot, which carries the
+    # new term: the fence bump that locks out the dead term-1 leadership.
+    heal_host(fols[1])
+    s2.put("kv", "c", b"3")
+    s2.flush()
+    s2.wait_replication()
+    tables, term, _, _ = _member_state(fols[1])
+    assert term == 2
+    assert tables["kv"] == {"a": b"1", "b": b"2", "c": b"3"}
+    s2.close()
+
+
+def test_quorum_stale_catchup_snapshot_rejected(repl_path, tmp_path, quorum_heal):
+    from ray_tpu._private.gcs_store import ReplicatedStoreClient, follower_paths
+    from ray_tpu._private.rpc import StaleLeaderError
+
+    # Regression (found by the interleaving explorer): a deposed leader
+    # whose follower moved on sees it as a "laggard" and ships a catch-up
+    # snapshot of its own stale state. reset_with must fence that exactly
+    # like append, or the old term overwrites the new term's log wholesale.
+    shared = follower_paths(repl_path, 1)[0]
+    old = ReplicatedStoreClient(repl_path, followers=[shared], term=1, sync="off")
+    old.put("kv", "old", b"1")
+    old.flush()
+
+    async def race():
+        # Under a running loop the put's group commit is deferred to a
+        # call_soon tick, so the promotion lands between the (passing)
+        # put-side fence check and the flush — the explorer's schedule.
+        old.put("kv", "late", b"3")
+        new = ReplicatedStoreClient(
+            str(tmp_path / "b.wal"), followers=[shared], term=2
+        )
+        new.put("kv", "new", b"2")
+        new.flush()
+        await asyncio.sleep(0)  # old's deferred flush fires here
+        return new
+
+    new = asyncio.run(race())
+    # The deposed leader saw the follower's seq ahead of its stream,
+    # shipped its stale state as a catch-up snapshot, and was rejected.
+    assert old.fenced
+    with pytest.raises(StaleLeaderError):
+        old.put("kv", "even-later", b"4")
+    tables, term, _, _ = _member_state(shared)
+    assert term == 2
+    assert tables["kv"].get("new") == b"2"
+    assert "late" not in tables["kv"]
+    old.close()
+    new.close()

@@ -313,7 +313,7 @@ def test_sim_1000_node_failover_reconnect_storm():
     import shutil
     import tempfile
 
-    from ray_tpu._private import rpc
+    from ray_tpu._private import gcs_ha, rpc
     from ray_tpu._private.sim_cluster import SimCluster, SimLeaseClient
 
     n = 1000
@@ -336,11 +336,33 @@ def test_sim_1000_node_failover_reconnect_storm():
         t_promote = time.perf_counter() - t0
 
         async def converged() -> float:
-            conn = await rpc.connect(*cluster.gcs_addr)
+            # Probe through the leader file like the raylets do: under the
+            # reconnect wave a promoted leader can miss its own lease and a
+            # second standby takes over, fencing term N and closing its
+            # connections — re-resolve and re-dial instead of dying on the
+            # demoted address. GetAllNodes is a read; re-issuing is safe.
+            leader_file = cluster.gcs_leader_file()
+
+            async def dial() -> "rpc.Connection":
+                addr = gcs_ha.resolve_leader_file(leader_file)
+                return await rpc.connect(*(addr or cluster.gcs_addr))
+
+            conn = None
             try:
                 deadline = asyncio.get_running_loop().time() + 600
                 while True:
-                    reply = await conn.call("GetAllNodes", timeout=60)
+                    try:
+                        if conn is None:
+                            conn = await dial()
+                        reply = await conn.call("GetAllNodes", timeout=60)
+                    except (rpc.RpcError, OSError):
+                        if asyncio.get_running_loop().time() > deadline:
+                            raise
+                        if conn is not None:
+                            await conn.close()
+                            conn = None
+                        await asyncio.sleep(0.25)
+                        continue
                     alive = sum(
                         1 for node in reply["nodes"]
                         if node["state"] == "ALIVE"
@@ -353,7 +375,8 @@ def test_sim_1000_node_failover_reconnect_storm():
                         )
                     await asyncio.sleep(0.25)
             finally:
-                await conn.close()
+                if conn is not None:
+                    await conn.close()
 
         t_converge = cluster.run(converged(), timeout=700)
         # The promoted leader still schedules: a fresh lease burst works.

@@ -68,13 +68,18 @@ loadgen:
 # Perf floors (CI perf-smoke job runs the same commands): the ray_perf
 # microbenchmark suite — tasks/actors/put/get plus the streaming-ingest
 # leg (ingest_rows_per_s) — and the serve loadgen smoke, gated together
-# against benchmarks/perf_floors.json.
+# against benchmarks/perf_floors.json. Then the native-wire A/B: the
+# lease bench runs with and without RAY_TPU_NATIVE_WIRE=0 and the gate
+# asserts the _fastpath codec strictly wins (pack >= 1.2x) and the
+# end-to-end lease rate doesn't regress with native enabled.
 perf:
 	timeout -k 10 900 env JAX_PLATFORMS=cpu \
 		$(PYTHON) -m ray_tpu._private.ray_perf --json /tmp/perf.json
 	timeout -k 10 300 env JAX_PLATFORMS=cpu \
 		$(PYTHON) -m ray_tpu.loadgen --smoke --json /tmp/serve_load.json
 	$(PYTHON) benchmarks/perf_gate.py /tmp/perf.json /tmp/serve_load.json
+	timeout -k 10 600 env JAX_PLATFORMS=cpu \
+		$(PYTHON) benchmarks/native_ab.py
 
 # Exhaustive interleaving explorer (docs/static_analysis.md): enumerate
 # the control-plane scenarios' schedule spaces under the virtual loop

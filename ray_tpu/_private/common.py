@@ -76,6 +76,12 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     # hundreds of nodes batching caps the broadcast fan-out at
     # subscribers/batch_ms msgs/s instead of subscribers*grants/s.
     "scheduler_view_batch_ms": 0,
+    # Raylet -> GCS UpdateResources debounce: once the dirty flag is set, a
+    # raylet waits this long before reporting so a burst of grant/release
+    # mutations folds into one round-trip instead of one each. 0 reports
+    # per mutation (pre-PR-20 behavior); the idle 1 s heartbeat report is
+    # unaffected either way.
+    "raylet_report_debounce_s": 0.01,
     # Object spilling (reference: local_object_manager.cc +
     # external_storage.py): sealed objects are written to disk when the shm
     # arena fills and restored on access. Empty dir -> default under /tmp.
@@ -306,6 +312,11 @@ _CONFIG_DEFAULTS: Dict[str, Any] = {
     # cancellation) this long past its wire deadline before the chaos
     # no-call-outlives-deadline invariant flags it.
     "rpc_deadline_grace_s": 0.5,
+    # Event-loop implementation for daemons ("asyncio" | "uvloop").
+    # "uvloop" installs the uvloop policy when the package is importable
+    # and falls back to stock asyncio (with a log line) when it is not —
+    # the A/B lives in `make perf`; see docs/perf.md "Native wire codec".
+    "rpc_event_loop": "asyncio",
     # Worker subprocesses flush deadline_stats deltas (met/shed/enforced/
     # overruns) to the GCS at this cadence, plus once on Exit, so the
     # no-call-outlives-deadline invariant sees overruns inside
@@ -411,7 +422,9 @@ class ResourceSet:
 
     @classmethod
     def from_units(cls, units: Dict[str, int]) -> "ResourceSet":
-        return cls(_units=dict(units))
+        rs = cls.__new__(cls)
+        rs._units = {k: v for k, v in units.items() if v != 0}
+        return rs
 
     def to_units(self) -> Dict[str, int]:
         return dict(self._units)
@@ -425,14 +438,26 @@ class ResourceSet:
     def __add__(self, other: "ResourceSet") -> "ResourceSet":
         units = dict(self._units)
         for k, v in other._units.items():
-            units[k] = units.get(k, 0) + v
-        return ResourceSet.from_units(units)
+            nv = units.get(k, 0) + v
+            if nv:
+                units[k] = nv
+            else:
+                units.pop(k, None)
+        rs = ResourceSet.__new__(ResourceSet)
+        rs._units = units
+        return rs
 
     def __sub__(self, other: "ResourceSet") -> "ResourceSet":
         units = dict(self._units)
         for k, v in other._units.items():
-            units[k] = units.get(k, 0) - v
-        return ResourceSet.from_units(units)
+            nv = units.get(k, 0) - v
+            if nv:
+                units[k] = nv
+            else:
+                units.pop(k, None)
+        rs = ResourceSet.__new__(ResourceSet)
+        rs._units = units
+        return rs
 
     def get(self, name: str) -> float:
         return from_fixed(self._units.get(name, 0))

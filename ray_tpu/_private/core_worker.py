@@ -375,9 +375,9 @@ class _ShapePool:
     in-flight lease requests to the raylet."""
 
     __slots__ = (
-        "idle", "pending", "inflight", "inflight_ids", "leases",
-        "total_outstanding", "resources", "pg_id", "bundle_index", "strategy",
-        "sweep_scheduled",
+        "idle", "pending", "inflight", "inflight_ids", "inflight_reqs",
+        "leases", "total_outstanding", "resources", "pg_id", "bundle_index",
+        "strategy", "sweep_scheduled",
     )
 
     def __init__(self, resources, pg_id, bundle_index, strategy=None):
@@ -392,6 +392,11 @@ class _ShapePool:
         # lease_ids of in-flight RequestWorkerLease RPCs still cancellable on
         # the home raylet.
         self.inflight_ids: set = set()
+        # lease_id -> (conn, msgid) of the batched request frame, so a
+        # cancel landing before the batch flushes withdraws the entry
+        # locally instead of sending a wire cancel for a frame that never
+        # went out.
+        self.inflight_reqs: dict = {}
         # Live leases of this shape (granted, not yet returned).
         self.leases: set = set()
         # Running total of outstanding pushes across self.leases (kept by
@@ -536,8 +541,23 @@ class LeasePool:
         while surplus > 0 and pool.inflight_ids:
             lid = pool.inflight_ids.pop()
             surplus -= 1
+            conn, msgid = pool.inflight_reqs.pop(lid, (None, None))
+            if conn is not None and conn.closed:
+                # The link died with the request on it: teardown already
+                # failed the pending future, whose exception path does the
+                # slot bookkeeping. Nothing to cancel anywhere.
+                continue
+            if conn is not None and conn.try_cancel_batched(msgid):
+                # The request was still queued in this tick's unsent lease
+                # batch: withdrawn locally, so no CancelWorkerLease may go
+                # out (the raylet never saw the request; a wire cancel for
+                # it would be a cancel for a phantom lease_id). The
+                # awaiting coroutine observes its future cancelled and
+                # exits; account for the slot here.
+                pool.inflight -= 1
+                continue
             try:
-                self.core.raylet_conn.push_nowait(
+                (conn if conn is not None else self.core.raylet_conn).push_nowait(
                     "CancelWorkerLease", {"lease_id": lid}
                 )
             except rpc.ConnectionLost:
@@ -690,7 +710,13 @@ class LeasePool:
             hops = 0
             used_gcs_fallback = False
             while True:
-                reply = await raylet_conn.call(
+                # Batched issue: this tick's lease requests to the same
+                # raylet ride one LeaseBatch frame. The msgid is recorded so
+                # a cancel racing the flush withdraws the entry locally
+                # (_pump) instead of sending a wire cancel for a frame that
+                # never went out.
+                deadline = raylet_conn._effective_deadline(None)
+                fut = raylet_conn.call_batched_nowait(
                     "RequestWorkerLease",
                     {
                         "lease_id": lease_id,
@@ -704,9 +730,19 @@ class LeasePool:
                         # groups leased workers by owner for fair shedding.
                         "job_id": self.core.job_id,
                     },
-                    timeout=None,
+                    deadline=deadline,
                 )
+                pool.inflight_reqs[lease_id] = (raylet_conn, fut.rpc_msgid)
+                try:
+                    reply = await raylet_conn._await_reply(fut, deadline)
+                except asyncio.CancelledError:
+                    if lease_id not in pool.inflight_ids:
+                        # Withdrawn pre-flush by _pump's surplus trim, which
+                        # already did the slot bookkeeping.
+                        return
+                    raise
                 pool.inflight_ids.discard(lease_id)
+                pool.inflight_reqs.pop(lease_id, None)
                 if reply.get("cancelled"):
                     pool.inflight -= 1
                     # A cancel can cross new work: we asked to cancel this
@@ -760,6 +796,7 @@ class LeasePool:
         except Exception as e:
             pool.inflight -= 1
             pool.inflight_ids.discard(lease_id)
+            pool.inflight_reqs.pop(lease_id, None)
             # Fail one pending item (the request served one logical slot).
             while pool.pending:
                 kind, item, _hints = pool.pending.popleft()
@@ -1022,7 +1059,7 @@ class LeasePool:
                     pass
             lease.fp_channel = False
         try:
-            await lease.raylet_conn.call(
+            await lease.raylet_conn.call_batched(
                 "ReturnWorker", {"lease_id": lease.lease_id, "dirty": dirty}
             )
         except rpc.RpcError:

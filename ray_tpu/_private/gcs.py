@@ -622,6 +622,7 @@ class GcsServer:
         s.register("ListEvents", self._list_events)
         s.register("GetAllNodes", self._get_all_nodes)
         s.register("UpdateResources", self._update_resources)
+        s.register_sync("UpdateResources", self._update_resources_sync)
         s.register("CreateActor", self._create_actor)
         s.register("GetActor", self._get_actor)
         s.register("GetNamedActor", self._get_named_actor)
@@ -837,6 +838,20 @@ class GcsServer:
         }
 
     async def _update_resources(self, conn, p):
+        return self._apply_update_resources(p)
+
+    def _update_resources_sync(self, conn, msgid, p):
+        """Inline fast path: resource reports are the highest-volume RPC the
+        GCS serves (every grant/release on every raylet lands here) and the
+        handler never awaits — dispatch it from data_received with no task.
+        Raylets normally send reports as pushes (msgid None, no reply): the
+        report is state-full and versioned, so a lost one is superseded by
+        the next — the reference syncer's ack-free stream."""
+        reply = self._apply_update_resources(p)
+        if msgid is not None:
+            conn.reply_nowait(msgid, "UpdateResources", reply)
+
+    def _apply_update_resources(self, p: dict) -> dict:
         node = self.nodes.get(p["node_id"])
         if node is not None:
             rv = p.get("version")
@@ -1770,6 +1785,12 @@ class GcsClient:
         self._handlers = conn._handlers
         self._handlers.setdefault("Pub", self._on_pub)
         self._handlers.setdefault("PubBatch", self._on_pub_batch)
+        # Sync fast path: pub deliveries dispatch inline from data_received
+        # (no task per broadcast). The async registrations above stay as
+        # fallback for connections without sync-handler support.
+        self._sync_handlers = conn._sync_handlers
+        self._sync_handlers.setdefault("Pub", self._on_pub_sync)
+        self._sync_handlers.setdefault("PubBatch", self._on_pub_batch_sync)
         # Per-channel last-seen publish seqno + publisher epoch (gap
         # detection; see Publisher docstring and docs/fault_tolerance.md)
         # and leader term (HA: a term change is a new control plane — a
@@ -1824,6 +1845,7 @@ class GcsClient:
             addr[0],
             addr[1],
             handlers=self._handlers,
+            sync_handlers=self._sync_handlers,
             policy=policy,
         )
         conn.remote_addr = tuple(addr)
@@ -1875,6 +1897,17 @@ class GcsClient:
     async def _ensure_connected(self) -> rpc.Connection:
         return await self._rc._ensure_connected()
 
+    def _on_pub_sync(self, conn, msgid, p):
+        """Inline pub delivery from data_received — no task per push.
+        Registered as a sync handler so a view-head broadcast costs zero
+        task creations on each of N subscribers; async subscriber handlers
+        still run (spawned), sync ones run inline."""
+        self._dispatch_pub_sync(p["channel"], p["msg"], p.get("seq"))
+
+    def _on_pub_batch_sync(self, conn, msgid, p):
+        for channel, msg, seq in p["items"]:
+            self._dispatch_pub_sync(channel, msg, seq)
+
     async def _on_pub(self, conn, p):
         await self._dispatch_pub(p["channel"], p["msg"], p.get("seq"))
 
@@ -1883,6 +1916,9 @@ class GcsClient:
             await self._dispatch_pub(channel, msg, seq)
 
     async def _dispatch_pub(self, channel: str, msg, seq) -> None:
+        self._dispatch_pub_sync(channel, msg, seq)
+
+    def _dispatch_pub_sync(self, channel: str, msg, seq) -> None:
         if isinstance(msg, dict) and "leader_term" in msg:
             term = msg["leader_term"]
             known = self._sub_term.get(channel)
@@ -1905,16 +1941,21 @@ class GcsClient:
                     # so resynchronize from a snapshot.
                     self._note_gap(channel, "overflow")
             self._sub_seq[channel] = seq
-        await self._deliver(channel, msg)
+        self._deliver_sync(channel, msg)
 
-    async def _deliver(self, channel: str, msg) -> None:
+    def _deliver_sync(self, channel: str, msg) -> None:
         for fn in list(self._sub_handlers.get(channel, [])):
             try:
                 res = fn(msg)
                 if asyncio.iscoroutine(res):
-                    await res
+                    # Async subscriber handler: runs as its own task. Sync
+                    # handlers (the hot view-head path) run inline.
+                    rpc.spawn(res)
             except Exception:
                 logger.exception("pubsub handler failed for %s", channel)
+
+    async def _deliver(self, channel: str, msg) -> None:
+        self._deliver_sync(channel, msg)
 
     def _note_gap(self, channel: str, cause: str) -> None:
         _TEL_SUB_GAP.cell(cause=cause).inc()

@@ -426,6 +426,9 @@ def _main(argv=None) -> None:
         await stop.wait()
         await standby.stop()
 
+    from ray_tpu._private import rpc
+
+    rpc.install_event_loop()
     asyncio.run(_run())
 
 

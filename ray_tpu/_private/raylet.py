@@ -878,6 +878,13 @@ class Raylet:
         s.register("RequestWorkerLease", self._request_worker_lease)
         s.register("CancelWorkerLease", self._cancel_worker_lease)
         s.register("ReturnWorker", self._return_worker)
+        # Lease fast path: the unconstrained-grant/release/cancel cases run
+        # inline from the read loop (no dispatch task, no deadline wrapper);
+        # anything they can't settle synchronously falls through to the
+        # async handlers registered above.
+        s.register_sync("RequestWorkerLease", self._request_worker_lease_sync)
+        s.register_sync("ReturnWorker", self._return_worker_sync)
+        s.register_sync("CancelWorkerLease", self._cancel_worker_lease_sync)
         s.register("LeaseWorkerForActor", self._lease_worker_for_actor)
         s.register("KillWorker", self._kill_worker)
         s.register("ObjCreate", self._obj_create)
@@ -948,30 +955,67 @@ class Raylet:
     # -- resource reporting --------------------------------------------------
 
     async def _resource_report_loop(self) -> None:
+        debounce = config.raylet_report_debounce_s
         while True:
-            try:
-                await asyncio.wait_for(self._resources_dirty.wait(), timeout=1.0)
-            except asyncio.TimeoutError:
-                pass
-            self._resources_dirty.clear()
-            try:
-                self._report_version += 1
-                await self.gcs.call(
-                    "UpdateResources",
-                    {
-                        "node_id": self.node_id,
-                        "available": self.available.to_units(),
-                        "total": self.total.to_units(),
-                        "version": self._report_version,
-                    },
+            # Hot path: under grant/release churn the dirty event is almost
+            # always already set when we come back around — skip the
+            # wait_for (a timer + waiter task per iteration, pure loop
+            # churn) and optionally debounce so a burst of mutations folds
+            # into one UpdateResources round-trip instead of one each.
+            if not self._resources_dirty.is_set():
+                # Park on the dirty event with a call_later heartbeat that
+                # force-sets it after 1s — same "report at least every
+                # second" behavior as wait_for(..., 1.0) without the wrapper
+                # task wait_for creates per iteration (one extra task per
+                # report at cluster scale).
+                hb = asyncio.get_running_loop().call_later(
+                    1.0, self._resources_dirty.set
                 )
+                try:
+                    await self._resources_dirty.wait()
+                finally:
+                    hb.cancel()
+            if debounce > 0 and self._resources_dirty.is_set():
+                # Debounce on the wakeup path too: a lease cycle dirties the
+                # ledger twice (grant, then release milliseconds later) —
+                # reporting immediately on the first wake would send two
+                # UpdateResources per lease where one suffices.
+                await asyncio.sleep(debounce)
+            self._resources_dirty.clear()
+            self._tel_node_util.set(self._local_util())
+            self._report_version += 1
+            payload = {
+                "node_id": self.node_id,
+                "available": self.available.to_units(),
+                "total": self.total.to_units(),
+                "version": self._report_version,
+            }
+            # Steady state: reports ride as pushes — no reply frame, no
+            # caller future, no timeout timer (the reference syncer's
+            # ack-free stream). Safe because each report is the FULL
+            # versioned resource state: a lost push is superseded by the
+            # next report or the 1s idle heartbeat, and the GCS drops
+            # out-of-order versions. Only when the link is down do we fall
+            # back to gcs.call, whose retry machinery redials.
+            try:
+                conn = self.gcs.conn
+                if conn is not None and not conn.closed:
+                    conn.push_nowait("UpdateResources", payload)
+                    continue
+            except rpc.ConnectionLost:
+                pass
+            try:
+                await self.gcs.call("UpdateResources", payload)
             except rpc.RpcError:
                 logger.warning("gcs unreachable from raylet %s", self.node_id[:8])
                 await asyncio.sleep(1.0)
 
     def _mark_dirty(self) -> None:
+        # The node-util gauge refreshes in the report loop (once per
+        # debounced report), not here: grant/release each mark dirty and
+        # recomputing the max-ratio scan twice per lease is avoidable work
+        # on the hot path.
         self._resources_dirty.set()
-        self._tel_node_util.set(self._local_util())
 
     # -- worker pool ---------------------------------------------------------
 
@@ -1303,6 +1347,89 @@ class Raylet:
         units[f"bundle_group_{pg_id}"] = 1
         return ResourceSet.from_units(units)
 
+    # -- lease fast path (sync handlers, no dispatch task) -------------------
+
+    def _lease_slow_path(self, conn, msgid, method: str, p: dict) -> None:
+        """Hand a lease request the sync fast path cannot settle to the
+        registered async handler, in its own dispatch task — exactly what
+        rpc._on_message would have done had no sync handler existed. The
+        ambient deadline/trace the sync dispatch established are re-read
+        here and threaded through, so budgets and spans are unchanged."""
+        rpc.spawn(  # rpc-flow: disable=unsupervised-spawn
+            conn._dispatch(
+                msgid, method, p, rpc.current_deadline(), rpc.current_trace_ctx()
+            )
+        )
+
+    def _request_worker_lease_sync(self, conn, msgid, p) -> None:
+        """Inline grant: the common case — an unconstrained lease that fits
+        local resources with an idle (or sim) worker on hand and an empty
+        queue — commits and replies without creating a single task. The
+        semantic fast-path conditions mirror the async handler's
+        local-grant route bit for bit: no strategy/locality/PG/spillback
+        input (so no policy decision), hybrid policy would stay local
+        (fits + util at or below the spread threshold), FIFO preserved
+        (pending queue empty), no duplicate ledger hit, and no trace
+        context (traced requests take the slow path so lease-lifecycle
+        spans keep their exact shape). Everything else falls through to
+        the async handler unchanged."""
+        if (
+            self.pending_leases
+            or p.get("strategy")
+            or p.get("locality")
+            or p.get("spilled_from")
+            or p.get("pg_id")
+            or self._is_duplicate_grant(p["lease_id"])
+            or rpc.current_trace_ctx() is not None
+        ):
+            self._lease_slow_path(conn, msgid, "RequestWorkerLease", p)
+            return
+        demand = ResourceSet.from_units(p.get("resources") or {})
+        if not (
+            demand.is_subset_of(self.available)
+            and self._local_util() <= config.scheduler_spread_threshold
+        ):
+            self._lease_slow_path(conn, msgid, "RequestWorkerLease", p)
+            return
+        handle = None
+        while self.idle_workers:
+            h = self.idle_workers.pop()
+            if h.worker_id in self.workers and h.conn and not h.conn.closed:
+                handle = h
+                break
+        if handle is None:
+            if self.sim_workers:
+                handle = self._make_sim_worker()
+            else:
+                # Would need to spawn a worker process: async territory.
+                self._lease_slow_path(conn, msgid, "RequestWorkerLease", p)
+                return
+        lease_id = p["lease_id"]
+        self.available = self.available - demand
+        self._mark_dirty()
+        self._record_granted(lease_id)
+        handle.lease_id = lease_id
+        handle.demand = demand  # type: ignore[attr-defined]
+        handle.leased_since = time.monotonic()  # type: ignore[attr-defined]
+        handle.job_id = p.get("job_id") or handle.job_id
+        self.leases[lease_id] = handle
+        self._tel_refresh_gauges()
+        self._tel_grant_latency.observe(0.0)
+        conn.reply_nowait(
+            msgid, "RequestWorkerLease", self._grant_reply(handle, lease_id)
+        )
+
+    def _return_worker_sync(self, conn, msgid, p) -> None:
+        """ReturnWorker is synchronous end to end (ledger flip, resource
+        refund, idle-pool push): reply inline, skip the dispatch task."""
+        self._release_lease(p["lease_id"], p.get("dirty", False))
+        conn.reply_nowait(msgid, "ReturnWorker", {"ok": True})
+
+    def _cancel_worker_lease_sync(self, conn, msgid, p) -> None:
+        conn.reply_nowait(
+            msgid, "CancelWorkerLease", self._cancel_lease_inline(p["lease_id"])
+        )
+
     async def _request_worker_lease(self, conn, p):
         if self._is_duplicate_grant(p["lease_id"]):
             # Duplicate of a lease this raylet already committed to granting
@@ -1548,7 +1675,9 @@ class Raylet:
         return util
 
     def _local_util(self) -> float:
-        return self._node_util(self.total.to_units(), self.available.to_units())
+        # Read the unit dicts directly (no defensive copies): _node_util
+        # only iterates, and this runs once per grant on the fast path.
+        return self._node_util(self.total._units, self.available._units)
 
     async def _policy_pick(self, demand: ResourceSet, strategy: dict):
         """Pick a remote target per policy, or None to queue locally.
@@ -1709,7 +1838,9 @@ class Raylet:
         """Cancel a queued (ungranted) lease request: the surplus-request
         drain that keeps recycled-lease pools from pinning the raylet queue
         (reference: NodeManagerService CancelWorkerLease)."""
-        lease_id = p["lease_id"]
+        return self._cancel_lease_inline(p["lease_id"])
+
+    def _cancel_lease_inline(self, lease_id: str) -> dict:
         if self.granted_lease_ids.get(lease_id):
             # Already committed to granting: too late to cancel. Any queued
             # duplicate of this id mirrors the grant reply instead — setting
@@ -1982,14 +2113,40 @@ class Raylet:
         else:  # caller gave up; return resources
             self._release_lease(req.lease_id, dirty=False)
 
+    # Pre-packed grant-reply skeleton: the five keys (and the constant
+    # granted=true) of every grant reply, packed once at import. Each grant
+    # splices only its per-lease values between the skeleton segments —
+    # byte-identical to msgpack-packing the dict (insertion order below
+    # matches the segment order), as tests/test_fastpath_native.py asserts.
+    _GRANT_SKEL = (
+        b"\x85" + rpc._packb("granted") + b"\xc3" + rpc._packb("worker_id"),
+        rpc._packb("worker_addr"),
+        rpc._packb("lease_id"),
+        rpc._packb("fp_port"),
+    )
+
     def _grant_reply(self, handle: WorkerHandle, lease_id: str) -> dict:
-        return {
+        worker_addr = list(handle.addr)
+        mapping = {
             "granted": True,
             "worker_id": handle.worker_id,
-            "worker_addr": list(handle.addr),
+            "worker_addr": worker_addr,
             "lease_id": lease_id,
             "fp_port": handle.fp_port,
         }
+        skel = self._GRANT_SKEL
+        try:
+            raw = b"".join(
+                (
+                    skel[0], rpc._packb(handle.worker_id),
+                    skel[1], rpc._packb(worker_addr),
+                    skel[2], rpc._packb(lease_id),
+                    skel[3], rpc._packb(handle.fp_port),
+                )
+            )
+        except Exception:  # unpackable oddity: let the frame packer handle it
+            return mapping
+        return rpc.PackedPayload(mapping, raw)
 
     def _return_worker_to_pool(self, handle: WorkerHandle) -> None:
         """Return a worker acquired for a grant that will not happen (the
@@ -3201,4 +3358,5 @@ async def main() -> None:
 
 if __name__ == "__main__":
     logging.basicConfig(level=logging.INFO)
+    rpc.install_event_loop()
     asyncio.run(main())

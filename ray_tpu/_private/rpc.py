@@ -108,6 +108,10 @@ _KIND_BLOB_REP = 5
 
 _MAX_FRAME = 1 << 31
 
+# msgpack fixarray headers (frames are 4-6 slots, always < 16): used when
+# splicing a PackedPayload into a hand-assembled frame.
+_FIXARRAY = [bytes([0x90 | i]) for i in range(16)]
+
 # Per-kind frame/byte counters, cells bound once at import (indexable by the
 # wire kind, so the send/receive hot paths do one list index + float add).
 # Blob kinds count the sidecar bytes too — the data plane is the point.
@@ -272,7 +276,17 @@ def pack_push(method: str, payload: Any = None) -> Optional[bytes]:
     schedule must see (and be able to drop/delay) every individual frame."""
     if _send_interceptor is not None:
         return None
-    return _packb([0, _KIND_PUSH, method, payload])
+    frame = [0, _KIND_PUSH, method, payload]
+    if method in _native_methods():
+        if _NATIVE_WIRE is not None:
+            try:
+                packed = _NATIVE_WIRE.pack_frame(frame)
+                _TEL_NATIVE_PACK.inc()
+                return packed
+            except Exception:
+                pass  # unexpected payload shape: fall through to msgpack
+        _TEL_FALLBACK_PACK.inc()
+    return _packb(frame)
 
 
 # Sentinel error string delivered to call_cb callbacks on connection loss
@@ -325,6 +339,119 @@ def _typed_error(payload) -> RpcError:
 
 
 _packb = msgpack.Packer(use_bin_type=True, autoreset=True).pack
+
+
+# ---------------------------------------------------------------------------
+# Native wire codec (src/fastpath.cc, ray_tpu._native._fastpath).
+#
+# The hottest schemas — registered per-method in wire.NATIVE_WIRE_SCHEMAS —
+# are packed by a C encoder that emits byte-identical msgpack (the parity
+# fuzz in tests/test_fastpath_native.py holds both directions), and the
+# whole inbound stream is decoded by a C streaming decoder with the same
+# feed()/iterate/tell() surface as msgpack.Unpacker. Three ways back to the
+# pure-Python path: the .so is absent (source checkout, masked import),
+# RAY_TPU_NATIVE_WIRE=0, or the compiled schema versions disagree with
+# wire.py (a drift the `wire-native-drift` lint rule catches at review
+# time; the runtime check keeps a stale .so safe anyway).
+# ---------------------------------------------------------------------------
+
+_NATIVE_WIRE = None
+if os.environ.get("RAY_TPU_NATIVE_WIRE", "1") != "0":  # pragma: no branch
+    try:
+        from ray_tpu._native import _fastpath as _native_mod
+
+        if hasattr(_native_mod, "pack_frame") and hasattr(_native_mod, "Decoder"):
+            _NATIVE_WIRE = _native_mod
+    except Exception:  # pragma: no cover - source checkout without the .so
+        _NATIVE_WIRE = None
+
+# Methods eligible for native pack: resolved lazily from wire.py (rpc.py is
+# the bottom of the import graph and cannot import wire at module load).
+# None = not resolved yet; frozenset once resolved.
+_NATIVE_METHODS: Optional[frozenset] = None
+
+
+def _native_methods() -> frozenset:
+    global _NATIVE_METHODS
+    if _NATIVE_METHODS is None:
+        try:
+            from ray_tpu._private import wire  # lazy: avoid import cycle
+
+            _NATIVE_METHODS = wire.native_method_set(_NATIVE_WIRE)
+        except Exception:  # pragma: no cover - wire must stay importable
+            logger.exception("native wire schema resolution failed")
+            _NATIVE_METHODS = frozenset()
+    return _NATIVE_METHODS
+
+
+def native_wire_active() -> bool:
+    """True when the C codec is loaded and at least one schema is bound."""
+    return _NATIVE_WIRE is not None and bool(_native_methods())
+
+
+_TEL_NATIVE_PACK = telemetry.counter(
+    "rpc", "native_pack", "frames packed by the native (C) wire codec"
+)
+_TEL_FALLBACK_PACK = telemetry.counter(
+    "rpc",
+    "fallback_pack",
+    "native-registered frames packed by Python msgpack instead "
+    "(.so absent, RAY_TPU_NATIVE_WIRE=0, or a pack error)",
+)
+_TEL_BATCH_SIZE = telemetry.histogram(
+    "rpc",
+    "lease_batch_size",
+    "entries coalesced per flushed lease batch (1 = singleton fast frame)",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+)
+
+
+class PackedPayload(dict):
+    """A payload carrying its own msgpack bytes, spliced verbatim into the
+    frame by ``_pack_frame`` — the grant fan-out hot path: a raylet
+    granting N queued leases packs the common reply skeleton once and
+    patches per-lease fields, instead of paying a full dict encode per
+    grant. Subclasses dict so in-process consumers (explorer scenarios,
+    tests that call handlers directly) read it like the payload it encodes;
+    ``raw`` MUST be exactly one msgpack value encoding the same mapping,
+    and the mapping must not be mutated after construction (the bytes
+    would go stale)."""
+
+    __slots__ = ("raw",)
+
+    def __init__(self, mapping: dict, raw: bytes):
+        super().__init__(mapping)
+        self.raw = raw
+
+
+def _cancel_for_timeout(fut: asyncio.Future) -> None:
+    """Deadline timer callback for Connection.call: mark-then-cancel so the
+    awaiter can tell a timeout from a caller cancellation."""
+    if not fut.done():
+        fut.rpc_timed_out = True
+        fut.cancel()
+
+
+def install_event_loop() -> str:
+    """Install the event-loop policy named by ``config.rpc_event_loop``.
+
+    Returns the name actually in effect. "uvloop" requires the package;
+    when it is not importable (this tree does not vendor it) the stock
+    asyncio policy stays installed and a log line records the fallback, so
+    the knob is safe to flip in config without a hard dependency."""
+    choice = getattr(config, "rpc_event_loop", "asyncio")
+    if choice == "uvloop":
+        try:
+            import uvloop  # type: ignore
+
+            uvloop.install()
+            return "uvloop"
+        except ImportError:
+            logger.info(
+                "rpc_event_loop=uvloop requested but uvloop is not "
+                "installed; using asyncio"
+            )
+    return "asyncio"
 
 
 # ---------------------------------------------------------------------------
@@ -471,15 +598,24 @@ class RetryPolicy:
         )
 
 
+def _new_unpacker():
+    """Streaming frame decoder: the native C decoder when loaded (same
+    feed()/iterate/tell() surface, byte-identical results), else msgpack's.
+    One per connection, plus a fresh one at every blob-mode switch."""
+    if _NATIVE_WIRE is not None:
+        return _NATIVE_WIRE.Decoder()
+    return msgpack.Unpacker(
+        raw=False, strict_map_key=False, max_buffer_size=_MAX_FRAME
+    )
+
+
 class _RpcProtocol(asyncio.Protocol):
     """Transport glue: buffers writes per loop tick, streams reads through a
     msgpack Unpacker, and forwards complete messages to the Connection."""
 
     def __init__(self, conn: "Connection"):
         self._conn = conn
-        self._unpacker = msgpack.Unpacker(
-            raw=False, strict_map_key=False, max_buffer_size=_MAX_FRAME
-        )
+        self._unpacker = _new_unpacker()
         self.transport: Optional[asyncio.Transport] = None
         self._paused = False
         self._drain_waiters: list = []
@@ -516,6 +652,20 @@ class _RpcProtocol(asyncio.Protocol):
     def data_received(self, data: bytes) -> None:
         _TEL_BYTES_IN.inc(len(data))
         view = memoryview(data)
+        conn = self._conn
+        # Replies produced while we dispatch this chunk (sync handlers
+        # answering inline) are flushed once at the end of the read instead
+        # of via a call_soon per reply: same coalescing, one less loop
+        # callback per request on the server hot path.
+        conn._in_read = True
+        try:
+            self._feed(view)
+        finally:
+            conn._in_read = False
+            if conn._out and not conn._flush_scheduled and not conn._closed:
+                conn._flush()
+
+    def _feed(self, view) -> None:
         try:
             while True:
                 if self._blob_remaining > 0:
@@ -547,9 +697,7 @@ class _RpcProtocol(asyncio.Protocol):
                         # Unpacker (its buffer holds those same bytes), and
                         # switch to blob mode.
                         tail = self._fed - self._unpacker.tell()
-                        self._unpacker = msgpack.Unpacker(
-                            raw=False, strict_map_key=False, max_buffer_size=_MAX_FRAME
-                        )
+                        self._unpacker = _new_unpacker()
                         self._fed = 0
                         self._begin_blob(list(msg))
                         view = view[view.nbytes - tail :]
@@ -623,6 +771,17 @@ class Connection:
         self._protocol = _RpcProtocol(self)
         self._out: list = []
         self._flush_scheduled = False
+        # True while data_received is dispatching inbound frames on this
+        # connection: replies queued during the read are flushed at its end
+        # (no call_soon per reply).
+        self._in_read = False
+        # Lease-batch coalescing (call_batched_nowait): entries queued for
+        # the next flush tick. Each entry is [msgid, method, payload,
+        # absolute_deadline|None, [trace_id, span_id]|None]; per-entry
+        # msgids keep dedup tokens, cancellation, and chaos faults
+        # operating per-lease inside the coalesced frame.
+        self._batch_entries: list = []
+        self._batch_scheduled = False
         # Arbitrary per-connection state daemons can attach (e.g. worker id).
         self.context: Dict[str, Any] = {}
         # The logical (host, port) this connection was dialed to; set by
@@ -662,11 +821,48 @@ class Connection:
             _TEL_FRAMES_OUT[kind].inc()
             _TEL_BYTES_OUT[kind].inc(len(out[0]) + total)
             return out
-        if len(msg) > 4 and msg[4] is not None:
+        method = msg[2]
+        if kind == _KIND_PUSH and method == "LeaseBatch":
+            # Per-entry deadlines are absolute loop instants in memory;
+            # stamp each into a relative TTL at pack time on a copy — the
+            # same honesty rule as the frame-level slot, so a batch a chaos
+            # schedule delays ships with every entry's budget already
+            # shrunk (the in-memory frame keeps absolute instants and a
+            # re-send re-stamps them).
+            now = self._loop.time()
+            entries = [
+                [e[0], e[1], e[2], None if e[3] is None else e[3] - now, e[4]]
+                for e in msg[3]["entries"]
+            ]
+            msg = [msg[0], kind, method, {"entries": entries}]
+        elif len(msg) > 4 and msg[4] is not None:
             # Rebuild in place so a trailing trace-context slot survives.
             msg = list(msg)
             msg[4] = msg[4] - self._loop.time()
-        packed = _packb(msg)
+        payload = msg[3]
+        if type(payload) is PackedPayload:
+            # Splice pre-packed payload bytes into the frame: fixarray
+            # header + per-slot packs around the raw value. The grant
+            # fan-out path pays one skeleton pack for N replies.
+            parts = [_FIXARRAY[len(msg)], _packb(msg[0]), _packb(kind),
+                     _packb(method), payload.raw]
+            for extra in msg[4:]:
+                parts.append(_packb(extra))
+            packed = b"".join(parts)
+        else:
+            packed = None
+            nm = _NATIVE_METHODS
+            if method in (nm if nm is not None else _native_methods()):
+                if _NATIVE_WIRE is not None:
+                    try:
+                        packed = _NATIVE_WIRE.pack_frame(msg)
+                        _TEL_NATIVE_PACK.inc()
+                    except Exception:
+                        packed = None
+                if packed is None:
+                    _TEL_FALLBACK_PACK.inc()
+            if packed is None:
+                packed = _packb(msg)
         _TEL_FRAMES_OUT[kind].inc()
         _TEL_BYTES_OUT[kind].inc(len(packed))
         return [packed]
@@ -689,7 +885,7 @@ class Connection:
             # the duration of this call: hand them to the transport NOW (an
             # unwritable socket copies them into asyncio's own buffer).
             self._flush()
-        elif not self._flush_scheduled:
+        elif not self._flush_scheduled and not self._in_read:
             self._flush_scheduled = True
             self._loop.call_soon(self._flush)
 
@@ -702,7 +898,7 @@ class Connection:
         self._out.extend(self._pack_frame(msg))
         if msg[1] == _KIND_BLOB or msg[1] == _KIND_BLOB_REP:
             self._flush()
-        elif not self._flush_scheduled:
+        elif not self._flush_scheduled and not self._in_read:
             self._flush_scheduled = True
             self._loop.call_soon(self._flush)
 
@@ -808,17 +1004,123 @@ class Connection:
         frame as a TTL so every downstream hop sees it shrink."""
         deadline = self._effective_deadline(timeout)
         fut = self.call_nowait(method, payload, deadline=deadline)
-        try:
-            if deadline is None:
+        return await self._await_reply(fut, deadline)
+
+    async def _await_reply(self, fut: asyncio.Future, deadline: Optional[float]):
+        """Await a reply future under an absolute deadline. The timeout is
+        a loop timer (mark-then-cancel), NOT asyncio.wait_for: wait_for
+        wraps every call in an extra waiter task, which at lease rates is
+        the single largest source of event-loop churn — a timer costs one
+        heap entry and nothing more on the (common) in-time reply."""
+        if deadline is None:
+            try:
                 return await fut
-            return await asyncio.wait_for(
-                fut, max(0.0, deadline - self._loop.time())
-            )
+            finally:
+                if fut.cancelled():
+                    self._pending.pop(fut.rpc_msgid, None)
+        timer = self._loop.call_at(deadline, _cancel_for_timeout, fut)
+        try:
+            return await fut
+        except asyncio.CancelledError:
+            if getattr(fut, "rpc_timed_out", False):
+                raise asyncio.TimeoutError() from None
+            raise
         finally:
+            timer.cancel()
             # On timeout or caller cancellation the reply will never be
             # consumed; drop the entry so the pending table doesn't leak.
             if fut.cancelled():
                 self._pending.pop(fut.rpc_msgid, None)
+
+    # -- batched lease frames ------------------------------------------------
+
+    def call_batched_nowait(
+        self, method: str, payload: Any = None, deadline: Optional[float] = None
+    ) -> asyncio.Future:
+        """Like ``call_nowait``, but the request coalesces with every other
+        batched call issued on this connection in the same event-loop tick
+        into one ``LeaseBatch`` frame (one pack + one write for N lease
+        ops). Entries keep their own msgid, deadline, and trace context, so
+        dedup/cancellation/chaos semantics are per-lease; the receiving
+        rpc layer re-injects each entry through normal request dispatch.
+        Until the flush tick runs the entry can be withdrawn with
+        ``try_cancel_batched`` (a cancel for a frame that never went out
+        must not reach the wire). Loop thread only."""
+        if self._closed:
+            raise ConnectionLost("connection closed")
+        msgid = next(self._msgid)
+        fut = self._loop.create_future()
+        fut.rpc_msgid = msgid
+        self._pending[msgid] = fut
+        tctx = _trace_ctx.get()
+        self._batch_entries.append(
+            [msgid, method, payload, deadline,
+             None if tctx is None else [tctx[0], tctx[1]]]
+        )
+        if not self._batch_scheduled:
+            self._batch_scheduled = True
+            self._loop.call_soon(self._flush_batch)
+        return fut
+
+    async def call_batched(
+        self, method: str, payload: Any = None, timeout: Optional[float] = None
+    ):
+        """Batched counterpart of ``call``: enqueue into this tick's lease
+        batch and await the per-entry reply."""
+        deadline = self._effective_deadline(timeout)
+        fut = self.call_batched_nowait(method, payload, deadline=deadline)
+        return await self._await_reply(fut, deadline)
+
+    def try_cancel_batched(self, msgid: int) -> bool:
+        """Withdraw a batched request that has NOT been flushed yet.
+        Returns True when the entry was still queued locally: it is removed
+        from the pending batch and its future is cancelled, and the caller
+        must NOT send a wire cancel (the request never existed remotely).
+        False means the batch already went out — cancel over the wire as
+        usual. Loop thread only."""
+        entries = self._batch_entries
+        for i, entry in enumerate(entries):
+            if entry[0] == msgid:
+                del entries[i]
+                fut = self._pending.pop(msgid, None)
+                if fut is not None and not fut.done():
+                    fut.cancel()
+                return True
+        return False
+
+    def _flush_batch(self) -> None:
+        self._batch_scheduled = False
+        entries = self._batch_entries
+        if not entries or self._closed:
+            # Everything was withdrawn pre-flush, or the link died
+            # (teardown already failed the pending futures).
+            return
+        self._batch_entries = []
+        _TEL_BATCH_SIZE.observe(len(entries))
+        try:
+            if len(entries) == 1:
+                # Singleton: a plain request frame is cheaper than a
+                # 1-entry batch and semantically identical.
+                mid, method, payload, deadline, tctx = entries[0]
+                frame = [mid, _KIND_REQ, method, payload]
+                if deadline is not None or tctx is not None:
+                    frame.append(deadline)
+                if tctx is not None:
+                    frame.append(tctx)
+                self._send_nowait(frame)
+            else:
+                self._send_nowait(
+                    [0, _KIND_PUSH, "LeaseBatch", {"entries": entries}]
+                )
+        except ConnectionLost:
+            pass  # teardown already failed every pending future
+
+    @property
+    def write_paused(self) -> bool:
+        """True while the transport has backpressured writes (high-water
+        mark hit). Broadcast fan-out uses this to decide between an inline
+        write and a backpressure-aware drain task."""
+        return self._protocol._paused
 
     def push_nowait(self, method: str, payload: Any = None) -> None:
         """One-way message; no reply expected. Loop thread only."""
@@ -834,9 +1136,21 @@ class Connection:
         _TEL_FRAMES_OUT[_KIND_PUSH].inc()
         _TEL_BYTES_OUT[_KIND_PUSH].inc(len(packed))
         self._out.append(packed)
-        if not self._flush_scheduled:
+        if not self._flush_scheduled and not self._in_read:
             self._flush_scheduled = True
             self._loop.call_soon(self._flush)
+
+    def push_packed_now(self, packed: bytes) -> None:
+        """``push_packed_nowait`` + immediate transport write. Broadcast
+        fan-out sends exactly one frame per subscriber per round — there is
+        nothing to coalesce, so the per-connection flush callback is pure
+        overhead (N loop callbacks per round at N subscribers)."""
+        if self._closed:
+            raise ConnectionLost("connection closed")
+        _TEL_FRAMES_OUT[_KIND_PUSH].inc()
+        _TEL_BYTES_OUT[_KIND_PUSH].inc(len(packed))
+        self._out.append(packed)
+        self._flush()
 
     async def push(self, method: str, payload: Any = None) -> None:
         self._send_nowait([0, _KIND_PUSH, method, payload])
@@ -1011,6 +1325,24 @@ class Connection:
                 return
             spawn(self._dispatch(msgid, method, payload, deadline, tctx))
         elif kind == _KIND_PUSH:
+            if method == "LeaseBatch":
+                # Unbundle: re-inject every entry as its own request frame
+                # through this same dispatch path, so per-entry TTL shed,
+                # sync fast-path handlers, dedup ledgers, and trace context
+                # all behave exactly as for unbatched frames. The N replies
+                # coalesce back into one write on the next flush tick.
+                for e in payload["entries"]:
+                    self._on_message([e[0], _KIND_REQ, e[1], e[2], e[3], e[4]])
+                return
+            sync_h = self._sync_handlers.get(method)
+            if sync_h is not None:
+                # Push fast path: no task per broadcast delivery. The
+                # handler gets msgid=None (pushes have no reply).
+                try:
+                    sync_h(self, None, payload)
+                except Exception:
+                    logger.exception("sync push handler %s failed", method)
+                return
             spawn(self._dispatch(None, method, payload))
         else:
             cb = self._cb_pending.pop(msgid, None)
@@ -1134,6 +1466,7 @@ class Connection:
             return
         self._closed = True
         self._out.clear()
+        self._batch_entries.clear()
         # Fail the mid-stream blob (the sink may hold a partially-written
         # arena span: done(False) lets it abort/quarantine) and any sinks
         # still waiting for a blob reply.

@@ -270,6 +270,16 @@ SCHEMAS: Dict[str, WireSchema] = {
         ["lease_id"], ["dirty"], retry=RETRY_DEDUP, dedup_key="lease_id",
         trace=False, errors=(),
     ),
+    # Per-tick coalesced lease traffic (rpc.call_batched_nowait): one push
+    # frame carries every RequestWorkerLease/ReturnWorker/CancelWorkerLease
+    # a client issued to one raylet in one event-loop tick, as
+    # ``[msgid, method, payload, ttl, trace]`` entries. Entries keep their
+    # own msgids, dedup tokens, deadlines, and trace context — the
+    # receiving rpc layer re-injects each through normal request dispatch,
+    # so retry/dedup semantics are those of the inner methods and the
+    # batch frame itself is never retried as a unit. Trace context rides
+    # per entry, hence trace=False for the envelope.
+    "LeaseBatch": _s(["entries"], trace=False, errors=()),
     # Deduped on spec.actor_id ("actor:<id>" lease ids) via the raylet's
     # actor_creations_in_flight set + grant ledger.
     "LeaseWorkerForActor": _s(
@@ -356,3 +366,71 @@ def retry_class(method: str, default: str = RETRY_NONE) -> Tuple[str, Optional[s
     if schema is None:
         return default, None
     return schema.retry, schema.dedup_key
+
+
+# ---------------------------------------------------------------------------
+# Native-codec schema registry (src/fastpath.cc `pack_frame`/`Decoder`).
+#
+# Methods listed here are packed by the C msgpack encoder on the hot path
+# (rpc._pack_frame / rpc.pack_push); everything else — and every frame when
+# the .so is absent or RAY_TPU_NATIVE_WIRE=0 — takes the pure-Python
+# msgpack path. The encoder is generic (it emits byte-identical msgpack
+# for any payload; the parity fuzz in tests/test_fastpath_native.py is the
+# proof), so this registry is a *versioning contract*, not a field-layout
+# table: ``fields`` mirrors the method's SCHEMAS entry (checked at import
+# below) and ``version`` must match the `NATIVE_WIRE_SCHEMA` marker
+# compiled into src/fastpath.cc (checked at runtime via
+# ``schema_versions()``, and at review time by the rpc_check
+# `wire-native-drift` rule). Changing a native method's field list
+# therefore forces three synchronized edits — SCHEMAS, this table (fields
+# + version bump), and the fastpath.cc marker — or lint fails.
+#
+# Reply frames reuse the request's method name, so registering a method
+# covers its replies too (the "lease replies" of the grant fan-out path).
+# ---------------------------------------------------------------------------
+
+NATIVE_WIRE_SCHEMAS: Dict[str, Tuple[int, Tuple[str, ...]]] = {
+    "RequestWorkerLease": (1, (
+        "bundle_index", "job_id", "lease_id", "locality", "pg_id",
+        "resources", "spilled_from", "strategy",
+    )),
+    "ReturnWorker": (1, ("dirty", "lease_id")),
+    "CancelWorkerLease": (1, ("lease_id",)),
+    "LeaseBatch": (1, ("entries",)),
+    "PubBatch": (1, ("items",)),
+}
+
+for _m, (_v, _fields) in NATIVE_WIRE_SCHEMAS.items():
+    _schema = SCHEMAS.get(_m)
+    if _schema is None:
+        raise AssertionError(f"native wire schema {_m!r} missing from SCHEMAS")
+    _declared = tuple(sorted(_schema.required | _schema.optional))
+    if tuple(sorted(_fields)) != _declared:
+        raise AssertionError(
+            f"NATIVE_WIRE_SCHEMAS[{_m!r}] fields {sorted(_fields)} drifted "
+            f"from SCHEMAS {list(_declared)}: update the fields tuple, bump "
+            "its version here, and bump the matching NATIVE_WIRE_SCHEMA "
+            "marker in src/fastpath.cc"
+        )
+del _m, _v, _fields, _schema, _declared
+
+
+def native_method_set(native_mod=None) -> FrozenSet[str]:
+    """Methods eligible for native pack on this process.
+
+    With ``native_mod`` (the loaded ``_fastpath`` module), only methods
+    whose compiled schema version matches this registry qualify — a stale
+    .so silently falls back per-method instead of shipping frames packed
+    under an outdated contract. With ``native_mod=None`` (no .so), the
+    full declared set is returned so the caller can still count fallback
+    packs against it."""
+    if native_mod is None:
+        return frozenset(NATIVE_WIRE_SCHEMAS)
+    try:
+        versions = native_mod.schema_versions()
+    except Exception:
+        return frozenset()
+    return frozenset(
+        m for m, (v, _f) in NATIVE_WIRE_SCHEMAS.items()
+        if versions.get(m) == v
+    )

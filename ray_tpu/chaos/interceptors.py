@@ -77,6 +77,12 @@ class ChaosInterceptor:
             frame_class = _frame_class(msg)
         except Exception:
             return False
+        if method == "LeaseBatch" and msg[1] == 3 and isinstance(msg[3], dict):
+            # A lease batch is a transport envelope, not a lease op: faults
+            # target the entries it carries, so dedup/cancel/exactly-once
+            # invariants are exercised per lease exactly as they were when
+            # each op rode its own frame.
+            return self._intercept_batch(conn, msg)
         spec = self._match(method, frame_class)
         if spec is None:
             return False
@@ -106,6 +112,83 @@ class ChaosInterceptor:
                 held[0]._send_direct(held[1])
             self._held[spec.name] = (conn, msg)
             return True
+        return False
+
+    @staticmethod
+    def _entry_frame(entry: list) -> list:
+        """Re-expand one batch entry ``[msgid, method, payload, deadline,
+        tctx]`` into the singleton request frame ``_flush_batch`` would have
+        sent for it — the form delayed/duplicated/reordered copies travel
+        in (the pack layer re-derives the wire TTL from the absolute
+        deadline at actual send time, so a delayed entry's budget keeps
+        shrinking while it is held)."""
+        msgid, method, payload, deadline, tctx = entry
+        frame = [msgid, 0, method, payload]
+        if deadline is not None or tctx is not None:
+            frame.append(deadline)
+        if tctx is not None:
+            frame.append(tctx)
+        return frame
+
+    def _intercept_batch(self, conn: rpc.Connection, msg: list) -> bool:
+        """Apply the schedule to each LeaseBatch entry independently:
+        surviving entries are repacked into the (mutated in place) batch;
+        dropped ones vanish; delayed/duplicated/reordered ones leave the
+        batch and travel as singleton request frames via the
+        interceptor-bypassing ``_send_direct``. Consuming every entry
+        consumes the whole frame."""
+        entries = msg[3].get("entries") or []
+        survivors: List[list] = []
+        changed = False
+        for entry in entries:
+            emethod = entry[1]
+            spec = self._match(emethod, "request")
+            if spec is None:
+                survivors.append(entry)
+                continue
+            idx = self._match_counts[spec.name]
+            self._match_counts[spec.name] = idx + 1
+            action = self.schedule.decision(spec.name, idx)
+            if action is None:
+                held = self._held.pop(spec.name, None)
+                if held is not None:
+                    # Adjacent swap across the batch boundary: this entry
+                    # goes first (as a singleton), the held frame behind it.
+                    conn._send_direct(self._entry_frame(entry))
+                    held[0]._send_direct(held[1])
+                    changed = True
+                    continue
+                survivors.append(entry)
+                continue
+            self.log.record(FaultEvent(spec.name, idx, action, emethod, 0))
+            kind = action[0]
+            if kind == "drop":
+                changed = True
+                continue
+            if kind == "delay":
+                timer = conn._loop.call_later(
+                    action[1], conn._send_direct, self._entry_frame(entry)
+                )
+                self._timers.append(timer)
+                changed = True
+                continue
+            if kind == "dup":
+                conn._send_direct(self._entry_frame(entry))
+                survivors.append(entry)
+                continue
+            if kind == "reorder":
+                held = self._held.pop(spec.name, None)
+                if held is not None:
+                    held[0]._send_direct(held[1])
+                self._held[spec.name] = (conn, self._entry_frame(entry))
+                changed = True
+                continue
+            survivors.append(entry)
+        if not changed:
+            return False
+        if not survivors:
+            return True
+        msg[3]["entries"] = survivors
         return False
 
     def _passthrough_reorder(

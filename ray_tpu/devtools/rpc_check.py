@@ -42,7 +42,7 @@ Non-literal method names (e.g. the dashboard's generic proxy
 ``conn.call(method, ...)``) are outside the static horizon and skipped.
 Suppression: ``# aio-lint: disable=<rule>`` with rules
 ``unknown-rpc-method``, ``orphan-rpc-handler``, ``payload-key-drift``,
-``rpc-magic-timeout``, ``wire-trace-undeclared``.
+``rpc-magic-timeout``, ``wire-trace-undeclared``, ``wire-native-drift``.
 
 Run: ``python -m ray_tpu.devtools.rpc_check [--markdown] [paths]``.
 """
@@ -68,6 +68,7 @@ RULE_ORPHAN = "orphan-rpc-handler"
 RULE_DRIFT = "payload-key-drift"
 RULE_TIMEOUT = "rpc-magic-timeout"
 RULE_TRACE = "wire-trace-undeclared"
+RULE_NATIVE = "wire-native-drift"
 
 _CALL_METHODS = {
     "call",
@@ -500,6 +501,7 @@ def check(
     findings.extend(_check_payload_drift(inv))
     findings.extend(_check_magic_timeouts(inv, rpc_path))
     findings.extend(_check_trace_declared())
+    findings.extend(_check_native_wire_drift())
 
     # Apply inline suppressions from the source files involved.
     if not apply_suppressions:
@@ -577,6 +579,98 @@ def _check_payload_drift(inv: Inventory) -> List[Finding]:
                     "wire.py — producer/consumer drift",
                 )
             )
+    return findings
+
+
+def _fastpath_cc_path() -> str:
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(_rpc_module_path()))
+    )
+    return os.path.join(repo_root, "src", "fastpath.cc")
+
+
+def _check_native_wire_drift(cc_path: Optional[str] = None) -> List[Finding]:
+    """Every natively packed schema (wire.NATIVE_WIRE_SCHEMAS) must have a
+    matching ``// NATIVE_WIRE_SCHEMA: <Method> v<N> fields=...`` marker in
+    src/fastpath.cc with the SAME version and field list. A Python field
+    change without a C-side version bump would ship two processes that
+    pack the same method differently while both believe they match — the
+    runtime gate (schema_versions) only protects processes that agree on
+    wire.py, so the drift must die in lint."""
+    import re
+
+    from ray_tpu._private import wire
+
+    cc = cc_path or _fastpath_cc_path()
+    if not os.path.exists(cc):
+        return []  # installed distribution without the C sources
+    findings: List[Finding] = []
+    pat = re.compile(
+        r"//\s*NATIVE_WIRE_SCHEMA:\s*(\w+)\s+v(\d+)\s+fields=([\w,]*)"
+    )
+    markers: Dict[str, Tuple[int, Tuple[str, ...], int]] = {}
+    with open(cc, "r", encoding="utf-8") as fh:
+        for lineno, line in enumerate(fh, 1):
+            m = pat.search(line)
+            if m:
+                fields = tuple(sorted(f for f in m.group(3).split(",") if f))
+                markers[m.group(1)] = (int(m.group(2)), fields, lineno)
+    for method, (ver, fields) in sorted(wire.NATIVE_WIRE_SCHEMAS.items()):
+        marker = markers.pop(method, None)
+        if marker is None:
+            findings.append(
+                Finding(
+                    cc,
+                    1,
+                    0,
+                    RULE_NATIVE,
+                    f"natively packed schema {method} (wire.py v{ver}) has "
+                    "no NATIVE_WIRE_SCHEMA marker in fastpath.cc — add the "
+                    "marker (and the kWireSchemas entry) or remove the "
+                    "method from NATIVE_WIRE_SCHEMAS",
+                )
+            )
+            continue
+        cc_ver, cc_fields, lineno = marker
+        if tuple(sorted(fields)) != cc_fields:
+            findings.append(
+                Finding(
+                    cc,
+                    lineno,
+                    0,
+                    RULE_NATIVE,
+                    f"{method} field list drifted: wire.py declares "
+                    f"{sorted(fields)} but the fastpath.cc marker has "
+                    f"{list(cc_fields)} — update the marker AND bump the "
+                    "schema version on both sides",
+                )
+            )
+        elif cc_ver != ver:
+            findings.append(
+                Finding(
+                    cc,
+                    lineno,
+                    0,
+                    RULE_NATIVE,
+                    f"{method} schema version skew: wire.py v{ver} vs "
+                    f"fastpath.cc v{cc_ver} — bump both sides together "
+                    "(the runtime gate would silently fall back to the "
+                    "Python packer on every process)",
+                )
+            )
+    for method, (cc_ver, _fields, lineno) in sorted(markers.items()):
+        findings.append(
+            Finding(
+                cc,
+                lineno,
+                0,
+                RULE_NATIVE,
+                f"fastpath.cc declares native schema {method} v{cc_ver} "
+                "that wire.py does not list in NATIVE_WIRE_SCHEMAS — "
+                "stale marker, or the registry entry was dropped without "
+                "the C side",
+            )
+        )
     return findings
 
 
@@ -721,7 +815,16 @@ def markdown_table(paths: Optional[List[str]] = None) -> str:
         "budget to pass downstream (see `ray_tpu/_private/rpc.py`). Blob",
         "frames (kinds 4 and 5) put the sidecar byte length in the fifth",
         "slot instead and stream that many raw bytes after the control",
-        "frame — the data plane's zero-copy path. Request frames may also",
+        "frame — the data plane's zero-copy path. `LeaseBatch` (kind 3,",
+        "schema in `wire.py`) is a transport envelope, not a handler",
+        "method: `Connection.call_batched` coalesces every request bound",
+        "for one peer in the same event-loop tick into one push frame whose",
+        "payload is `{entries: [[msgid, method, payload, ttl?, trace_ctx?],",
+        "...]}`; the receiving read loop unpacks it and dispatches each",
+        "entry exactly as if it had arrived as its own request frame, so",
+        "per-entry msgids keep replies, cancellation, retry dedup, and",
+        "chaos fault injection addressed to individual requests (see",
+        "docs/scheduling.md \"Batched lease frames\"). Request frames may also",
         "carry a sixth element, the active trace context as",
         "`[trace_id, span_id]` — the receiver re-establishes it as the",
         "ambient span parent for the handler so runtime spans recorded on",

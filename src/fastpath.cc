@@ -488,6 +488,585 @@ void AcceptLoop(std::shared_ptr<Server> srv) {
   }
 }
 
+// ------------------------------------------------------------- wire codec
+//
+// C-level pack/unpack for the asyncio RPC stack's msgpack frames
+// (ray_tpu/_private/rpc.py). Byte-identical to
+// msgpack.Packer(use_bin_type=True) / msgpack.Unpacker(raw=False,
+// strict_map_key=False): the Python side fuzzes parity in both directions
+// (tests/test_fastpath_native.py), so any divergence is a test failure,
+// not a silent wire fork. Registered per-schema: rpc.py consults
+// schema_versions() against wire.NATIVE_WIRE_SCHEMAS and only routes a
+// method here while the versions match.
+//
+// The schema markers below are parsed by devtools/rpc_check.py
+// (wire-native-drift): editing a natively-packed schema's field list in
+// wire.py without bumping BOTH the version there and the marker (and
+// table) here fails lint.
+//
+// NATIVE_WIRE_SCHEMA: RequestWorkerLease v1 fields=bundle_index,job_id,lease_id,locality,pg_id,resources,spilled_from,strategy
+// NATIVE_WIRE_SCHEMA: ReturnWorker v1 fields=dirty,lease_id
+// NATIVE_WIRE_SCHEMA: CancelWorkerLease v1 fields=lease_id
+// NATIVE_WIRE_SCHEMA: LeaseBatch v1 fields=entries
+// NATIVE_WIRE_SCHEMA: PubBatch v1 fields=items
+
+struct WireSchema {
+  const char* method;
+  int version;
+};
+constexpr WireSchema kWireSchemas[] = {
+    {"RequestWorkerLease", 1}, {"ReturnWorker", 1}, {"CancelWorkerLease", 1},
+    {"LeaseBatch", 1},         {"PubBatch", 1},
+};
+
+constexpr size_t kMaxWireFrame = 64u << 20;  // mirrors rpc._MAX_FRAME
+
+// -- encoder --
+
+void PutBE16(std::string* out, uint16_t v) {
+  char b[2] = {static_cast<char>(v >> 8), static_cast<char>(v)};
+  out->append(b, 2);
+}
+void PutBE32(std::string* out, uint32_t v) {
+  char b[4] = {static_cast<char>(v >> 24), static_cast<char>(v >> 16),
+               static_cast<char>(v >> 8), static_cast<char>(v)};
+  out->append(b, 4);
+}
+void PutBE64(std::string* out, uint64_t v) {
+  char b[8];
+  for (int i = 0; i < 8; ++i) b[i] = static_cast<char>(v >> (56 - 8 * i));
+  out->append(b, 8);
+}
+
+// Packs one Python object; byte-for-byte what msgpack-python's C packer
+// emits for the same value. Returns false with a Python error set on
+// unsupported types (caller falls back to the Python packer).
+bool PackObj(std::string* out, PyObject* o, int depth) {
+  if (depth > 128) {
+    PyErr_SetString(PyExc_ValueError, "pack_frame: nesting too deep");
+    return false;
+  }
+  if (o == Py_None) {
+    out->push_back(static_cast<char>(0xc0));
+    return true;
+  }
+  // bool before int: Python bool subclasses int.
+  if (PyBool_Check(o)) {
+    out->push_back(static_cast<char>(o == Py_True ? 0xc3 : 0xc2));
+    return true;
+  }
+  if (PyLong_Check(o)) {
+    int overflow = 0;
+    long long v = PyLong_AsLongLongAndOverflow(o, &overflow);
+    if (overflow > 0) {
+      unsigned long long u = PyLong_AsUnsignedLongLong(o);
+      if (u == static_cast<unsigned long long>(-1) && PyErr_Occurred())
+        return false;  // > 2**64-1: OverflowError, like msgpack
+      out->push_back(static_cast<char>(0xcf));
+      PutBE64(out, u);
+      return true;
+    }
+    if (overflow < 0) {
+      PyErr_SetString(PyExc_OverflowError, "int too small for msgpack");
+      return false;
+    }
+    if (v == -1 && PyErr_Occurred()) return false;
+    if (v >= 0) {
+      if (v < 0x80) {
+        out->push_back(static_cast<char>(v));
+      } else if (v < 0x100) {
+        out->push_back(static_cast<char>(0xcc));
+        out->push_back(static_cast<char>(v));
+      } else if (v < 0x10000) {
+        out->push_back(static_cast<char>(0xcd));
+        PutBE16(out, static_cast<uint16_t>(v));
+      } else if (v < 0x100000000LL) {
+        out->push_back(static_cast<char>(0xce));
+        PutBE32(out, static_cast<uint32_t>(v));
+      } else {
+        out->push_back(static_cast<char>(0xcf));
+        PutBE64(out, static_cast<uint64_t>(v));
+      }
+    } else {
+      if (v >= -32) {
+        out->push_back(static_cast<char>(0xe0 | (v & 0x1f)));
+      } else if (v >= -128) {
+        out->push_back(static_cast<char>(0xd0));
+        out->push_back(static_cast<char>(v));
+      } else if (v >= -32768) {
+        out->push_back(static_cast<char>(0xd1));
+        PutBE16(out, static_cast<uint16_t>(v));
+      } else if (v >= -2147483648LL) {
+        out->push_back(static_cast<char>(0xd2));
+        PutBE32(out, static_cast<uint32_t>(v));
+      } else {
+        out->push_back(static_cast<char>(0xd3));
+        PutBE64(out, static_cast<uint64_t>(v));
+      }
+    }
+    return true;
+  }
+  if (PyFloat_Check(o)) {
+    double d = PyFloat_AS_DOUBLE(o);
+    uint64_t bits;
+    std::memcpy(&bits, &d, 8);
+    out->push_back(static_cast<char>(0xcb));
+    PutBE64(out, bits);
+    return true;
+  }
+  if (PyUnicode_Check(o)) {
+    Py_ssize_t len;
+    const char* s = PyUnicode_AsUTF8AndSize(o, &len);
+    if (s == nullptr) return false;
+    size_t n = static_cast<size_t>(len);
+    if (n < 32) {
+      out->push_back(static_cast<char>(0xa0 | n));
+    } else if (n < 0x100) {
+      out->push_back(static_cast<char>(0xd9));
+      out->push_back(static_cast<char>(n));
+    } else if (n < 0x10000) {
+      out->push_back(static_cast<char>(0xda));
+      PutBE16(out, static_cast<uint16_t>(n));
+    } else {
+      out->push_back(static_cast<char>(0xdb));
+      PutBE32(out, static_cast<uint32_t>(n));
+    }
+    out->append(s, n);
+    return true;
+  }
+  if (PyBytes_Check(o) || PyByteArray_Check(o) || PyMemoryView_Check(o)) {
+    Py_buffer view;
+    if (PyObject_GetBuffer(o, &view, PyBUF_CONTIG_RO) != 0) return false;
+    size_t n = static_cast<size_t>(view.len);
+    if (n < 0x100) {
+      out->push_back(static_cast<char>(0xc4));
+      out->push_back(static_cast<char>(n));
+    } else if (n < 0x10000) {
+      out->push_back(static_cast<char>(0xc5));
+      PutBE16(out, static_cast<uint16_t>(n));
+    } else {
+      out->push_back(static_cast<char>(0xc6));
+      PutBE32(out, static_cast<uint32_t>(n));
+    }
+    out->append(static_cast<const char*>(view.buf), n);
+    PyBuffer_Release(&view);
+    return true;
+  }
+  if (PyList_Check(o) || PyTuple_Check(o)) {
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(o);
+    if (n < 16) {
+      out->push_back(static_cast<char>(0x90 | n));
+    } else if (n < 0x10000) {
+      out->push_back(static_cast<char>(0xdc));
+      PutBE16(out, static_cast<uint16_t>(n));
+    } else {
+      out->push_back(static_cast<char>(0xdd));
+      PutBE32(out, static_cast<uint32_t>(n));
+    }
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* item = PyList_Check(o) ? PyList_GET_ITEM(o, i)
+                                       : PyTuple_GET_ITEM(o, i);
+      if (!PackObj(out, item, depth + 1)) return false;
+    }
+    return true;
+  }
+  if (PyDict_Check(o)) {
+    Py_ssize_t n = PyDict_Size(o);
+    if (n < 16) {
+      out->push_back(static_cast<char>(0x80 | n));
+    } else if (n < 0x10000) {
+      out->push_back(static_cast<char>(0xde));
+      PutBE16(out, static_cast<uint16_t>(n));
+    } else {
+      out->push_back(static_cast<char>(0xdf));
+      PutBE32(out, static_cast<uint32_t>(n));
+    }
+    PyObject *key, *value;
+    Py_ssize_t pos = 0;
+    while (PyDict_Next(o, &pos, &key, &value)) {
+      if (!PackObj(out, key, depth + 1)) return false;
+      if (!PackObj(out, value, depth + 1)) return false;
+    }
+    return true;
+  }
+  PyErr_Format(PyExc_TypeError, "pack_frame: cannot pack %s",
+               Py_TYPE(o)->tp_name);
+  return false;
+}
+
+// -- decoder --
+
+enum ParseStatus { kParseOk = 0, kParseMore = 1, kParseErr = 2 };
+
+uint32_t GetBE32(const unsigned char* p) {
+  return (static_cast<uint32_t>(p[0]) << 24) |
+         (static_cast<uint32_t>(p[1]) << 16) |
+         (static_cast<uint32_t>(p[2]) << 8) | p[3];
+}
+
+// Parses one msgpack object at *off. On kParseOk advances *off and sets
+// *out (new reference). kParseMore = need more bytes (*off untouched,
+// no error set). kParseErr = malformed stream (Python error set).
+ParseStatus ParseObj(const unsigned char* p, size_t n, size_t* off,
+                     PyObject** out, int depth) {
+  if (depth > 128) {
+    PyErr_SetString(PyExc_ValueError, "msgpack nesting too deep");
+    return kParseErr;
+  }
+  size_t o = *off;
+  if (o >= n) return kParseMore;
+  uint8_t b = p[o++];
+  // Fast scalar forms first.
+  if (b < 0x80) {  // positive fixint
+    *out = PyLong_FromLong(b);
+    *off = o;
+    return *out ? kParseOk : kParseErr;
+  }
+  if (b >= 0xe0) {  // negative fixint
+    *out = PyLong_FromLong(static_cast<int8_t>(b));
+    *off = o;
+    return *out ? kParseOk : kParseErr;
+  }
+  size_t len = 0;
+  switch (b) {
+    case 0xc0:
+      Py_INCREF(Py_None);
+      *out = Py_None;
+      *off = o;
+      return kParseOk;
+    case 0xc2:
+      Py_INCREF(Py_False);
+      *out = Py_False;
+      *off = o;
+      return kParseOk;
+    case 0xc3:
+      Py_INCREF(Py_True);
+      *out = Py_True;
+      *off = o;
+      return kParseOk;
+    case 0xcc:
+      if (o + 1 > n) return kParseMore;
+      *out = PyLong_FromLong(p[o]);
+      *off = o + 1;
+      return *out ? kParseOk : kParseErr;
+    case 0xcd:
+      if (o + 2 > n) return kParseMore;
+      *out = PyLong_FromLong((p[o] << 8) | p[o + 1]);
+      *off = o + 2;
+      return *out ? kParseOk : kParseErr;
+    case 0xce:
+      if (o + 4 > n) return kParseMore;
+      *out = PyLong_FromUnsignedLong(GetBE32(p + o));
+      *off = o + 4;
+      return *out ? kParseOk : kParseErr;
+    case 0xcf: {
+      if (o + 8 > n) return kParseMore;
+      uint64_t v = (static_cast<uint64_t>(GetBE32(p + o)) << 32) |
+                   GetBE32(p + o + 4);
+      *out = PyLong_FromUnsignedLongLong(v);
+      *off = o + 8;
+      return *out ? kParseOk : kParseErr;
+    }
+    case 0xd0:
+      if (o + 1 > n) return kParseMore;
+      *out = PyLong_FromLong(static_cast<int8_t>(p[o]));
+      *off = o + 1;
+      return *out ? kParseOk : kParseErr;
+    case 0xd1:
+      if (o + 2 > n) return kParseMore;
+      *out = PyLong_FromLong(
+          static_cast<int16_t>((p[o] << 8) | p[o + 1]));
+      *off = o + 2;
+      return *out ? kParseOk : kParseErr;
+    case 0xd2:
+      if (o + 4 > n) return kParseMore;
+      *out = PyLong_FromLong(static_cast<int32_t>(GetBE32(p + o)));
+      *off = o + 4;
+      return *out ? kParseOk : kParseErr;
+    case 0xd3: {
+      if (o + 8 > n) return kParseMore;
+      uint64_t v = (static_cast<uint64_t>(GetBE32(p + o)) << 32) |
+                   GetBE32(p + o + 4);
+      *out = PyLong_FromLongLong(static_cast<int64_t>(v));
+      *off = o + 8;
+      return *out ? kParseOk : kParseErr;
+    }
+    case 0xca: {  // float32 (never emitted by us; accepted for parity)
+      if (o + 4 > n) return kParseMore;
+      uint32_t bits = GetBE32(p + o);
+      float f;
+      std::memcpy(&f, &bits, 4);
+      *out = PyFloat_FromDouble(f);
+      *off = o + 4;
+      return *out ? kParseOk : kParseErr;
+    }
+    case 0xcb: {
+      if (o + 8 > n) return kParseMore;
+      uint64_t bits = (static_cast<uint64_t>(GetBE32(p + o)) << 32) |
+                      GetBE32(p + o + 4);
+      double d;
+      std::memcpy(&d, &bits, 8);
+      *out = PyFloat_FromDouble(d);
+      *off = o + 8;
+      return *out ? kParseOk : kParseErr;
+    }
+    case 0xd9:  // str8
+      if (o + 1 > n) return kParseMore;
+      len = p[o];
+      o += 1;
+      goto parse_str;
+    case 0xda:
+      if (o + 2 > n) return kParseMore;
+      len = (p[o] << 8) | p[o + 1];
+      o += 2;
+      goto parse_str;
+    case 0xdb:
+      if (o + 4 > n) return kParseMore;
+      len = GetBE32(p + o);
+      o += 4;
+      goto parse_str;
+    case 0xc4:
+      if (o + 1 > n) return kParseMore;
+      len = p[o];
+      o += 1;
+      goto parse_bin;
+    case 0xc5:
+      if (o + 2 > n) return kParseMore;
+      len = (p[o] << 8) | p[o + 1];
+      o += 2;
+      goto parse_bin;
+    case 0xc6:
+      if (o + 4 > n) return kParseMore;
+      len = GetBE32(p + o);
+      o += 4;
+      goto parse_bin;
+    case 0xdc:
+      if (o + 2 > n) return kParseMore;
+      len = (p[o] << 8) | p[o + 1];
+      o += 2;
+      goto parse_array;
+    case 0xdd:
+      if (o + 4 > n) return kParseMore;
+      len = GetBE32(p + o);
+      o += 4;
+      goto parse_array;
+    case 0xde:
+      if (o + 2 > n) return kParseMore;
+      len = (p[o] << 8) | p[o + 1];
+      o += 2;
+      goto parse_map;
+    case 0xdf:
+      if (o + 4 > n) return kParseMore;
+      len = GetBE32(p + o);
+      o += 4;
+      goto parse_map;
+    default:
+      if ((b & 0xe0) == 0xa0) {  // fixstr
+        len = b & 0x1f;
+        goto parse_str;
+      }
+      if ((b & 0xf0) == 0x90) {  // fixarray
+        len = b & 0x0f;
+        goto parse_array;
+      }
+      if ((b & 0xf0) == 0x80) {  // fixmap
+        len = b & 0x0f;
+        goto parse_map;
+      }
+      // 0xc1 (reserved) and ext families: the wire never carries them.
+      PyErr_Format(PyExc_ValueError, "unsupported msgpack byte 0x%02x", b);
+      return kParseErr;
+  }
+
+parse_str:
+  if (len > kMaxWireFrame) {
+    PyErr_SetString(PyExc_ValueError, "msgpack str too large");
+    return kParseErr;
+  }
+  if (o + len > n) return kParseMore;
+  *out = PyUnicode_DecodeUTF8(reinterpret_cast<const char*>(p + o),
+                              static_cast<Py_ssize_t>(len), nullptr);
+  if (*out == nullptr) return kParseErr;
+  *off = o + len;
+  return kParseOk;
+
+parse_bin:
+  if (len > kMaxWireFrame) {
+    PyErr_SetString(PyExc_ValueError, "msgpack bin too large");
+    return kParseErr;
+  }
+  if (o + len > n) return kParseMore;
+  *out = PyBytes_FromStringAndSize(reinterpret_cast<const char*>(p + o),
+                                   static_cast<Py_ssize_t>(len));
+  if (*out == nullptr) return kParseErr;
+  *off = o + len;
+  return kParseOk;
+
+parse_array: {
+  if (len > (16u << 20)) {
+    PyErr_SetString(PyExc_ValueError, "msgpack array too large");
+    return kParseErr;
+  }
+  PyObject* list = PyList_New(static_cast<Py_ssize_t>(len));
+  if (list == nullptr) return kParseErr;
+  for (size_t i = 0; i < len; ++i) {
+    PyObject* item = nullptr;
+    ParseStatus st = ParseObj(p, n, &o, &item, depth + 1);
+    if (st != kParseOk) {
+      Py_DECREF(list);
+      return st;
+    }
+    PyList_SET_ITEM(list, static_cast<Py_ssize_t>(i), item);  // steals
+  }
+  *out = list;
+  *off = o;
+  return kParseOk;
+}
+
+parse_map: {
+  if (len > (16u << 20)) {
+    PyErr_SetString(PyExc_ValueError, "msgpack map too large");
+    return kParseErr;
+  }
+  PyObject* dict = PyDict_New();
+  if (dict == nullptr) return kParseErr;
+  for (size_t i = 0; i < len; ++i) {
+    PyObject* key = nullptr;
+    PyObject* value = nullptr;
+    ParseStatus st = ParseObj(p, n, &o, &key, depth + 1);
+    if (st != kParseOk) {
+      Py_DECREF(dict);
+      return st;
+    }
+    st = ParseObj(p, n, &o, &value, depth + 1);
+    if (st != kParseOk) {
+      Py_DECREF(key);
+      Py_DECREF(dict);
+      return st;
+    }
+    int rc = PyDict_SetItem(dict, key, value);
+    Py_DECREF(key);
+    Py_DECREF(value);
+    if (rc != 0) {  // e.g. unhashable key
+      Py_DECREF(dict);
+      return kParseErr;
+    }
+  }
+  *out = dict;
+  *off = o;
+  return kParseOk;
+}
+}
+
+// Streaming decoder object: the same feed()/iterate/tell() surface as
+// msgpack.Unpacker, so rpc._new_unpacker can swap it in transparently
+// (including the blob-mode switch, which relies on tell() counting total
+// consumed bytes since construction).
+struct DecoderObject {
+  PyObject_HEAD
+  std::string* buf;
+  size_t pos;                      // parse cursor into *buf
+  unsigned long long consumed;     // total bytes consumed since creation
+};
+
+PyObject* DecoderNew(PyTypeObject* type, PyObject*, PyObject*) {
+  DecoderObject* self =
+      reinterpret_cast<DecoderObject*>(type->tp_alloc(type, 0));
+  if (self == nullptr) return nullptr;
+  self->buf = new std::string();
+  self->pos = 0;
+  self->consumed = 0;
+  return reinterpret_cast<PyObject*>(self);
+}
+
+void DecoderDealloc(DecoderObject* self) {
+  delete self->buf;
+  Py_TYPE(self)->tp_free(reinterpret_cast<PyObject*>(self));
+}
+
+PyObject* DecoderFeed(DecoderObject* self, PyObject* arg) {
+  Py_buffer view;
+  if (PyObject_GetBuffer(arg, &view, PyBUF_CONTIG_RO) != 0) return nullptr;
+  if (self->buf->size() - self->pos + static_cast<size_t>(view.len) >
+      kMaxWireFrame) {
+    PyBuffer_Release(&view);
+    PyErr_SetString(PyExc_ValueError, "decoder buffer limit exceeded");
+    return nullptr;
+  }
+  // Compact consumed prefix before it grows unbounded.
+  if (self->pos > (1u << 20)) {
+    self->buf->erase(0, self->pos);
+    self->pos = 0;
+  }
+  self->buf->append(static_cast<const char*>(view.buf),
+                    static_cast<size_t>(view.len));
+  PyBuffer_Release(&view);
+  Py_RETURN_NONE;
+}
+
+PyObject* DecoderTell(DecoderObject* self, PyObject*) {
+  return PyLong_FromUnsignedLongLong(self->consumed);
+}
+
+PyObject* DecoderIter(PyObject* self) {
+  Py_INCREF(self);
+  return self;
+}
+
+PyObject* DecoderNext(DecoderObject* self) {
+  const unsigned char* p =
+      reinterpret_cast<const unsigned char*>(self->buf->data());
+  size_t n = self->buf->size();
+  size_t off = self->pos;
+  PyObject* out = nullptr;
+  ParseStatus st = ParseObj(p, n, &off, &out, 0);
+  if (st == kParseOk) {
+    self->consumed += off - self->pos;
+    self->pos = off;
+    return out;
+  }
+  if (st == kParseMore) return nullptr;  // StopIteration (no error set)
+  return nullptr;                        // kParseErr: Python error already set
+}
+
+PyMethodDef kDecoderMethods[] = {
+    {"feed", reinterpret_cast<PyCFunction>(DecoderFeed), METH_O,
+     "feed(bytes-like): append raw stream bytes"},
+    {"tell", reinterpret_cast<PyCFunction>(DecoderTell), METH_NOARGS,
+     "tell() -> total bytes consumed since creation"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyTypeObject DecoderType = {
+    PyVarObject_HEAD_INIT(nullptr, 0) "_fastpath.Decoder", /* tp_name */
+    sizeof(DecoderObject),                                 /* tp_basicsize */
+    0,                                                     /* tp_itemsize */
+    reinterpret_cast<destructor>(DecoderDealloc),          /* tp_dealloc */
+};
+
+PyObject* py_pack_frame(PyObject*, PyObject* arg) {
+  std::string out;
+  out.reserve(256);
+  if (!PackObj(&out, arg, 0)) return nullptr;
+  return PyBytes_FromStringAndSize(out.data(),
+                                   static_cast<Py_ssize_t>(out.size()));
+}
+
+PyObject* py_schema_versions(PyObject*, PyObject*) {
+  PyObject* d = PyDict_New();
+  if (d == nullptr) return nullptr;
+  for (const auto& s : kWireSchemas) {
+    PyObject* v = PyLong_FromLong(s.version);
+    if (v == nullptr || PyDict_SetItemString(d, s.method, v) != 0) {
+      Py_XDECREF(v);
+      Py_DECREF(d);
+      return nullptr;
+    }
+    Py_DECREF(v);
+  }
+  return d;
+}
+
 // ---------------------------------------------------------------- python
 
 PyObject* py_client_connect(PyObject*, PyObject* args) {
@@ -660,6 +1239,11 @@ PyMethodDef kMethods[] = {
      "serve(host, port, callback) -> (server_id, bound_port)"},
     {"stop_server", py_stop_server, METH_VARARGS, "stop a server"},
     {"stop_all", py_stop_all, METH_NOARGS, "stop the driver IO threads"},
+    {"pack_frame", py_pack_frame, METH_O,
+     "pack_frame(obj) -> bytes (msgpack, byte-identical to the Python "
+     "packer)"},
+    {"schema_versions", py_schema_versions, METH_NOARGS,
+     "schema_versions() -> {method: version} for natively packed schemas"},
     {nullptr, nullptr, 0, nullptr},
 };
 
@@ -670,4 +1254,22 @@ PyModuleDef kModule = {
 
 }  // namespace
 
-PyMODINIT_FUNC PyInit__fastpath() { return PyModule_Create(&kModule); }
+PyMODINIT_FUNC PyInit__fastpath() {
+  DecoderType.tp_flags = Py_TPFLAGS_DEFAULT;
+  DecoderType.tp_doc = "streaming msgpack decoder (msgpack.Unpacker surface)";
+  DecoderType.tp_iter = DecoderIter;
+  DecoderType.tp_iternext = reinterpret_cast<iternextfunc>(DecoderNext);
+  DecoderType.tp_methods = kDecoderMethods;
+  DecoderType.tp_new = DecoderNew;
+  if (PyType_Ready(&DecoderType) < 0) return nullptr;
+  PyObject* mod = PyModule_Create(&kModule);
+  if (mod == nullptr) return nullptr;
+  Py_INCREF(&DecoderType);
+  if (PyModule_AddObject(mod, "Decoder",
+                         reinterpret_cast<PyObject*>(&DecoderType)) < 0) {
+    Py_DECREF(&DecoderType);
+    Py_DECREF(mod);
+    return nullptr;
+  }
+  return mod;
+}

@@ -677,3 +677,88 @@ def test_stale_telemetry_allow_flagged(tmp_path):
     )
     findings = lint.audit_suppressions([str(tmp_path)])
     assert [f.rule for f in findings] == [lint.RULE_STALE]
+
+
+# ---------------------------------------------------------------------------
+# rpc_check: wire-native-drift
+# ---------------------------------------------------------------------------
+
+
+def _cc_fixture(tmp_path, markers):
+    cc = tmp_path / "fastpath.cc"
+    cc.write_text("// codec\n" + "\n".join(markers) + "\n")
+    return str(cc)
+
+
+def _native_markers():
+    """The markers matching the live wire.NATIVE_WIRE_SCHEMAS registry."""
+    from ray_tpu._private import wire
+
+    return [
+        f"// NATIVE_WIRE_SCHEMA: {m} v{v} fields={','.join(fields)}"
+        for m, (v, fields) in sorted(wire.NATIVE_WIRE_SCHEMAS.items())
+    ]
+
+
+def test_native_drift_clean_registry_negative(tmp_path):
+    cc = _cc_fixture(tmp_path, _native_markers())
+    assert rpc_check._check_native_wire_drift(cc_path=cc) == []
+
+
+def test_native_drift_field_mutation_positive(tmp_path):
+    """Mutating a natively packed schema's field list without touching the
+    C marker must fail lint — simulated by mutating the marker instead."""
+    markers = [
+        m.replace("dirty,lease_id", "dirty,lease_id,renamed_field")
+        for m in _native_markers()
+    ]
+    findings = rpc_check._check_native_wire_drift(
+        cc_path=_cc_fixture(tmp_path, markers)
+    )
+    assert any(
+        f.rule == rpc_check.RULE_NATIVE and "ReturnWorker" in f.message
+        for f in findings
+    )
+
+
+def test_native_drift_version_skew_positive(tmp_path):
+    markers = [
+        m.replace("RequestWorkerLease v1", "RequestWorkerLease v2")
+        for m in _native_markers()
+    ]
+    findings = rpc_check._check_native_wire_drift(
+        cc_path=_cc_fixture(tmp_path, markers)
+    )
+    assert any(
+        f.rule == rpc_check.RULE_NATIVE and "version skew" in f.message
+        for f in findings
+    )
+
+
+def test_native_drift_missing_marker_positive(tmp_path):
+    markers = [m for m in _native_markers() if "PubBatch" not in m]
+    findings = rpc_check._check_native_wire_drift(
+        cc_path=_cc_fixture(tmp_path, markers)
+    )
+    assert any(
+        f.rule == rpc_check.RULE_NATIVE and "PubBatch" in f.message
+        for f in findings
+    )
+
+
+def test_native_drift_stale_marker_positive(tmp_path):
+    markers = _native_markers() + [
+        "// NATIVE_WIRE_SCHEMA: GhostMethod v1 fields=x"
+    ]
+    findings = rpc_check._check_native_wire_drift(
+        cc_path=_cc_fixture(tmp_path, markers)
+    )
+    assert any(
+        f.rule == rpc_check.RULE_NATIVE and "GhostMethod" in f.message
+        for f in findings
+    )
+
+
+def test_native_drift_real_tree_is_clean():
+    """The committed fastpath.cc markers must match wire.py exactly."""
+    assert rpc_check._check_native_wire_drift() == []

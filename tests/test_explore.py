@@ -252,7 +252,12 @@ def test_ha_promotion_bounded_clean():
 def test_resubscribe_gap_bounded_clean():
     report = _explore_scenario("resubscribe_gap", budget=300)
     assert report.violations == 0, report.first_violation
-    assert report.schedules + report.pruned > 100
+    # Measured space: ~99 interleavings. The publisher's inline fan-out
+    # (no drain task when a subscriber has no backlog and a writable
+    # transport) removed one task-spawn choice point per delivery, so the
+    # space is smaller than the pre-batching ~150 — still far from
+    # degenerate.
+    assert report.schedules + report.pruned > 60
 
 
 def test_quorum_election_exhausts_clean():

@@ -102,3 +102,281 @@ def test_submit_to_closed_channel_returns_false(server):
 
 def test_connect_failure_returns_negative():
     assert fp.client_connect("127.0.0.1", 1) < 0
+
+
+# ---------------------------------------------------- wire codec parity
+
+msgpack = pytest.importorskip("msgpack")
+
+_PACKB = msgpack.Packer(use_bin_type=True, autoreset=True).pack
+_FUZZ_ROUNDS = 500
+
+
+def _lease_id(rng):
+    return "".join(rng.choices("0123456789abcdef", k=24))
+
+
+def _resources(rng):
+    names = ["CPU", "TPU", "memory", "node:10.0.0.%d" % rng.randrange(256),
+             "custom/res-%d" % rng.randrange(8)]
+    return {
+        rng.choice(names): rng.choice([1, 0.5, 4.0, rng.random() * 64])
+        for _ in range(rng.randrange(0, 4))
+    }
+
+
+def _payload_for(method, rng):
+    """Schema-shaped randomized payloads (field lists mirror
+    wire.NATIVE_WIRE_SCHEMAS; the drift lint keeps the two in sync)."""
+    if method == "RequestWorkerLease":
+        return {
+            "lease_id": _lease_id(rng),
+            "resources": _resources(rng),
+            "pg_id": rng.choice([None, _lease_id(rng)]),
+            "bundle_index": rng.choice([-1, 0, rng.randrange(64)]),
+            "strategy": rng.choice(
+                [None, {"spread": True}, {"node_affinity": {"node_id": _lease_id(rng), "soft": rng.random() < 0.5}}]
+            ),
+            "spilled_from": rng.random() < 0.3,
+            "locality": rng.choice(
+                [None, {"10.0.0.%d:%d" % (rng.randrange(256), rng.randrange(1024, 65536)): rng.random() * 8}]
+            ),
+            "job_id": rng.choice([None, "job-%04d" % rng.randrange(10000)]),
+        }
+    if method == "ReturnWorker":
+        return {"lease_id": _lease_id(rng), "dirty": rng.random() < 0.5}
+    if method == "CancelWorkerLease":
+        return {"lease_id": _lease_id(rng)}
+    if method == "LeaseBatch":
+        inner = ["RequestWorkerLease", "ReturnWorker", "CancelWorkerLease"]
+        return {
+            "entries": [
+                [
+                    rng.randrange(1, 1 << 30),
+                    m,
+                    _payload_for(m, rng),
+                    rng.choice([None, rng.random() * 30]),
+                    rng.choice([None, [_lease_id(rng), _lease_id(rng)[:16]]]),
+                ]
+                for m in (rng.choice(inner) for _ in range(rng.randrange(1, 9)))
+            ]
+        }
+    if method == "PubBatch":
+        return {
+            "items": [
+                [
+                    rng.choice(["NODE", "ACTOR", "WORKER", "health"]),
+                    rng.choice(
+                        [
+                            {"node_id": _lease_id(rng), "state": rng.choice(["ALIVE", "DEAD"])},
+                            {"actor_id": _lease_id(rng), "addr": ["10.0.0.1", rng.randrange(65536)]},
+                            b"\x00binary blob\xff" * rng.randrange(1, 4),
+                        ]
+                    ),
+                    rng.randrange(1, 1 << 40),
+                ]
+                for _ in range(rng.randrange(1, 9))
+            ]
+        }
+    raise AssertionError(method)
+
+
+def _frame_variants(method, payload, rng):
+    """The frame shapes rpc._pack_frame actually emits: bare request, with
+    TTL slot, and with TTL + trace-context slot (PR 4 / PR 13 survive
+    byte-for-byte)."""
+    msgid = rng.randrange(1, 1 << 31)
+    kind = 3 if method in ("LeaseBatch", "PubBatch") else 0
+    yield [msgid, kind, method, payload]
+    yield [msgid, kind, method, payload, rng.random() * 30]
+    yield [msgid, kind, method, payload, rng.random() * 30,
+           [_lease_id(rng), _lease_id(rng)[:16]]]
+
+
+def _norm(v):
+    if isinstance(v, (list, tuple)):
+        return [_norm(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _norm(val) for k, val in v.items()}
+    return v
+
+
+@pytest.mark.parametrize(
+    "method",
+    ["RequestWorkerLease", "ReturnWorker", "CancelWorkerLease", "LeaseBatch", "PubBatch"],
+)
+def test_native_pack_parity_fuzz(method):
+    """Per native schema: randomized frames pack byte-identically to the
+    Python packer, Python-unpack the native bytes losslessly, and
+    native-unpack the Python bytes losslessly — both directions of the
+    fallback boundary are interchangeable on the wire."""
+    import random
+
+    if not hasattr(fp, "pack_frame"):
+        pytest.skip("extension built without the wire codec")
+    rng = random.Random(hash(method) & 0xFFFF)
+    for _ in range(_FUZZ_ROUNDS):
+        payload = _payload_for(method, rng)
+        for frame in _frame_variants(method, payload, rng):
+            native = fp.pack_frame(frame)
+            pure = _PACKB(frame)
+            assert native == pure, f"{method}: byte divergence"
+            # native-pack -> Python-unpack
+            back = msgpack.unpackb(native, raw=False, strict_map_key=False)
+            assert back == _norm(frame)
+            # Python-pack -> native-unpack
+            dec = fp.Decoder()
+            dec.feed(pure)
+            got = list(dec)
+            assert got == [_norm(frame)]
+            assert dec.tell() == len(pure)
+
+
+def test_native_decoder_incremental_and_tell():
+    """Chunked feeds: frames split at arbitrary byte boundaries decode
+    exactly once each, and tell() counts total consumed bytes (the blob-
+    mode switch in rpc.data_received depends on that)."""
+    import random
+
+    if not hasattr(fp, "pack_frame"):
+        pytest.skip("extension built without the wire codec")
+    rng = random.Random(99)
+    frames = []
+    for _ in range(200):
+        m = rng.choice(["RequestWorkerLease", "ReturnWorker", "LeaseBatch"])
+        frames.append(next(_frame_variants(m, _payload_for(m, rng), rng)))
+    stream = b"".join(_PACKB(f) for f in frames)
+    dec = fp.Decoder()
+    got = []
+    i = 0
+    while i < len(stream):
+        n = rng.randrange(1, 4096)
+        dec.feed(stream[i : i + n])
+        i += n
+        got.extend(dec)
+    assert got == [_norm(f) for f in frames]
+    assert dec.tell() == len(stream)
+
+
+def test_native_decoder_rejects_malformed_bytes():
+    """Ext/reserved leaders are not part of the wire protocol: the decoder
+    must raise (the rpc layer drops the connection) instead of guessing."""
+    if not hasattr(fp, "pack_frame"):
+        pytest.skip("extension built without the wire codec")
+    for bad in (b"\xc1", b"\xc7\x01\x05x", b"\xd4\x05x", b"\xc8\x00\x01\x05x"):
+        dec = fp.Decoder()
+        dec.feed(bad)
+        with pytest.raises(Exception):
+            list(dec)
+
+
+def test_packed_payload_grant_reply_byte_identity():
+    """The pre-packed grant skeleton (raylet._grant_reply) must splice into
+    frames byte-identically to packing the equivalent plain-dict reply —
+    the wire format is unchanged, only who pays the encode."""
+    import asyncio
+
+    from ray_tpu._private import rpc as _rpc
+
+    mapping = {
+        "granted": True,
+        "worker_id": "w-00042",
+        "worker_addr": ["10.0.0.7", 45123],
+        "lease_id": "a1b2c3d4e5f60718293a4b5c",
+        "fp_port": 7011,
+    }
+    packed = _rpc.PackedPayload(mapping, _rpc._packb(mapping))
+
+    async def go():
+        server = _rpc.Server("127.0.0.1", 0)
+        addr = await server.start()
+        conn = await _rpc.connect(*addr)
+        try:
+            flats = []
+            for frame_payload in (mapping, packed):
+                bufs = conn._pack_frame([771, 1, "RequestWorkerLease", frame_payload])
+                flats.append(b"".join(bytes(b) for b in bufs))
+        finally:
+            await conn.close()
+            await server.stop()
+        return flats
+
+    plain, spliced = asyncio.run(go())
+    assert spliced == plain
+    assert msgpack.unpackb(plain, raw=False, strict_map_key=False) == [
+        771, 1, "RequestWorkerLease", mapping,
+    ]
+
+
+def test_python_fallback_when_native_masked():
+    """With the compiled module masked (import error) the rpc layer must
+    boot on the pure-Python packer and complete a lease-shaped round trip;
+    native is an accelerator, never a dependency."""
+    import subprocess
+
+    code = r"""
+import asyncio, sys
+
+class _Mask:
+    def find_module(self, name, path=None):
+        if name == "ray_tpu._native._fastpath":
+            return self
+    def load_module(self, name):
+        raise ImportError("masked for fallback test")
+
+sys.meta_path.insert(0, _Mask())
+
+from ray_tpu._private import rpc
+
+assert rpc._NATIVE_WIRE is None, "mask failed"
+assert not rpc.native_wire_active()
+
+async def go():
+    server = rpc.Server("127.0.0.1", 0)
+
+    async def lease(conn, p):
+        return {"granted": True, "lease_id": p["lease_id"]}
+
+    server.register("RequestWorkerLease", lease)
+    addr = await server.start()
+    conn = await rpc.connect(*addr)
+    try:
+        replies = await asyncio.gather(
+            *(conn.call_batched("RequestWorkerLease", {"lease_id": "L%d" % i})
+              for i in range(8))
+        )
+        assert [r["lease_id"] for r in replies] == ["L%d" % i for i in range(8)]
+    finally:
+        await conn.close()
+        await server.stop()
+
+asyncio.run(go())
+print("FALLBACK_OK")
+"""
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr
+    assert "FALLBACK_OK" in out.stdout
+
+
+def test_env_gate_disables_native_wire():
+    """RAY_TPU_NATIVE_WIRE=0 must force the pure-Python path even with the
+    extension importable."""
+    import subprocess
+
+    code = (
+        "from ray_tpu._private import rpc; "
+        "assert rpc._NATIVE_WIRE is None; "
+        "assert not rpc.native_wire_active(); "
+        "print('GATE_OK')"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=60,
+        env={**os.environ, "RAY_TPU_NATIVE_WIRE": "0", "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr
+    assert "GATE_OK" in out.stdout

@@ -185,3 +185,126 @@ def test_locality_miss_when_holder_saturated():
         cluster.run(client.close(), timeout=10)
     finally:
         cluster.shutdown()
+
+
+# --------------------------------------------- batched lease cancel race
+
+
+def test_cancel_before_batch_flush_withdraws_locally():
+    """A surplus cancel landing between enqueue-into-batch and the flush
+    tick must withdraw the entry from the pending LeaseBatch
+    (``try_cancel_batched``) instead of sending a CancelWorkerLease for a
+    request frame that never went out — the raylet would see a cancel for
+    a phantom lease_id."""
+    import asyncio
+
+    from ray_tpu._private import rpc
+    from ray_tpu._private.core_worker import LeasePool
+
+    async def go():
+        seen = []
+        server = rpc.Server("127.0.0.1", 0)
+
+        async def req(conn, p):
+            seen.append(("RequestWorkerLease", p["lease_id"]))
+            return {"cancelled": True}
+
+        async def cancel(conn, p):
+            seen.append(("CancelWorkerLease", p["lease_id"]))
+
+        server.register("RequestWorkerLease", req)
+        server.register("CancelWorkerLease", cancel)
+        addr = await server.start()
+        conn = await rpc.connect(*addr)
+
+        class _Core:
+            raylet_conn = conn
+            job_id = "job-test"
+
+        lp = LeasePool(_Core())
+        key = lp.shape_key({"CPU": 1}, None, -1, None)
+        pool = lp._pool(key, {"CPU": 1}, None, -1, None)
+        waiter = asyncio.get_running_loop().create_future()
+        pool.pending.append(("waiter", waiter, None))
+        try:
+            lp._pump(key, pool)  # spawns one _request_lease
+            assert pool.inflight == 1
+            # One tick: the request coroutine runs up to its reply await,
+            # queueing its entry into this tick's (still unsent) batch.
+            await asyncio.sleep(0)
+            assert len(conn._batch_entries) == 1, "request must sit in the unsent batch"
+            # The work disappears in the same tick (acquire cancelled).
+            waiter.cancel()
+            pool.pending.clear()
+            lp._pump(key, pool)  # surplus trim races the flush
+            assert pool.inflight == 0
+            assert conn._batch_entries == [], "entry must be withdrawn from the batch"
+            assert pool.inflight_ids == set()
+            assert pool.inflight_reqs == {}
+            # Let the flush tick and any stray frames land.
+            await asyncio.sleep(0.2)
+            assert seen == [], f"nothing may reach the wire, saw {seen}"
+            # The withdrawn coroutine must not double-decrement the slot.
+            assert pool.inflight == 0
+        finally:
+            await conn.close()
+            await server.stop()
+
+    asyncio.run(go())
+
+
+def test_cancel_after_batch_flush_sends_wire_cancel():
+    """Contrast case: once the batch has flushed, the surplus trim must
+    fall back to a wire CancelWorkerLease — the raylet holds the queued
+    request and must be told to drop it."""
+    import asyncio
+
+    from ray_tpu._private import rpc
+    from ray_tpu._private.core_worker import LeasePool
+
+    async def go():
+        seen = []
+        cancelled = asyncio.Event()
+        server = rpc.Server("127.0.0.1", 0)
+
+        async def req(conn, p):
+            seen.append(("RequestWorkerLease", p["lease_id"]))
+            await cancelled.wait()  # park until the cancel lands
+            return {"cancelled": True}
+
+        async def cancel(conn, p):
+            seen.append(("CancelWorkerLease", p["lease_id"]))
+            cancelled.set()
+
+        server.register("RequestWorkerLease", req)
+        server.register("CancelWorkerLease", cancel)
+        addr = await server.start()
+        conn = await rpc.connect(*addr)
+
+        class _Core:
+            raylet_conn = conn
+            job_id = "job-test"
+
+        lp = LeasePool(_Core())
+        key = lp.shape_key({"CPU": 1}, None, -1, None)
+        pool = lp._pool(key, {"CPU": 1}, None, -1, None)
+        waiter = asyncio.get_running_loop().create_future()
+        pool.pending.append(("waiter", waiter, None))
+        try:
+            lp._pump(key, pool)
+            await asyncio.sleep(0.1)  # batch flushes; request reaches server
+            assert ("RequestWorkerLease", next(iter(pool.inflight_ids))) in seen
+            waiter.cancel()
+            pool.pending.clear()
+            lp._pump(key, pool)
+            await asyncio.wait_for(cancelled.wait(), 5)
+            await asyncio.sleep(0.1)  # cancelled reply drains
+            assert [m for m, _ in seen] == ["RequestWorkerLease", "CancelWorkerLease"]
+            assert pool.inflight == 0
+            assert pool.inflight_ids == set()
+            assert pool.inflight_reqs == {}
+        finally:
+            await conn.close()
+            await server.stop()
+
+    asyncio.run(go())
